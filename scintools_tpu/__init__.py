@@ -1,0 +1,44 @@
+"""scintools_tpu — TPU-native pulsar-scintillation analysis & simulation.
+
+A brand-new JAX/XLA re-design with the capabilities of scintools
+(github.com/danielreardon/scintools): dynamic-spectrum loading and
+preprocessing, ACFs and secondary spectra, scintillation-parameter
+fitting (least-squares and MCMC), arc-curvature measurement, the θ-θ
+transform with phase retrieval and wavefield mosaicking, electromagnetic
+simulation, analytic forward models and pulsar velocity models.
+
+Backends: ``numpy`` (default, bit-reproducible) and ``jax`` (TPU).
+"""
+
+from .backend import set_default_backend, default_backend, get_xp
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "set_default_backend",
+    "default_backend",
+    "get_xp",
+    "Simulation",
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import scintools_tpu` light.
+    try:
+        if name in ("Dynspec", "BasicDyn", "MatlabDyn", "SimDyn", "HoloDyn",
+                    "sort_dyn"):
+            from . import dynspec as _d
+            return getattr(_d, name)
+        if name == "Simulation":
+            from .sim.simulation import Simulation
+            return Simulation
+        if name == "ACF":
+            from .sim.acf_model import ACF
+            return ACF
+        if name == "Brightness":
+            from .sim.brightness import Brightness
+            return Brightness
+    except ImportError as e:
+        raise AttributeError(
+            f"scintools_tpu.{name} unavailable: {e}") from e
+    raise AttributeError(f"module 'scintools_tpu' has no attribute {name!r}")

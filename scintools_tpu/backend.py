@@ -1,0 +1,66 @@
+"""Backend dispatch: bit-reproducible numpy default, JAX/TPU fast path.
+
+The reference package (scintools) is numpy-only. Here every hot kernel has a
+single generic implementation written against the ``xp`` array namespace
+(numpy or jax.numpy), with jitted JAX fast-paths registered where it pays.
+The numpy path is the default and is bit-reproducible run-to-run; the jax
+path targets TPU via XLA (see BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_DEFAULT_BACKEND = os.environ.get("SCINTOOLS_BACKEND", "numpy")
+
+_jax = None
+_jnp = None
+
+
+def _load_jax():
+    global _jax, _jnp
+    if _jax is None:
+        import jax
+        import jax.numpy as jnp
+
+        _jax = jax
+        _jnp = jnp
+    return _jax, _jnp
+
+
+def set_default_backend(backend):
+    """Set the process-wide default backend ('numpy' or 'jax')."""
+    global _DEFAULT_BACKEND
+    if backend not in ("numpy", "jax"):
+        raise ValueError("backend must be 'numpy' or 'jax'")
+    _DEFAULT_BACKEND = backend
+
+
+def default_backend():
+    return _DEFAULT_BACKEND
+
+
+def resolve_backend(backend=None):
+    return _DEFAULT_BACKEND if backend is None else backend
+
+
+def get_xp(backend=None):
+    """Return the array namespace for a backend name."""
+    backend = resolve_backend(backend)
+    if backend == "numpy":
+        return np
+    if backend == "jax":
+        return _load_jax()[1]
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def get_jax():
+    return _load_jax()[0]
+
+
+def to_numpy(x):
+    return np.asarray(x)
+
+

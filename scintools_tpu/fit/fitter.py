@@ -1,0 +1,196 @@
+"""Least-squares and MCMC drivers with lmfit/emcee-like result objects.
+
+Reproduces the role of ``fitter`` (/root/reference/scintools/
+scint_models.py:29-46): residual functions ``f(params, *args) ->
+residuals`` are minimised either by least squares or by an
+affine-invariant ensemble sampler (the emcee algorithm, Goodman & Weare
+2010), self-contained here since neither lmfit nor emcee is a
+dependency.
+
+The least-squares outer loop runs on host (scipy trust-region
+reflective); the residual function may internally evaluate jitted JAX
+models on TPU — that is where the flops are (e.g. the analytic-ACF 2-D
+fit). A fully-jitted vmapped LM lives in ``lm_jax.py`` for batch fits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.optimize import least_squares
+
+from .parameters import Parameters
+
+
+class MinimizerResult:
+    """Small result record mirroring the lmfit fields the reference
+    reads (dynspec.py:2946-3028): params (with stderr), residual,
+    chisqr, redchi, nfev, success, plus flatchain for MCMC."""
+
+    def __init__(self, params, residual=None, success=True, nfev=0,
+                 message=""):
+        self.params = params
+        self.residual = residual
+        self.success = success
+        self.nfev = nfev
+        self.message = message
+        if residual is not None:
+            self.chisqr = float(np.sum(np.square(residual)))
+            nvary = len(params.varying_names())
+            self.nfree = max(len(np.ravel(residual)) - nvary, 1)
+            self.redchi = self.chisqr / self.nfree
+        self.flatchain = None
+
+    def fit_report(self):
+        lines = [f"[[Fit]] success={self.success} nfev={self.nfev}"]
+        if hasattr(self, "chisqr"):
+            lines.append(f"chi-square={self.chisqr:.6g} "
+                         f"redchi={self.redchi:.6g}")
+        for name, par in self.params.items():
+            err = "None" if par.stderr is None else f"{par.stderr:.4g}"
+            lines.append(f"  {name}: {par.value:.6g} +/- {err}"
+                         f" ({'vary' if par.vary else 'fixed'})")
+        return "\n".join(lines)
+
+
+def _residual_vector(model, params, args):
+    res = model(params, *args)
+    return np.asarray(np.ravel(res), dtype=float)
+
+
+def minimize_leastsq(model, params, args=(), max_nfev=None,
+                     nan_policy="raise"):
+    """Trust-region-reflective least squares with stderr from the
+    jacobian covariance (lmfit ``Minimizer.minimize()`` equivalent)."""
+    params = params.copy()
+    names = params.varying_names()
+    if not names:
+        res = _residual_vector(model, params, args)
+        return MinimizerResult(params, residual=res, nfev=1)
+    x0 = params.varying_values()
+    lo, hi = params.varying_bounds()
+    # keep x0 strictly inside any finite bounds
+    with np.errstate(invalid="ignore"):
+        lo_in = np.where(np.isfinite(lo),
+                         lo + 1e-12 * np.maximum(1, np.abs(lo)), lo)
+        hi_in = np.where(np.isfinite(hi),
+                         hi - 1e-12 * np.maximum(1, np.abs(hi)), hi)
+    x0 = np.clip(x0, lo_in, hi_in)
+
+    nfev = 0
+
+    def fun(x):
+        nonlocal nfev
+        nfev += 1
+        r = _residual_vector(model, params.with_values(x), args)
+        if nan_policy == "omit":
+            r = np.where(np.isfinite(r), r, 0.0)
+        elif not np.all(np.isfinite(r)):
+            if nan_policy == "raise":
+                raise ValueError("NaN in residuals with nan_policy='raise'")
+        return r
+
+    sol = least_squares(fun, x0, bounds=(lo, hi), max_nfev=max_nfev)
+    params = params.with_values(sol.x)
+    result = MinimizerResult(params, residual=sol.fun, success=sol.success,
+                             nfev=nfev, message=sol.message)
+    # covariance from J^T J (Gauss-Newton approximation), lmfit-style
+    try:
+        J = sol.jac
+        _, s, VT = np.linalg.svd(J, full_matrices=False)
+        tol = np.finfo(float).eps * max(J.shape) * (s[0] if len(s) else 0)
+        s = s[s > tol]
+        VT = VT[: s.size]
+        cov = VT.T / s ** 2 @ VT
+        cov = cov * result.redchi
+        for i, name in enumerate(names):
+            result.params[name].stderr = float(np.sqrt(np.abs(cov[i, i])))
+        result.covar = cov
+    except Exception:
+        result.covar = None
+    return result
+
+
+def _log_prob(model, params, args, x, lo, hi, is_weighted=True):
+    if np.any(x < lo) or np.any(x > hi):
+        return -np.inf
+    try:
+        r = _residual_vector(model, params.with_values(x), args)
+    except Exception:
+        return -np.inf
+    if not np.all(np.isfinite(r)):
+        return -np.inf
+    return -0.5 * float(np.sum(r * r))
+
+
+def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
+                 burn=0.2, thin=10, pos=None, seed=0, progress=False,
+                 is_weighted=True):
+    """Affine-invariant ensemble sampler (stretch move, a=2), numpy
+    implementation. Returns MinimizerResult with ``flatchain`` and
+    median/std parameter estimates, like lmfit's ``Minimizer.emcee``."""
+    rng = np.random.default_rng(None if seed is None else seed)
+    params = params.copy()
+    names = params.varying_names()
+    ndim = len(names)
+    lo, hi = params.varying_bounds()
+    x0 = params.varying_values()
+
+    if pos is None:
+        scale = np.where(np.isfinite(hi - lo), (hi - lo) * 1e-2,
+                         1e-4 * np.maximum(np.abs(x0), 1.0))
+        pos = x0 + scale * rng.standard_normal((nwalkers, ndim))
+        pos = np.clip(pos, lo, hi)
+    else:
+        pos = np.array(pos, dtype=float)
+        nwalkers = pos.shape[0]
+
+    logp = np.array([_log_prob(model, params, args, p, lo, hi)
+                     for p in pos])
+    nburn = int(burn * steps) if burn < 1 else int(burn)
+    chain = []
+    a = 2.0
+    half = nwalkers // 2
+    for step in range(steps):
+        for first in (True, False):
+            idx = np.arange(0, half) if first else np.arange(half, nwalkers)
+            other = np.arange(half, nwalkers) if first else np.arange(0, half)
+            z = ((a - 1.0) * rng.random(len(idx)) + 1) ** 2 / a
+            partners = rng.choice(other, size=len(idx))
+            prop = pos[partners] + z[:, None] * (pos[idx] - pos[partners])
+            logp_prop = np.array([
+                _log_prob(model, params, args, p, lo, hi) for p in prop])
+            log_accept = (ndim - 1) * np.log(z) + logp_prop - logp[idx]
+            accept = np.log(rng.random(len(idx))) < log_accept
+            pos[idx[accept]] = prop[accept]
+            logp[idx[accept]] = logp_prop[accept]
+        if step >= nburn and step % thin == 0:
+            chain.append(pos.copy())
+        if progress and steps >= 10 and step % (steps // 10) == 0:
+            print(f"  emcee step {step}/{steps}")
+
+    flat = (np.array(chain).reshape(-1, ndim) if chain
+            else pos.reshape(-1, ndim))
+    for i, name in enumerate(names):
+        params[name].value = float(np.median(flat[:, i]))
+        params[name].stderr = float(np.std(flat[:, i]))
+    res = _residual_vector(model, params, args)
+    result = MinimizerResult(params, residual=res, nfev=nwalkers * steps)
+    result.flatchain = flat
+    result.var_names = names
+    return result
+
+
+def fitter(model, params, args, mcmc=False, pos=None, nwalkers=100,
+           steps=1000, burn=0.2, progress=True, workers=1,
+           nan_policy="raise", max_nfev=None, thin=10, is_weighted=True,
+           seed=0):
+    """Uniform driver matching the reference ``fitter`` signature
+    (scint_models.py:29-46). ``workers`` is accepted for API parity;
+    parallelism here is vectorised rather than process-based."""
+    if mcmc:
+        return sample_emcee(model, params, args, nwalkers=nwalkers,
+                            steps=steps, burn=burn, thin=thin, pos=pos,
+                            progress=progress, seed=seed,
+                            is_weighted=is_weighted)
+    return minimize_leastsq(model, params, args, max_nfev=max_nfev,
+                            nan_policy=nan_policy)

@@ -1,0 +1,378 @@
+"""Scintillation model library — residual functions for the fitter.
+
+Re-implements the model set of /root/reference/scintools/scint_models.py
+as pure, xp-generic (numpy or jax.numpy) functions so every model is
+jittable and differentiable on TPU. Each residual model keeps the
+reference contract: inputs (params, xdata, ydata, weights) → residuals =
+(ydata - model) * weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_xp, resolve_backend
+
+
+def _vals(params):
+    return params.valuesdict() if hasattr(params, "valuesdict") else params
+
+
+# --------------------------------------------------------------------------
+# 1-D / 2-D ACF models (scint_models.py:62-215)
+# --------------------------------------------------------------------------
+
+def tau_acf_model(params, xdata, ydata, weights, backend=None):
+    """amp·exp(−(t/τ)^α) × triangle taper; lag-0 weight zeroed
+    (scint_models.py:62-85)."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    if weights is None:
+        weights = xp.ones(xp.shape(ydata))
+    weights = xp.asarray(weights)
+    model = p["amp"] * xp.exp(-(xdata / p["tau"]) ** p["alpha"])
+    model = model * (1 - xdata / xp.max(xdata))
+    weights = weights.at[0].set(0) if hasattr(weights, "at") else _set0(weights)
+    return (ydata - model) * weights
+
+
+def _set0(w):
+    w = np.array(w, dtype=float)
+    w[0] = 0
+    return w
+
+
+def dnu_acf_model(params, xdata, ydata, weights, backend=None):
+    """amp·exp(−f/(Δν/ln2)) × triangle taper (scint_models.py:88-109)."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    if weights is None:
+        weights = xp.ones(xp.shape(ydata))
+    weights = xp.asarray(weights)
+    model = p["amp"] * xp.exp(-xdata / (p["dnu"] / np.log(2)))
+    model = model * (1 - xdata / xp.max(xdata))
+    weights = weights.at[0].set(0) if hasattr(weights, "at") else _set0(weights)
+    return (ydata - model) * weights
+
+
+def scint_acf_model(params, xdata, ydata, weights, backend=None):
+    """Joint τ and Δν 1-D fit (scint_models.py:112-120). xdata/ydata/
+    weights are (time_cut, freq_cut) pairs."""
+    xp = get_xp(resolve_backend(backend))
+    rt = tau_acf_model(params, xdata[0], ydata[0],
+                       None if weights is None else weights[0], backend)
+    rf = dnu_acf_model(params, xdata[1], ydata[1],
+                       None if weights is None else weights[1], backend)
+    return xp.concatenate((rt, rf))
+
+
+def scint_acf_model_2d_approx(params, tdata, fdata, ydata, weights,
+                              backend=None):
+    """Approximate analytic 2-D ACF with phase-gradient shear
+    (scint_models.py:123-161)."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    amp, dnu, tau, alpha = p["amp"], p["dnu"], p["tau"], p["alpha"]
+    mu = p["phasegrad"] * 60  # min/MHz → s/MHz
+    tobs, bw = p["tobs"], p["bw"]
+    nt, nf = len(tdata), len(fdata)
+    tdata = xp.reshape(xp.asarray(tdata), (nt, 1))
+    fdata = xp.reshape(xp.asarray(fdata), (1, nf))
+    if weights is None:
+        weights = np.ones(np.shape(ydata))
+
+    model = amp * xp.exp(
+        -(xp.abs((tdata - mu * fdata) / tau) ** (3 * alpha / 2)
+          + xp.abs(fdata / (dnu / np.log(2))) ** (3 / 2)) ** (2 / 3))
+    model = model * (1 - xp.abs(tdata) / tobs)
+    model = model * (1 - xp.abs(fdata) / bw)
+    weights = np.fft.fftshift(np.asarray(weights))
+    weights[-1, -1] = 0  # white-noise spike not fitted
+    weights = np.fft.ifftshift(weights)
+    model = xp.transpose(model)
+    return (ydata - model) * xp.asarray(weights)
+
+
+def scint_acf_model_2d(params, ydata, weights, backend=None):
+    """Analytic Rickett+14 2-D ACF fit (scint_models.py:164-215): the
+    expensive model — each evaluation builds the theoretical ACF via the
+    jitted kernel in sim/acf_model.py."""
+    from ..sim.acf_model import theoretical_acf
+
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    tau, dnu = abs(p["tau"]), abs(p["dnu"])
+    tobs, bw = p["tobs"], p["bw"]
+    nt, nf = p["nt"], p["nf"]
+    nf_crop, nt_crop = np.shape(ydata)
+    dt, df = 2 * tobs / nt, 2 * bw / nf
+    taumax = nt_crop * dt / tau
+    dnumax = nf_crop * df / dnu
+
+    acf = theoretical_acf(
+        taumax=taumax, dnumax=dnumax, nt=nt_crop, nf=nf_crop,
+        ar=abs(p["ar"]), alpha=p["alpha"], phasegrad=p["phasegrad"],
+        theta=p["theta"], amp=p["amp"], psi=p["psi"], wn=p.get("wn", 0),
+        backend=backend)
+    model = acf.acf
+
+    tri_t = 1 - np.abs(np.linspace(-taumax * tau, taumax * tau, nt_crop)) / tobs
+    tri_f = 1 - np.abs(np.linspace(-dnumax * dnu, dnumax * dnu, nf_crop)) / bw
+    model = model * xp.asarray(np.outer(tri_f, tri_t))
+
+    if weights is None:
+        weights = np.ones(np.shape(ydata))
+    weights = np.fft.fftshift(np.asarray(weights))
+    weights[-1, -1] = 0
+    weights = np.fft.ifftshift(weights)
+    return (ydata - model) * xp.asarray(weights)
+
+
+# --------------------------------------------------------------------------
+# Secondary-spectrum 1-D models (scint_models.py:218-284)
+# --------------------------------------------------------------------------
+
+def _sspec_1d(model, xdata, xp):
+    model = model * (1 - xdata / xp.max(xdata))
+    flipped = model[::-1]
+    model = xp.concatenate((model, flipped))[: 2 * len(xdata) - 1]
+    model = xp.real(xp.fft.fft(model))[: len(xdata)]
+    return model
+
+
+def tau_sspec_model(params, xdata, ydata, backend=None):
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    model = p["amp"] * xp.exp(-(xdata / p["tau"]) ** p["alpha"])
+    model = xp.where(xp.arange(len(xdata)) == 0, 0.0, model)
+    model = _sspec_1d(model, xdata, xp)
+    return (ydata - model) * model
+
+
+def dnu_sspec_model(params, xdata, ydata, backend=None):
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    model = p["amp"] * xp.exp(-xdata / (p["dnu"] / np.log(2)))
+    model = xp.where(xp.arange(len(xdata)) == 0, 0.0, model)
+    model = _sspec_1d(model, xdata, xp)
+    return (ydata - model) * model
+
+
+def scint_sspec_model(params, xdata, ydata, backend=None):
+    xp = get_xp(resolve_backend(backend))
+    rt = tau_sspec_model(params, xdata[0], ydata[0], backend)
+    rf = dnu_sspec_model(params, xdata[1], ydata[1], backend)
+    return xp.concatenate((rt, rf))
+
+
+def powerspectrum_model(params, xdata, ydata, backend=None):
+    """wn + amp·x^alpha (scint_models.py:49-59)."""
+    p = _vals(params)
+    return ydata - (p["wn"] + p["amp"] * xdata ** p["alpha"])
+
+
+# --------------------------------------------------------------------------
+# Parabola fitters (scint_models.py:300-347) — closed-form polyfit
+# --------------------------------------------------------------------------
+
+def fit_parabola(x, y):
+    """Deg-2 polyfit with covariance → (yfit, peak, peak_error)."""
+    x = np.asarray(x, dtype=float)
+    y = np.asarray(y, dtype=float)
+    ptp = np.ptp(x)
+    xs = x * (1000 / ptp)
+    params, pcov = np.polyfit(xs, y, 2, cov=True)
+    yfit = params[0] * xs ** 2 + params[1] * xs + params[2]
+    errors = np.sqrt(np.abs(np.diag(pcov)))
+    peak = -params[1] / (2 * params[0])
+    peak_error = np.sqrt((errors[1] ** 2) * ((1 / (2 * params[0])) ** 2)
+                         + (errors[0] ** 2) * ((params[1] / 2) ** 2))
+    return yfit, peak * (ptp / 1000), peak_error * (ptp / 1000)
+
+
+def fit_log_parabola(x, y):
+    """Parabola fit in log-x (scint_models.py:329-347)."""
+    logx = np.log(np.asarray(x, dtype=float))
+    ptp = np.ptp(logx)
+    xs = logx * (1000 / ptp)
+    yfit, peak, peak_error = fit_parabola(xs, y)
+    frac_error = peak_error / peak
+    peak = np.e ** (peak * ptp / 1000)
+    return yfit, peak, frac_error * peak
+
+
+# --------------------------------------------------------------------------
+# Velocity / curvature models (scint_models.py:350-587)
+# --------------------------------------------------------------------------
+
+def effective_velocity_annual(params, true_anomaly, vearth_ra, vearth_dec,
+                              mjd=None, backend=None):
+    """Keplerian binary + proper motion + Earth → effective velocity in
+    RA/DEC (scint_models.py:504-587). Pure function of arrays; jittable
+    when true anomaly is precomputed."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    v_c = 299792.458
+    kmpkpc = 3.085677581e16
+    secperyr = 86400 * 365.2425
+    masrad = np.pi / (3600 * 180 * 1000)
+
+    if "PB" in p:
+        A1, PB, ECC = p["A1"], p["PB"], p["ECC"]
+        OM = p["OM"] * np.pi / 180
+        if "OMDOT" in p and mjd is not None:
+            omega = OM + (p["OMDOT"] * np.pi / 180
+                          * (mjd - p["T0"]) / 365.2425)
+        else:
+            omega = OM
+        if "KIN" in p:
+            INC = p["KIN"] * np.pi / 180
+        elif "COSI" in p:
+            INC = xp.arccos(p["COSI"])
+        elif "SINI" in p:
+            INC = xp.arcsin(p["SINI"])
+        else:
+            raise KeyError("inclination parameter (KIN, COSI, or SINI) "
+                           "not found")
+        if "sense" in p:
+            if p["sense"] < 0.5 and INC > np.pi / 2:
+                INC = np.pi - INC
+            if p["sense"] >= 0.5 and INC < np.pi / 2:
+                INC = np.pi - INC
+        KOM = p["KOM"] * np.pi / 180
+        vp_0 = (2 * np.pi * A1 * v_c) / (xp.sin(INC) * PB * 86400
+                                         * np.sqrt(1 - ECC ** 2))
+        vp_x = -vp_0 * (ECC * xp.sin(omega) + xp.sin(true_anomaly + omega))
+        vp_y = vp_0 * xp.cos(INC) * (ECC * xp.cos(omega)
+                                     + xp.cos(true_anomaly + omega))
+    else:
+        vp_x = 0.0
+        vp_y = 0.0
+        KOM = p.get("KOM", 0.0) * np.pi / 180
+
+    PMRA = p.get("PMRA", 0.0)
+    PMDEC = p.get("PMDEC", 0.0)
+    s = p["s"]
+    d = p["d"] * kmpkpc
+    pmra_v = PMRA * masrad * d / secperyr
+    pmdec_v = PMDEC * masrad * d / secperyr
+
+    vp_ra = np.sin(KOM) * vp_x + np.cos(KOM) * vp_y
+    vp_dec = np.cos(KOM) * vp_x - np.sin(KOM) * vp_y
+
+    veff_ra = s * vearth_ra + (1 - s) * (vp_ra + pmra_v)
+    veff_dec = s * vearth_dec + (1 - s) * (vp_dec + pmdec_v)
+    return veff_ra, veff_dec, vp_ra, vp_dec
+
+
+def arc_curvature(params, ydata, weights, true_anomaly, vearth_ra,
+                  vearth_dec, mjd=None, model_only=False,
+                  return_veff=False, backend=None):
+    """η = d·s(1−s)/(2·veff²)/1e9 curvature model
+    (scint_models.py:350-425)."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    if "psi" in p:
+        raise KeyError("parameter psi is no longer supported. "
+                       "Please use zeta")
+    if "vism_psi" in p:
+        raise KeyError("parameter vism_psi is no longer supported. "
+                       "Please use vism_zeta")
+    kmpkpc = 3.085677581e16
+    d = p["d"]
+    dkm = d * kmpkpc
+    s = p["s"]
+
+    veff_ra, veff_dec, _, _ = effective_velocity_annual(
+        params, true_anomaly, vearth_ra, vearth_dec, mjd=mjd,
+        backend=backend)
+
+    nmodel = p.get("nmodel", 1 if "zeta" in p else 0)
+    vism_ra = p.get("vism_ra", 0)
+    vism_dec = p.get("vism_dec", 0)
+
+    if nmodel > 0.5:  # anisotropic
+        zeta = p["zeta"] * np.pi / 180
+        if "vism_zeta" in p:
+            veff2 = (veff_ra * xp.sin(zeta) + veff_dec * xp.cos(zeta)
+                     - p["vism_zeta"]) ** 2
+        else:
+            veff2 = ((veff_ra - vism_ra) * xp.sin(zeta)
+                     + (veff_dec - vism_dec) * xp.cos(zeta)) ** 2
+    else:
+        veff2 = (veff_ra - vism_ra) ** 2 + (veff_dec - vism_dec) ** 2
+
+    model = dkm * s * (1 - s) / (2 * veff2) / 1e9  # 1/(m mHz²)
+    if weights is None:
+        weights = np.ones(np.shape(ydata))
+    if model_only:
+        if return_veff:
+            return model, (veff_ra - vism_ra), (veff_dec - vism_dec)
+        return model
+    return (ydata - model) * weights
+
+
+def veff_thin_screen(params, ydata, weights, true_anomaly, vearth_ra,
+                     vearth_dec, mjd=None, backend=None):
+    """Rickett+14 Eq.4 thin-screen scintillation-velocity model
+    (scint_models.py:428-496)."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    s, d = p["s"], p["d"]
+    kappa = p.get("kappa", 1)
+    veff_ra, veff_dec, _, _ = effective_velocity_annual(
+        params, true_anomaly, vearth_ra, vearth_dec, mjd=mjd,
+        backend=backend)
+    nmodel = p.get("nmodel", 1 if "psi" in p else 0)
+    veff_ra = veff_ra - p.get("vism_ra", 0)
+    veff_dec = veff_dec - p.get("vism_dec", 0)
+    if nmodel > 0.5:
+        R = p["R"]
+        psi = p["psi"] * np.pi / 180
+        cosa, sina = np.cos(2 * psi), np.sin(2 * psi)
+        a = (1 - R * cosa) / np.sqrt(1 - R ** 2)
+        b = (1 + R * cosa) / np.sqrt(1 - R ** 2)
+        c = -2 * R * sina / np.sqrt(1 - R ** 2)
+    else:
+        a, b, c = 1, 1, 0
+    coeff = 1 / np.sqrt(2 * d * (1 - s) / s)
+    veff = kappa * xp.sqrt(a * veff_dec ** 2 + b * veff_ra ** 2
+                           + c * veff_ra * veff_dec)
+    model = coeff * veff / s
+    if weights is None:
+        weights = np.ones(np.shape(ydata))
+    return (ydata - model) * weights
+
+
+# --------------------------------------------------------------------------
+# Weak-scintillation arc models (scint_models.py:590-663)
+# --------------------------------------------------------------------------
+
+def arc_weak(ftn, ar=1, psi=0, alpha=11 / 3, backend=None):
+    """1-D weak-scintillation Doppler profile (scint_models.py:590-618)."""
+    xp = get_xp(resolve_backend(backend))
+    cs, sn = np.cos(psi * np.pi / 180), np.sin(psi * np.pi / 180)
+    a = cs ** 2 / ar + ar * sn ** 2
+    b = ar * cs ** 2 + sn ** 2 / ar
+    c = 2 * sn * cs * (1 / ar - ar)
+    p = ((a * ftn ** 2 + b * (1 - ftn ** 2)
+          + c * ftn * (1 - ftn ** 2) ** 0.5) ** (-alpha / 2)
+         + (a * ftn ** 2 + b * (1 - ftn ** 2)
+            - c * ftn * (1 - ftn ** 2) ** 0.5) ** (-alpha / 2))
+    return p / xp.sqrt(1 - ftn ** 2)
+
+
+def arc_weak_2d(fdop, tdel, eta=1, ar=1, psi=0, alpha=11 / 3, backend=None):
+    """2-D weak-scintillation model sspec (scint_models.py:621-663)."""
+    xp = get_xp(resolve_backend(backend))
+    cs, sn = np.cos(psi * np.pi / 180), np.sin(psi * np.pi / 180)
+    a = cs ** 2 / ar + ar * sn ** 2
+    b = ar * cs ** 2 + sn ** 2 / ar
+    c = 2 * sn * cs * (1 / ar - ar)
+    fdx, TDEL = xp.meshgrid(xp.asarray(fdop), xp.asarray(tdel))
+    f_arc = xp.sqrt(TDEL / eta)
+    fdy = xp.sqrt(TDEL / eta - fdx ** 2)
+    p = ((a * fdx ** 2 + b * fdy ** 2 + c * fdx * fdy) ** (-11 / 6)
+         + (a * fdx ** 2 + b * fdy ** 2 - c * fdx * fdy) ** (-11 / 6))
+    arc_frac = xp.real(fdx) / xp.real(f_arc)
+    return p / xp.sqrt(1 - arc_frac ** 2)

@@ -1,0 +1,72 @@
+"""Minimal lmfit-compatible Parameters container.
+
+The reference builds its fitting layer on lmfit's ``Parameters`` /
+``Minimizer`` (/root/reference/scintools/scint_models.py:29-46). lmfit
+is not a dependency here; this module provides the small API subset the
+reference actually uses: ``add``, mapping access, ``value``/``stderr``/
+``vary``/``min``/``max`` attributes and ``valuesdict()``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Parameter:
+    __slots__ = ("name", "value", "vary", "min", "max", "stderr")
+
+    def __init__(self, name, value=0.0, vary=True, min=-np.inf, max=np.inf):
+        self.name = name
+        self.value = value
+        self.vary = vary
+        self.min = -np.inf if min is None else min
+        self.max = np.inf if max is None else max
+        self.stderr = None
+
+    def __repr__(self):
+        return (f"<Parameter {self.name!r} value={self.value} "
+                f"vary={self.vary} bounds=[{self.min}, {self.max}] "
+                f"stderr={self.stderr}>")
+
+
+class Parameters(dict):
+    """dict of name → Parameter with lmfit-style helpers."""
+
+    def add(self, name, value=0.0, vary=True, min=-np.inf, max=np.inf):
+        self[name] = Parameter(name, value=value, vary=vary, min=min, max=max)
+        return self[name]
+
+    def add_many(self, *items):
+        for it in items:
+            self.add(*it)
+
+    def valuesdict(self):
+        return {k: v.value for k, v in self.items()}
+
+    def copy(self):
+        new = Parameters()
+        for k, v in self.items():
+            p = new.add(k, value=v.value, vary=v.vary, min=v.min, max=v.max)
+            p.stderr = v.stderr
+        return new
+
+    # --- helpers used by the solvers -------------------------------------
+    def varying_names(self):
+        return [k for k, v in self.items() if v.vary]
+
+    def varying_values(self):
+        return np.array([self[k].value for k in self.varying_names()],
+                        dtype=float)
+
+    def varying_bounds(self):
+        names = self.varying_names()
+        lo = np.array([self[k].min for k in names], dtype=float)
+        hi = np.array([self[k].max for k in names], dtype=float)
+        return lo, hi
+
+    def with_values(self, x):
+        """Return a copy with varying parameters set from vector ``x``."""
+        new = self.copy()
+        for name, val in zip(self.varying_names(), np.atleast_1d(x)):
+            new[name].value = float(val)
+        return new

@@ -1,0 +1,152 @@
+"""psrflux-format dynamic-spectrum I/O (host-side).
+
+Format: '#'-comment header containing 'MJD0: <mjd>', then whitespace rows
+``isub ichan time(min) freq(MHz) flux [flux_err]``. Parsing semantics
+follow ``Dynspec.load_file`` (/root/reference/scintools/dynspec.py:144-230):
+reshape to (nsub, nchan), transpose to (nchan, nsub), flip to ascending
+frequency, estimate dt/df/bw the same way.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+
+@dataclass
+class RawDynSpec:
+    """Plain container for a loaded dynamic spectrum (host numpy arrays).
+
+    dyn has shape (nchan, nsub): frequency × time, ascending frequency.
+    times are seconds since obs start; freqs in MHz; dt s; df MHz.
+    """
+
+    dyn: np.ndarray
+    times: np.ndarray
+    freqs: np.ndarray
+    mjd: float = 60000.0
+    name: str = "dynspec"
+    header: list = field(default_factory=list)
+    filename: str | None = None
+
+    # derived quantities, populated in __post_init__ if left None
+    dt: float | None = None
+    df: float | None = None
+    bw: float | None = None
+    freq: float | None = None
+    tobs: float | None = None
+
+    def __post_init__(self):
+        self.dyn = np.asarray(self.dyn)
+        self.times = np.asarray(self.times, dtype=float)
+        self.freqs = np.asarray(self.freqs, dtype=float)
+        if self.dt is None:
+            self.dt = float(np.mean(np.diff(self.times))) if len(self.times) > 1 else 1.0
+        if self.df is None:
+            self.df = float(np.mean(np.diff(self.freqs))) if len(self.freqs) > 1 else 1.0
+        if self.bw is None:
+            self.bw = float(self.freqs[-1] - self.freqs[0] + self.df)
+        if self.freq is None:
+            self.freq = float(round(np.mean(self.freqs), 2))
+        if self.tobs is None:
+            self.tobs = float(np.max(self.times) + self.dt - np.min(self.times))
+
+    @property
+    def nchan(self):
+        return self.dyn.shape[0]
+
+    @property
+    def nsub(self):
+        return self.dyn.shape[1]
+
+    def copy(self, **kwargs):
+        out = replace(self, **kwargs) if kwargs else replace(self)
+        out.dyn = np.array(out.dyn)
+        return out
+
+
+def load_psrflux(filename, mjd=None):
+    """Parse a psrflux file → RawDynSpec. Mirrors dynspec.py:169-218."""
+    head = []
+    file_mjd = None
+    with open(filename, "r") as fh:
+        for line in fh:
+            if line.startswith("#"):
+                headline = line[1:].strip()
+                head.append(headline)
+                parts = headline.split()
+                if parts and parts[0] == "MJD0:" and file_mjd is None:
+                    file_mjd = float(parts[1])
+    raw = np.loadtxt(filename).transpose()
+    times = np.unique(raw[2] * 60)  # minutes → seconds, leading edges
+    if mjd is not None:
+        mjd0 = mjd
+    else:
+        mjd0 = (file_mjd if file_mjd is not None else 60000.0) + times[0] / 86400
+    times = times - times[0]
+    freqs = raw[3]
+    fluxes = raw[4]
+    nchan = int(np.max(raw[1])) + 1
+    bw = freqs[-1] - freqs[0]
+    df = round(bw / nchan, 5)
+    bw = round(bw + df, 2)
+    nsub = int(np.max(raw[0])) + 1
+    dt = float(np.mean(np.diff(times)))
+    tobs = float(np.max(times) + dt)
+
+    freqs = np.unique(freqs)
+    fluxes = fluxes.reshape([nsub, nchan]).transpose()
+    if df < 0:  # stored descending: flip to ascending frequency
+        df, bw = -df, -bw
+        fluxes = np.flip(fluxes, 0)
+
+    return RawDynSpec(
+        dyn=fluxes, times=times, freqs=freqs, mjd=float(mjd0),
+        name=os.path.basename(filename), header=head, filename=filename,
+        dt=dt, df=df, bw=float(bw), freq=float(round(np.mean(freqs), 2)),
+        tobs=tobs,
+    )
+
+
+def write_psrflux(ds, filename, note=None):
+    """Write RawDynSpec (or any object with the same attrs) to a psrflux
+    file, with provenance header (dynspec.py:330-376 semantics)."""
+    with open(filename, "w") as fn:
+        fn.write("# Scintools-TPU dynamic spectrum in psrflux format\n")
+        if note is not None:
+            fn.write(f"# Note: {note}\n")
+        fn.write(f"# MJD0: {ds.mjd}\n")
+        fn.write("# Original header begins below:\n")
+        has_isub = False
+        for line in ds.header:
+            fn.write(f"# {line} \n")
+            if "isub" in line:
+                has_isub = True
+        if not has_isub:
+            fn.write("# isub ichan time(min) freq(MHz) flux flux_err\n")
+        for i, ti in enumerate(np.asarray(ds.times) / 60):
+            for j, fi in enumerate(ds.freqs):
+                fn.write(f"{i} {j} {ti} {fi} {ds.dyn[j, i]} {0}\n")
+
+
+def concatenate_time(ds1, ds2):
+    """Time-concatenate two dynamic spectra, zero-filling the MJD gap
+    (Dynspec.__add__ semantics, dynspec.py:81-142)."""
+    timegap = round((ds2.mjd - ds1.mjd) * 86400 - ds1.tobs, 1)
+    extratimes = np.arange(0, timegap, ds1.dt)
+    nextra = 0 if timegap < ds1.dt else len(extratimes)
+    gap = np.zeros([ds1.dyn.shape[0], nextra])
+    nsub = ds1.nsub + nextra + ds2.nsub
+    tobs = ds1.tobs + timegap + ds2.tobs
+    times = np.linspace(0, tobs, nsub)
+    newdyn = np.concatenate((ds1.dyn, gap, ds2.dyn), axis=1)
+    name = (ds1.name.split(".")[0] + "+" + ds2.name.split(".")[0]
+            + ".dynspec")
+    return RawDynSpec(
+        dyn=newdyn, times=times, freqs=ds1.freqs,
+        mjd=min(ds1.mjd, ds2.mjd), name=name,
+        header=list(ds1.header) + list(ds2.header),
+        dt=ds1.dt, df=ds1.df, bw=ds1.bw, freq=ds1.freq, tobs=tobs,
+    )

@@ -1,0 +1,76 @@
+"""2-D autocovariance kernel.
+
+Functional re-design of ``Dynspec.calc_acf`` (direct method,
+/root/reference/scintools/dynspec.py:3780-3797): zero-padded
+``fft2 → |·|² → ifft2 → fftshift``, normalised to peak. The slow
+O(N^4) direct autocorrelation (scint_utils.py:67-84) is kept in
+tests as the oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_xp, resolve_backend
+
+
+def autocovariance(dyn, normalise=True, mean_sub=True, backend=None):
+    """2-D ACF of ``dyn[..., nf, nt]`` → shape (..., 2*nf, 2*nt).
+
+    Batch dimensions vmap/broadcast transparently (the FFTs act on the
+    last two axes).
+    """
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    dyn = xp.asarray(dyn)
+    nf, nt = dyn.shape[-2:]
+    if mean_sub:
+        # reference subtracts the mean over valid (finite) points; invalid
+        # points then contribute zero (per batch slice, both backends)
+        finite = xp.isfinite(dyn)
+        dyn0 = xp.where(finite, dyn, 0.0)
+        nvalid = xp.sum(finite, axis=(-2, -1), keepdims=True)
+        mean = xp.sum(dyn0, axis=(-2, -1), keepdims=True) / nvalid
+        dyn = xp.where(finite, dyn - mean, 0.0)
+    arr = xp.fft.fft2(dyn, s=(2 * nf, 2 * nt))
+    arr = xp.abs(arr) ** 2
+    arr = xp.fft.ifft2(arr)
+    arr = xp.fft.fftshift(arr, axes=(-2, -1))
+    arr = arr.real
+    if normalise:
+        arr = arr / xp.max(arr, axis=(-2, -1), keepdims=True)
+    return arr
+
+
+def acf_from_sspec(sspec_db, normalise=True, backend=None):
+    """ACF via the secondary spectrum ('sspec' method,
+    dynspec.py:3798-3807). ``sspec_db`` must be the full-frame (not
+    halved) spectrum in dB."""
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    s = xp.fft.fftshift(xp.asarray(sspec_db))
+    arr = xp.fft.fft2(10 ** (s / 10))
+    arr = xp.fft.fftshift(arr).real
+    if normalise:
+        arr = arr / xp.max(arr)
+    return arr
+
+
+def autocorr_direct(arr, mask=None):
+    """Slow masked O(N^4) 2-D autocorrelation — test oracle
+    (scint_utils.py:67-84 semantics, numpy only)."""
+    arr = np.ma.masked_invalid(np.asarray(arr, dtype=float))
+    if mask is not None:
+        arr = np.ma.masked_array(arr, mask=mask)
+    mean = np.ma.mean(arr)
+    std = np.ma.std(arr)
+    nr, nc = arr.shape
+    out = np.zeros((2 * nr, 2 * nc))
+    for x in range(-nr, nr):
+        for y in range(-nc, nc):
+            seg = (arr[max(0, x):min(x + nr, nr), max(0, y):min(y + nc, nc)]
+                   - mean) * (arr[max(0, -x):min(-x + nr, nr),
+                                  max(0, -y):min(-y + nc, nc)] - mean)
+            out[x + nr][y + nc] = np.ma.sum(seg) / (std ** 2)
+    out /= np.nanmax(out)
+    return out

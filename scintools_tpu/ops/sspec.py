@@ -1,0 +1,109 @@
+"""Secondary-spectrum kernel (2-D power spectrum of a dynamic spectrum).
+
+Functional re-design of ``Dynspec.calc_sspec``
+(/root/reference/scintools/dynspec.py:3584-3748): mean-subtract →
+edge-taper window → zero-pad to next-pow2+1 → fft2 → power → fftshift →
+keep positive delays → optional prewhiten (first-difference) /
+post-darken → 10·log10.
+
+All shapes are static given the input shape, so the jax path jits and
+vmaps cleanly (BASELINE.json north-star kernel #1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_xp, resolve_backend
+from .windows import get_window, apply_window
+
+
+def fft_shapes(nf, nt):
+    """FFT lengths used by the reference: next power of two, doubled."""
+    nrfft = int(2 ** (np.ceil(np.log2(nf)) + 1))
+    ncfft = int(2 ** (np.ceil(np.log2(nt)) + 1))
+    return nrfft, ncfft
+
+
+def sspec_axes(nf, nt, dt, df, halve=True, dlam=None):
+    """(fdop [mHz], tdel [us], beta [m^-1] or None) axes for the sspec."""
+    nrfft, ncfft = fft_shapes(nf, nt)
+    td = np.arange(nrfft // 2 if halve else nrfft)
+    fd = np.arange(-ncfft // 2, ncfft // 2)
+    fdop = fd * 1e3 / (ncfft * dt)
+    tdel = td / (nrfft * df)
+    beta = td / (nrfft * dlam) if dlam is not None else None
+    return fdop, tdel, beta
+
+
+def _prewhite_diff(dyn, xp):
+    """2-D first-difference prewhitening: 'valid' convolution with
+    [[1,-1],[-1,1]] (dynspec.py:3680-3682)."""
+    return (dyn[1:, 1:] - dyn[1:, :-1] - dyn[:-1, 1:] + dyn[:-1, :-1])
+
+
+def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
+                             halve=True, backend=None):
+    """Linear-power secondary spectrum of ``dyn[nf, nt]``.
+
+    window_arrays: optional (chan_window[nt], subint_window[nf]) from
+    :func:`get_window`; None to skip windowing.
+
+    Returns power (not dB) with shape (nrfft//2 if halve else nrfft, ncfft).
+    """
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    dyn = xp.asarray(dyn)
+    nf, nt = dyn.shape
+    nrfft, ncfft = fft_shapes(nf, nt)
+
+    dyn = dyn - xp.mean(dyn)
+    if window_arrays is not None:
+        dyn = apply_window(dyn, window_arrays[0], window_arrays[1], xp)
+    dyn = dyn - xp.mean(dyn)
+
+    if prewhite:
+        if not halve:
+            raise RuntimeError("Cannot apply prewhite to full frame")
+        dyn = _prewhite_diff(dyn, xp)
+
+    simf = xp.fft.fft2(dyn, s=(nrfft, ncfft))
+    simf = (simf * xp.conj(simf)).real
+    sec = xp.fft.fftshift(simf)
+    if halve:
+        sec = sec[nrfft // 2:]
+
+    if prewhite:  # post-darken
+        fd = np.arange(-ncfft // 2, ncfft // 2)
+        td = np.arange(nrfft // 2)
+        postdark = np.outer(np.sin(np.pi / nrfft * td) ** 2,
+                            np.sin(np.pi / ncfft * fd) ** 2)
+        postdark[:, ncfft // 2] = 1
+        postdark[0, :] = 1
+        sec = sec / xp.asarray(postdark)
+    return sec
+
+
+def secondary_spectrum(dyn, dt, df, window="hanning", window_frac=0.1,
+                       prewhite=False, halve=True, dlam=None, db=True,
+                       backend=None):
+    """Full sspec pipeline → (fdop [mHz], yaxis, sec[dB]).
+
+    yaxis is beta [m^-1] when ``dlam`` is given (wavelength-rescaled
+    input), else tdel [us].
+    """
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    nf, nt = np.shape(dyn)
+    wins = None
+    if window is not None:
+        wins = get_window(nt, nf, window=window, frac=window_frac)
+    sec = secondary_spectrum_power(dyn, window_arrays=wins,
+                                   prewhite=prewhite, halve=halve,
+                                   backend=backend)
+    if db:
+        with np.errstate(divide="ignore"):
+            sec = 10 * xp.log10(sec)
+    fdop, tdel, beta = sspec_axes(nf, nt, dt, df, halve=halve, dlam=dlam)
+    yaxis = beta if dlam is not None else tdel
+    return fdop, yaxis, sec

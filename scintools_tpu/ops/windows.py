@@ -1,0 +1,53 @@
+"""Edge-taper windows for spectral analysis.
+
+Reproduces the semantics of the reference ``get_window``
+(/root/reference/scintools/scint_utils.py:810-832): a window of
+``floor(frac*n)`` points is split at its midpoint and the two halves are
+placed at the array edges with ones in between, so only the outer
+``frac`` fraction of pixels is tapered.
+
+Windows are built host-side in numpy (cheap, one-time) and fed to the
+device kernels as constants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_WINDOW_FUNCS = {
+    "hanning": np.hanning,
+    "hamming": np.hamming,
+    "blackman": np.blackman,
+    "bartlett": np.bartlett,
+}
+
+
+def edge_taper(n, window="hanning", frac=0.1):
+    """1-D edge-taper window of length ``n``.
+
+    Matches ``np.insert(w, ceil(len(w)/2), ones(n-len(w)))`` of the
+    reference: the first ceil(m/2) window samples, then ones, then the
+    remaining floor(m/2) samples.
+    """
+    if window is None:
+        return np.ones(n)
+    try:
+        wfunc = _WINDOW_FUNCS[window.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Window {window!r} unknown; options: {sorted(_WINDOW_FUNCS)}"
+        )
+    m = int(np.floor(frac * n))
+    w = wfunc(m)
+    return np.insert(w, int(np.ceil(len(w) / 2)), np.ones(n - len(w)))
+
+
+def get_window(nt, nf, window="hanning", frac=0.1):
+    """(chan_window[nt], subint_window[nf]) pair, reference-compatible."""
+    return edge_taper(nt, window, frac), edge_taper(nf, window, frac)
+
+
+def apply_window(dyn, chan_window, subint_window, xp=np):
+    """Apply time (last-axis) and frequency (first-axis) tapers to
+    ``dyn[..., nf, nt]``."""
+    return dyn * xp.asarray(chan_window) * xp.asarray(subint_window)[..., :, None]

@@ -1,0 +1,110 @@
+"""psrflux / par-file I/O tests, incl. golden checks on the bundled
+reference observation files when available."""
+
+import os
+import glob
+
+import numpy as np
+import pytest
+
+from scintools_tpu.io.psrflux import (load_psrflux, write_psrflux,
+                                      RawDynSpec, concatenate_time)
+from scintools_tpu.io.parfile import read_par, pars_to_params
+
+REF_DATA = "/root/reference/scintools/examples/data/J0437-4715"
+
+
+def make_synthetic(tmp_path, nsub=10, nchan=8, descending=True):
+    path = os.path.join(tmp_path, "synth.dynspec")
+    rng = np.random.default_rng(0)
+    flux = rng.random((nsub, nchan))
+    freqs = (np.linspace(1500, 1400, nchan) if descending
+             else np.linspace(1400, 1500, nchan))
+    with open(path, "w") as fh:
+        fh.write("# test file\n# MJD0: 58000.5\n")
+        fh.write("# isub ichan time(min) freq(MHz) flux flux_err\n")
+        for i in range(nsub):
+            for j in range(nchan):
+                fh.write(f"{i} {j} {i * 0.5} {freqs[j]} {flux[i, j]} 0\n")
+    return path, flux, freqs
+
+
+class TestPsrflux:
+    def test_load_synthetic(self, tmp_path):
+        path, flux, freqs = make_synthetic(str(tmp_path))
+        ds = load_psrflux(path)
+        assert ds.nchan == 8 and ds.nsub == 10
+        # frequency ascending after flip
+        assert np.all(np.diff(ds.freqs) > 0)
+        # dyn[chan, sub] with ascending freq = flipped transpose of flux
+        np.testing.assert_allclose(ds.dyn, np.flip(flux.T, axis=0))
+        assert ds.mjd == pytest.approx(58000.5)
+        assert ds.dt == pytest.approx(30.0)
+
+    def test_round_trip(self, tmp_path):
+        path, _, _ = make_synthetic(str(tmp_path), descending=False)
+        ds = load_psrflux(path)
+        out = os.path.join(str(tmp_path), "out.dynspec")
+        write_psrflux(ds, out)
+        ds2 = load_psrflux(out)
+        np.testing.assert_allclose(ds2.dyn, ds.dyn, rtol=1e-12)
+        np.testing.assert_allclose(ds2.freqs, ds.freqs)
+        assert ds2.mjd == pytest.approx(ds.mjd)
+
+    @pytest.mark.skipif(not os.path.isdir(REF_DATA),
+                        reason="reference data not present")
+    def test_golden_j0437(self):
+        f = sorted(glob.glob(os.path.join(REF_DATA, "*.dynspec")))[0]
+        ds = load_psrflux(f)
+        # header facts from the psrflux file itself
+        assert ds.mjd > 55915.0
+        assert ds.dyn.shape == (ds.nchan, ds.nsub)
+        assert np.all(np.diff(ds.freqs) > 0)
+        assert ds.bw > 0 and ds.df > 0
+        assert np.isfinite(ds.dyn).all()
+
+    def test_concatenate_time(self, tmp_path):
+        path, _, _ = make_synthetic(str(tmp_path))
+        ds1 = load_psrflux(path)
+        ds2 = ds1.copy()
+        ds2.mjd = ds1.mjd + (ds1.tobs + 120.0) / 86400  # 2 min gap
+        cat = concatenate_time(ds1, ds2)
+        assert cat.nsub > ds1.nsub + ds2.nsub  # gap was zero-filled
+        assert cat.dyn.shape[0] == ds1.nchan
+        np.testing.assert_allclose(cat.dyn[:, :ds1.nsub], ds1.dyn)
+        np.testing.assert_allclose(cat.dyn[:, -ds2.nsub:], ds2.dyn)
+
+
+class TestParfile:
+    def test_read_par(self, tmp_path):
+        p = tmp_path / "test.par"
+        p.write_text(
+            "PSRJ           J0437-4715\n"
+            "RAJ            04:37:15.99744 1 0.00001\n"
+            "DECJ           -47:15:09.7170 1 0.0001\n"
+            "F0             173.6879458121843 1 1e-12\n"
+            "PB             5.7410459 1 0.000002\n"
+            "A1             3.36669157 1 0.00000014\n"
+            "E              1.9180e-05 1 0.0000002\n"
+            "T0             50000.0\n"
+            "OM             1.20 1 0.05\n"
+            "NTOA           1000\n"
+            "# a comment\n")
+        par = read_par(str(p))
+        assert par["PSRJ"] == "J0437-4715"
+        assert par["F0"] == pytest.approx(173.6879458121843)
+        assert par["ECC"] == pytest.approx(1.918e-05)  # E renamed to ECC
+        assert par["ECC_TYPE"] == "e"
+        assert par["PB_ERR"] == pytest.approx(2e-6)
+        assert "NTOA" not in par  # ignored
+
+    def test_pars_to_params(self, tmp_path):
+        p = tmp_path / "t.par"
+        p.write_text("RAJ 04:37:15.9\nDECJ -47:15:09.7\nPB 5.741\nS 0.7\n")
+        par = read_par(str(p))
+        params = pars_to_params(par)
+        # RAJ in radians: 4h37m ~ 1.21 rad
+        assert 1.1 < params["RAJ"].value < 1.3
+        assert params["DECJ"].value < 0
+        assert params["PB"].value == pytest.approx(5.741)
+        assert not params["PB"].vary
