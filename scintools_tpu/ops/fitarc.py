@@ -1,0 +1,216 @@
+"""Scintillation-arc curvature measurement (Hough-style η grid search).
+
+Re-design of ``Dynspec.fit_arc`` (/root/reference/scintools/
+dynspec.py:970-1346): normalise the secondary spectrum for a trial
+curvature, delay-scrunch to a Doppler profile, and fit a parabola to
+the profile peak over a √η grid. The batched row interpolation (the
+hot part) lives in :mod:`normsspec`; the peak search and parabola fit
+are cheap 1-D host work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+from scipy.signal import savgol_filter
+
+from .normsspec import normalise_sspec
+from ..fit.models import fit_parabola, fit_log_parabola
+
+
+@dataclass
+class ArcFit:
+    """Result of a single arc-curvature fit."""
+
+    eta: float
+    etaerr: float          # noise-based error (or parabola error)
+    etaerr2: float         # parabola-fit error
+    eta_array: np.ndarray  # η grid searched
+    profile: np.ndarray    # delay-scrunched power profile over η grid
+    norm_fdop: np.ndarray  # normalised fdop axis of the profile
+    noise: float
+    prob_eta_peak: np.ndarray = None
+    yfit: np.ndarray = None
+    xdata: np.ndarray = None
+
+
+def sspec_noise(sspec, cutmid, n_rows):
+    """Noise estimate from the outer quadrants of the secondary
+    spectrum (dynspec.py:1091-1109)."""
+    nr, nc = np.shape(sspec)
+    a = np.asarray(sspec)[int(nr / 2):,
+                          int(nc / 2 + np.ceil(cutmid / 2)):].ravel()
+    b = np.asarray(sspec)[int(nr / 2):,
+                          0:int(nc / 2 - np.floor(cutmid / 2))].ravel()
+    noise = np.std(np.concatenate((a, b)))
+    return noise / np.sqrt(n_rows * 2)
+
+
+def _profile_from_norm(ns, asymm=False):
+    """Fold the scrunched profile about fdop=0 (dynspec.py:1166-1180)."""
+    prof = np.asarray(ns.normsspecavg).squeeze()
+    fdopnew = np.asarray(ns.fdop).squeeze()
+    pos = fdopnew >= 0
+    neg = fdopnew < 0
+    p_pos = prof[pos]
+    p_neg = np.flip(prof[neg])
+    etafrac = 1.0 / fdopnew[pos]
+    if asymm:
+        return [p_pos, p_neg], etafrac
+    return [(p_pos + p_neg) / 2], etafrac
+
+
+def fit_arc_profile(spec, etafrac, etamin, etamax, constraint=(0, np.inf),
+                    nsmooth=5, low_power_diff=-1, high_power_diff=-0.5,
+                    noise=0.0, noise_error=True, log_parabola=False,
+                    efac=1):
+    """Peak search + parabola fit on one folded profile
+    (dynspec.py:1182-1282)."""
+    spec = np.asarray(spec).squeeze()
+    etafrac = np.asarray(etafrac).squeeze()
+
+    valid = np.isfinite(spec)
+    spec = np.flip(spec[valid])
+    etafrac = np.flip(etafrac[valid])
+
+    eta_array = etamin * etafrac ** 2
+    sel = eta_array < etamax
+    eta_array = eta_array[sel]
+    spec = spec[sel]
+
+    if len(spec) <= nsmooth:
+        raise ValueError(
+            f"profile has only {len(spec)} valid points — too few for "
+            f"smoothing window nsmooth={nsmooth}")
+    smoothed = savgol_filter(spec, nsmooth, 1)
+
+    inrange = np.flatnonzero((eta_array > constraint[0])
+                             & (eta_array < constraint[1]))
+    if len(inrange) == 0:
+        raise ValueError("no η grid points inside constraint range")
+    max_in = np.max(smoothed[inrange])
+    ind = int(np.argmin(np.abs(smoothed - max_in)))
+
+    max_power = smoothed[ind]
+    power = max_power
+    i1 = 1
+    while (power > max_power + low_power_diff
+           and ind - i1 > 0):
+        i1 += 1
+        power = smoothed[ind - i1]
+    power = max_power
+    i2 = 1
+    while (power > max_power + high_power_diff
+           and ind + i2 < len(smoothed) - 1):
+        i2 += 1
+        power = smoothed[ind + i2]
+
+    xdata = eta_array[int(ind - i1):int(ind + i2)]
+    ydata = spec[int(ind - i1):int(ind + i2)]
+    if log_parabola:
+        yfit, eta, etaerr = fit_log_parabola(xdata, ydata)
+    else:
+        yfit, eta, etaerr = fit_parabola(xdata, ydata)
+    if np.mean(np.gradient(np.diff(yfit))) > 0:
+        raise ValueError("Fit returned a forward parabola.")
+
+    etaerr2 = etaerr
+    if noise_error:
+        power = max_power
+        i1 = 1
+        while power > (max_power - noise) and (ind - i1 > 1):
+            power = smoothed[ind - i1]
+            i1 += 1
+        power = max_power
+        i2 = 1
+        while (power > (max_power - noise)
+               and (ind + i2 < len(smoothed) - 1)):
+            i2 += 1
+            power = smoothed[ind + i2]
+        etaerr = np.abs(eta_array[int(ind - i1)]
+                        - eta_array[int(ind + i2)]) / 2
+
+    sigma = noise * efac
+    with np.errstate(divide="ignore", invalid="ignore"):
+        prob = (1 / (sigma * np.sqrt(2 * np.pi))
+                * np.exp(-0.5 * ((spec - np.max(spec)) / sigma) ** 2))
+
+    return ArcFit(eta=float(eta), etaerr=float(etaerr),
+                  etaerr2=float(etaerr2), eta_array=eta_array,
+                  profile=spec, norm_fdop=None, noise=noise,
+                  prob_eta_peak=prob, yfit=yfit, xdata=xdata)
+
+
+def fit_arc(sspec, yaxis, fdop, asymm=False, delmax=None, numsteps=1e4,
+            startbin=3, cutmid=3, etamax=None, etamin=None,
+            low_power_diff=-1, high_power_diff=-0.5,
+            constraint=(0, np.inf), nsmooth=5, efac=1, noise_error=True,
+            log_parabola=False, logsteps=False, fit_spectrum=False,
+            subtract_artefacts=False, weighted=False, backend=None):
+    """Arc-curvature measurement on a (dB) secondary spectrum.
+
+    Works in a single consistent curvature convention: ``yaxis`` is the
+    delay-like axis (β [m^-1] for λ-scaled spectra, else tdel [us]) and
+    η relates them by yaxis = η·fdop². Unit conversions between the
+    β and tdel conventions are the caller's (façade's) responsibility
+    — the reference interleaves them with the search
+    (dynspec.py:1140-1148).
+
+    Returns a list of :class:`ArcFit` (two entries when ``asymm``).
+    """
+    sspec = np.array(sspec, dtype=float)
+    yaxis = np.asarray(yaxis, dtype=float)
+    if etamin is not None and np.any(np.asarray(etamin) <= 0):
+        raise ValueError("etamin must be positive (curvature is η > 0)")
+    if etamax is not None and np.any(np.asarray(etamax) <= 0):
+        raise ValueError("etamax must be positive (curvature is η > 0)")
+    if int(numsteps) <= 2 * nsmooth:
+        raise ValueError(
+            f"numsteps={int(numsteps)} too coarse for the smoothing "
+            f"window (nsmooth={nsmooth}); increase numsteps")
+    delmax = np.max(yaxis) if delmax is None else delmax
+
+    ind = int(np.argmin(np.abs(yaxis - delmax)))
+    ymax = yaxis[ind]
+
+    noise = sspec_noise(sspec, cutmid, n_rows=ind)
+
+    if etamax is None:
+        etamax = ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    if etamin is None:
+        etamin = (yaxis[1] - yaxis[0]) * startbin / np.max(fdop) ** 2
+
+    etamin_array = np.atleast_1d(np.asarray(etamin, dtype=float))
+    etamax_array = np.atleast_1d(np.asarray(etamax, dtype=float))
+
+    sqrt_eta_all = np.linspace(np.sqrt(np.min(etamin_array)),
+                               np.sqrt(np.max(etamax_array)),
+                               int(numsteps))
+
+    fits = []
+    for iarc in range(len(etamin_array)):
+        emin = float(etamin_array[iarc])
+        emax = float(etamax_array[iarc])
+        sqrt_eta = sqrt_eta_all[(sqrt_eta_all <= np.sqrt(emax))
+                                & (sqrt_eta_all >= np.sqrt(emin))]
+        numsteps_new = len(sqrt_eta)
+
+        ns = normalise_sspec(sspec, yaxis, fdop, eta=emin, delmax=delmax,
+                             startbin=startbin, maxnormfac=1,
+                             cutmid=cutmid, numsteps=numsteps_new,
+                             logsteps=logsteps, weighted=weighted,
+                             fit_spectrum=fit_spectrum,
+                             subtract_artefacts=subtract_artefacts,
+                             backend=backend)
+        specs, etafrac = _profile_from_norm(ns, asymm=asymm)
+        for spec in specs:
+            fit = fit_arc_profile(
+                spec, etafrac, emin, emax, constraint=constraint,
+                nsmooth=nsmooth, low_power_diff=low_power_diff,
+                high_power_diff=high_power_diff, noise=noise,
+                noise_error=noise_error, log_parabola=log_parabola,
+                efac=efac)
+            fit.norm_fdop = ns.fdop
+            fits.append(fit)
+    return fits

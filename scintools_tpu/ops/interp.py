@@ -1,0 +1,36 @@
+"""Interpolation utilities: NaN infill and batched 1-D resampling.
+
+Hosts the equivalents of ``interp_nan_2d`` (/root/reference/scintools/
+scint_utils.py:769-784) and the cubic-interpolation loops used by
+``scale_dyn`` (dynspec.py:3949-3956, :4062-4074), vectorised.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.interpolate import griddata, interp1d
+
+
+def interp_nan_2d(array, method="linear"):
+    """Fill NaNs of a 2-D array by interpolation from valid neighbours
+    (scint_utils.py:769-784)."""
+    array = np.array(array, dtype=float).squeeze()
+    x = np.arange(array.shape[1])
+    y = np.arange(array.shape[0])
+    marr = np.ma.masked_invalid(array)
+    xx, yy = np.meshgrid(x, y)
+    x1 = xx[~marr.mask]
+    y1 = yy[~marr.mask]
+    newarr = np.ravel(array[~marr.mask])
+    return griddata((x1, y1), newarr, (xx, yy), method=method)
+
+
+def columnwise_cubic_interp(arr, x_src, x_new, axis=0):
+    """Cubic interpolation of each 1-D slice of ``arr`` along ``axis``
+    from coordinates x_src onto x_new (the reference's per-column
+    interp1d loop, vectorised via scipy's axis support)."""
+    f = interp1d(x_src, arr, kind="cubic", axis=axis)
+    x_new = np.clip(x_new, np.min(x_src), np.max(x_src))
+    return f(x_new)
+
+

@@ -1,0 +1,195 @@
+"""Arc-normalised secondary spectrum (the η-search workhorse).
+
+Re-design of ``Dynspec.norm_sspec`` (/root/reference/scintools/
+dynspec.py:1920-2281). The reference loops over delay rows in python,
+renormalising each row's Doppler axis by the arc (fdop/√(tdel/η)) and
+interpolating onto a common grid. Here that is one batched linear
+interpolation: row i is sampled at fdopnew·√(tdel_i/η) — vmappable and
+static-shaped, so the whole η grid search becomes a single device
+kernel (north-star kernel #3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..backend import get_xp, resolve_backend, get_jax
+
+
+@dataclass
+class NormSspec:
+    """Result record for a normalised secondary spectrum."""
+
+    normsspecavg: np.ndarray     # delay-scrunched Doppler profile
+    normsspec: np.ndarray        # (ntdel, nfdop) normalised spectrum
+    mask: np.ndarray             # True where outside data support / NaN
+    tdel: np.ndarray             # delay axis used (cropped)
+    fdop: np.ndarray             # normalised fdop axis
+    powerspectrum: np.ndarray    # masked mean linear power per delay row
+    weights: np.ndarray          # per-row weights used for the average
+    ps_wn: float = None
+    ps_amp: float = None
+    ps_alpha: float = None
+    ps_wn_err: float = None
+    ps_amp_err: float = None
+    ps_alpha_err: float = None
+
+
+def _interp_rows_np(sspec, x_src, xq):
+    """Rows sspec[i] sampled at xq[i] over source axis x_src (numpy)."""
+    out = np.empty((sspec.shape[0], xq.shape[1]))
+    for i in range(sspec.shape[0]):
+        out[i] = np.interp(xq[i], x_src, sspec[i])
+    return out
+
+
+def scaled_row_interp(sspec, fdop, tdel, eta, fdopnew, backend=None):
+    """Sample each delay row at original Doppler fdopnew·√(tdel_i/η).
+
+    Returns (norm[ntdel, nq], mask[ntdel, nq]); mask marks points
+    outside each row's renormalised data support (|fdopnew| beyond the
+    largest available normalised Doppler for that row) or NaN output.
+    """
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    sspec = xp.asarray(sspec)
+    scale = xp.sqrt(xp.asarray(tdel) / eta)  # (ntdel,)
+    xq = xp.asarray(fdopnew)[None, :] * scale[:, None]
+    fmax = float(np.max(np.abs(fdop)))
+    if backend == "jax":
+        jax = get_jax()
+        # NaN-aware linear interpolation: NaNs propagate only locally
+        norm = jax.vmap(lambda q, row: xp.interp(q, xp.asarray(fdop), row)
+                        )(xq, sspec)
+    else:
+        norm = _interp_rows_np(np.asarray(sspec), np.asarray(fdop),
+                               np.asarray(xq))
+    # support mask: reference masks |fdopnew| > max(|selected fdop|)/scale
+    sup = xp.abs(xp.asarray(fdopnew))[None, :] * scale[:, None] > fmax
+    mask = sup | xp.isnan(norm)
+    return norm, mask
+
+
+def normalise_sspec(sspec, tdel, fdop, eta, delmax=None, startbin=1,
+                    maxnormfac=5, minnormfac=0, cutmid=0, numsteps=None,
+                    logsteps=False, weighted=True, interp_nan=False,
+                    fit_spectrum=False, powerspec_cut=False,
+                    subtract_artefacts=False, backend=None):
+    """Full norm_sspec pipeline on a (dB) secondary spectrum.
+
+    sspec[ntdel, nfdop] in dB with delay axis ``tdel`` (us or m^-1) and
+    Doppler axis ``fdop`` (mHz); ``eta`` in the matching curvature
+    convention. Returns :class:`NormSspec`.
+    """
+    backend = resolve_backend(backend)
+    sspec = np.array(sspec, dtype=float)
+    tdel_full = np.asarray(tdel, dtype=float)
+    fdop = np.asarray(fdop, dtype=float)
+
+    delmax = np.max(tdel_full) if delmax is None else delmax
+    ind = int(np.argmin(np.abs(tdel_full - delmax)))
+    sspec = sspec[startbin:ind, :]
+    tdel_c = tdel_full[startbin:ind]
+    nr, nc = sspec.shape
+    if cutmid > 0:
+        sspec[:, int(nc / 2 - np.floor(cutmid / 2)):
+              int(nc / 2 + np.floor(cutmid / 2))] = np.nan
+
+    if subtract_artefacts:
+        # delay response estimated from outer 10% in Doppler
+        outer = np.abs(fdop) > 0.9 * np.max(fdop)
+        delay_response = np.nanmean(sspec[:, outer], axis=1)
+        delay_response = delay_response - np.median(delay_response)
+        sspec = sspec - delay_response[:, None]
+
+    maxfdop = maxnormfac * np.sqrt(tdel_c[-1] / eta)
+    maxfdop = min(maxfdop, np.max(fdop))
+    nfdop = (2 * np.sum(np.abs(fdop) <= maxfdop) if numsteps is None
+             else int(numsteps))
+    if nfdop % 2 != 0:
+        nfdop += 1
+
+    if logsteps:
+        fdoplin = np.abs(np.linspace(-maxnormfac, maxnormfac, int(nfdop)))
+        fdop_pos = 10 ** np.linspace(np.log10(np.min(fdoplin)),
+                                     np.log10(np.max(fdoplin)),
+                                     int(nfdop / 2))
+        fdopnew = np.concatenate((-np.flip(fdop_pos), fdop_pos))
+    else:
+        fdopnew = np.linspace(-maxnormfac, maxnormfac, nfdop)
+    if minnormfac > 0:
+        fdopnew = fdopnew[np.abs(fdopnew) > minnormfac]
+
+    norm, mask = scaled_row_interp(sspec, fdop, tdel_c, eta, fdopnew,
+                                   backend=backend)
+    norm = np.asarray(norm)
+    mask = np.asarray(mask)
+
+    if interp_nan:
+        from ..ops.interp import interp_nan_2d
+        norm = interp_nan_2d(norm)
+        mask = mask & ~np.isfinite(norm) | (np.abs(fdopnew)[None, :]
+                                            * np.sqrt(tdel_c / eta)[:, None]
+                                            > np.max(np.abs(fdop)))
+
+    mnorm = np.ma.array(norm, mask=mask)
+    if logsteps:
+        # the reference computes the delay power spectrum from a
+        # parallel *linear*-grid interpolation (dynspec.py:2088-2127) so
+        # log-spaced oversampling of the arc core doesn't bias it
+        # (reference samples |linspace|, i.e. the positive side twice)
+        fdoplin = np.abs(np.linspace(-maxnormfac, maxnormfac, int(nfdop)))
+        nlin, mlin = scaled_row_interp(sspec, fdop, tdel_c, eta, fdoplin,
+                                       backend=backend)
+        mlin_arr = np.ma.array(np.asarray(nlin), mask=np.asarray(mlin))
+        powerspectrum = np.asarray(np.ma.mean(10 ** (mlin_arr / 10),
+                                              axis=1))
+    else:
+        powerspectrum = np.asarray(np.ma.mean(10 ** (mnorm / 10), axis=1))
+
+    # arc power-spectrum model: wn + amp·x^alpha over x=√tdel
+    xdata = np.sqrt(tdel_c)
+    ydata = xdata * powerspectrum
+    valid = np.isfinite(xdata) & np.isfinite(ydata)
+    xdata, ydata = xdata[valid], ydata[valid]
+    alpha = -11 / 3
+    index = int(np.argmin(np.abs(xdata - 10)))
+    amp = ydata[index] * xdata[index] ** -alpha
+    wn = np.min(ydata)
+    ps = {}
+    if fit_spectrum:
+        from ..fit.parameters import Parameters
+        from ..fit.fitter import fitter
+        from ..fit.models import powerspectrum_model
+
+        params = Parameters()
+        params.add("wn", value=wn, vary=True, min=np.min(ydata), max=np.inf)
+        params.add("alpha", value=alpha, vary=True, min=-np.inf, max=0)
+        params.add("amp", value=amp, vary=True, min=0.0, max=np.inf)
+        results = fitter(powerspectrum_model, params, (xdata, ydata))
+        wn = results.params["wn"].value
+        amp = results.params["amp"].value
+        alpha = results.params["alpha"].value
+        ps = dict(ps_wn=wn, ps_amp=amp, ps_alpha=alpha,
+                  ps_wn_err=results.params["wn"].stderr,
+                  ps_amp_err=results.params["amp"].stderr,
+                  ps_alpha_err=results.params["alpha"].stderr)
+
+    arc_spectrum = amp * xdata ** alpha
+    if weighted:
+        weights = 10 * np.log10(arc_spectrum)
+    else:
+        weights = np.ones(np.shape(arc_spectrum))
+
+    if powerspec_cut:
+        sel = (arc_spectrum > wn)
+        avg = np.ma.average(mnorm[sel, :], axis=0, weights=weights[sel])
+    else:
+        avg = np.ma.average(mnorm, axis=0, weights=weights)
+    avg = np.asarray(avg)
+
+    return NormSspec(normsspecavg=avg, normsspec=norm, mask=mask,
+                     tdel=tdel_c, fdop=fdopnew,
+                     powerspectrum=powerspectrum, weights=weights, **ps)
