@@ -136,8 +136,11 @@ def fit_arc_profile(spec, etafrac, etamin, etamax, constraint=(0, np.inf),
         prob = (1 / (sigma * np.sqrt(2 * np.pi))
                 * np.exp(-0.5 * ((spec - np.max(spec)) / sigma) ** 2))
 
-    return ArcFit(eta=float(eta), etaerr=float(etaerr),
-                  etaerr2=float(etaerr2), eta_array=eta_array,
+    # the reference stores every curvature error divided by sqrt(2)
+    # (dynspec.py:1288-1311)
+    return ArcFit(eta=float(eta), etaerr=float(etaerr) / np.sqrt(2),
+                  etaerr2=float(etaerr2) / np.sqrt(2),
+                  eta_array=eta_array,
                   profile=spec, norm_fdop=None, noise=noise,
                   prob_eta_peak=prob, yfit=yfit, xdata=xdata)
 

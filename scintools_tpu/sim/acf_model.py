@@ -90,7 +90,7 @@ def _gammitv_block(snx, sny, snp, gammes, snp2, gammes2, dnun, dsp,
             sx, sy = shifted(idn)
             cols.append(_fresnel_row(gammes, snp, sx, sy, dnun[idn],
                                      dsp / res_fac, xp))
-    return xp.stack(cols, axis=1) if xp is not np else np.stack(cols, axis=1)
+    return xp.stack(cols, axis=1)
 
 
 class ACF:

@@ -1,0 +1,439 @@
+"""θ-θ transform core: forward/inverse maps and eigenvalue curvature
+metric.
+
+Re-design of /root/reference/scintools/ththmod.py (Baker's θ-θ code).
+Canonical units throughout (no astropy dependency): tau in µs, fd in
+mHz, eta in s³ (numerically µs/mHz²), edges in mHz. ``unit_checks``
+coerces astropy Quantities if a caller passes them.
+
+TPU-first design notes:
+
+- ``thth_map`` is a pure gather with static shapes → vmaps over η.
+- The reference crops the θ-θ matrix to the largest filled square
+  (``thth_redmap``), whose size depends on η — a data-dependent shape
+  that would defeat vmap/jit. The batched search instead *masks* the
+  full matrix (zeroing rows/columns outside the valid square): zeroed
+  rows/cols only add null eigenvalues, so the dominant eigenvalue is
+  unchanged (ththmod.py:119-173 ↔ eigenvalue equivalence).
+- The dominant eigenvalue uses a Gershgorin-shifted power iteration
+  (``lax``-friendly, fixed iteration count) so the whole η grid is one
+  jitted kernel; the numpy path uses scipy ``eigsh`` with the
+  reference's seeded v0 (ththmod.py:398-400).
+- ``rev_map``'s histogram scatter becomes ``.at[].add`` on jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_xp, resolve_backend, get_jax
+
+
+def unit_checks(var, name=None, desired=None):
+    """Coerce to a plain float/ndarray in canonical units. Accepts
+    astropy Quantities when astropy is installed (API parity with
+    ththmod.py:1639-1668); plain numbers are assumed canonical."""
+    if hasattr(var, "to_value") and desired is not None:
+        try:
+            return np.asarray(var.to_value(desired))
+        except Exception:
+            return np.asarray(getattr(var, "value", var))
+    if hasattr(var, "value") and not isinstance(var, (int, float, complex,
+                                                      np.ndarray)):
+        return np.asarray(var.value)
+    return var
+
+
+def fft_axis(x, pad=0, scale=1.0):
+    """Fourier-conjugate coordinates of a uniform axis ``x`` with
+    ``pad`` extra copies of padding (ththmod.py:473-493).
+
+    ``scale`` converts units: time[s] → fd[mHz] uses scale=1e3;
+    freq[MHz] → tau[us] uses scale=1.0 (1/MHz = us).
+    """
+    x = np.asarray(x, dtype=float)
+    return np.fft.fftshift(
+        np.fft.fftfreq((pad + 1) * x.shape[0], x[1] - x[0])) * scale
+
+
+def th_cents_from_edges(edges):
+    """Bin centres, re-centred on the bin nearest zero
+    (ththmod.py:83-84)."""
+    edges = np.asarray(edges, dtype=float)
+    cents = (edges[1:] + edges[:-1]) / 2
+    return cents - cents[np.argmin(np.abs(cents))]
+
+
+def thth_map(CS, tau, fd, eta, edges, hermetian=True, backend=None):
+    """Conjugate spectrum → θ-θ matrix (gather; ththmod.py:56-116)."""
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    eta = float(unit_checks(eta, "eta"))
+    th_cents = th_cents_from_edges(unit_checks(edges, "edges"))
+
+    th1 = th_cents[None, :] * np.ones((len(th_cents), 1))
+    th2 = th1.T
+    dtau = np.diff(tau).mean()
+    dfd = np.diff(fd).mean()
+
+    tau_inv = ((eta * (th1 ** 2 - th2 ** 2) - tau[0] + dtau / 2)
+               // dtau).astype(int)
+    fd_inv = (((th1 - th2) - fd[0] + dfd / 2) // dfd).astype(int)
+    pnts = ((tau_inv > 0) & (tau_inv < tau.shape[0])
+            & (fd_inv < fd.shape[0]) & (fd_inv >= -fd.shape[0]))
+
+    CS = xp.asarray(CS)
+    ti = xp.asarray(np.where(pnts, tau_inv, 0))
+    fi = xp.asarray(np.where(pnts, fd_inv, 0))
+    vals = CS[ti, fi]
+    thth = xp.where(xp.asarray(pnts), vals, 0.0 + 0.0j)
+    thth = thth * xp.asarray(np.sqrt(np.abs(2 * eta * (th2 - th1))))
+
+    if hermetian:
+        thth = thth - xp.tril(thth)
+        thth = thth + xp.conj(xp.transpose(xp.triu(thth)))
+        thth = thth - xp.diag(xp.diag(thth))
+        anti = xp.diag(xp.diag(thth[::-1, :]))[::-1, :]
+        thth = thth - anti
+        thth = xp.nan_to_num(thth)
+    return thth
+
+
+def redmap_mask(tau, fd, eta, edges):
+    """Valid-square membership for the reduced θ-θ
+    (ththmod.py:151-155), host-side."""
+    tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    eta = float(unit_checks(eta, "eta"))
+    th_cents = th_cents_from_edges(unit_checks(edges, "edges"))
+    return ((th_cents ** 2 * eta < np.abs(tau.max()))
+            & (np.abs(th_cents) < np.abs(fd.max()) / 2))
+
+
+def thth_redmap(CS, tau, fd, eta, edges, hermetian=True, backend=None):
+    """θ-θ cropped to the largest filled square + reduced edges
+    (ththmod.py:119-173)."""
+    thth = np.asarray(thth_map(CS, tau, fd, eta, edges,
+                               hermetian=hermetian, backend=backend))
+    th_pnts = redmap_mask(tau, fd, eta, edges)
+    th_cents = th_cents_from_edges(unit_checks(edges, "edges"))
+    thth_red = thth[th_pnts, :][:, th_pnts]
+    cents_red = th_cents[th_pnts]
+    inner = (cents_red[:-1] + cents_red[1:]) / 2
+    step = np.diff(inner).mean()
+    edges_red = np.concatenate(([inner[0] - step], inner,
+                                [inner[-1] + step]))
+    return thth_red, edges_red
+
+
+def rev_map(thth, tau, fd, eta, edges, hermetian=True, backend=None):
+    """θ-θ → conjugate spectrum via weighted histogram scatter
+    (ththmod.py:176-271). Returns CS[tau, fd]."""
+    backend = resolve_backend(backend)
+    tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    eta = float(unit_checks(eta, "eta"))
+    th_cents = th_cents_from_edges(unit_checks(edges, "edges"))
+
+    fd_map = th_cents[None, :] - th_cents[:, None]
+    tau_map = eta * (th_cents[None, :] ** 2 - th_cents[:, None] ** 2)
+    dfd = fd[1] - fd[0]
+    dtau = tau[1] - tau[0]
+    nfd, ntau = fd.shape[0], tau.shape[0]
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        w = np.asarray(thth) / np.sqrt(np.abs(2 * eta * fd_map.T))
+
+    def scatter(fm, tm, weights, xp):
+        ix = np.floor((fm - (fd[0] - dfd / 2)) / dfd).astype(int)
+        iy = np.floor((tm - (tau[0] - dtau / 2)) / dtau).astype(int)
+        ok = (ix >= 0) & (ix < nfd) & (iy >= 0) & (iy < ntau)
+        ix = np.where(ok, ix, 0).ravel()
+        iy = np.where(ok, iy, 0).ravel()
+        wv = np.where(ok, weights, 0).ravel()
+        cnt = np.asarray(ok, dtype=float).ravel()
+        if xp is np:
+            # non-finite weights (θ1==θ2 Jacobian singularity) poison
+            # their bin, which nan_to_num zeroes at the end — same
+            # net behaviour as the reference's histogram2d
+            acc = np.zeros((nfd, ntau), dtype=complex)
+            with np.errstate(invalid="ignore"):
+                np.add.at(acc, (ix, iy), wv)
+            norm = np.zeros((nfd, ntau))
+            np.add.at(norm, (ix, iy), cnt)
+        else:
+            acc = xp.zeros((nfd, ntau), dtype=xp.asarray(wv).dtype)
+            acc = acc.at[xp.asarray(ix), xp.asarray(iy)].add(
+                xp.asarray(wv))
+            norm = xp.zeros((nfd, ntau))
+            norm = norm.at[xp.asarray(ix), xp.asarray(iy)].add(
+                xp.asarray(cnt))
+        return acc, norm
+
+    xp = get_xp(backend)
+    recov, norm = scatter(fd_map, tau_map, w, xp)
+    if hermetian:
+        r2, n2 = scatter(-fd_map, -tau_map, np.conj(w), xp)
+        recov = recov + r2
+        norm = norm + n2
+    with np.errstate(divide="ignore", invalid="ignore"):
+        recov = recov / norm
+    recov = xp.nan_to_num(recov)
+    return xp.transpose(recov)
+
+
+def _dominant_eig_numpy(thth_red, v0_seed=True):
+    """scipy eigsh largest-algebraic with the reference's middle-row
+    seed (ththmod.py:396-401)."""
+    from scipy.sparse.linalg import eigsh
+
+    kwargs = {}
+    if v0_seed:
+        v0 = np.copy(thth_red[thth_red.shape[0] // 2, :])
+        nrm = np.sqrt((np.abs(v0) ** 2).sum())
+        if nrm > 0:
+            kwargs["v0"] = v0 / nrm
+    w, V = eigsh(thth_red, 1, which="LA", **kwargs)
+    return np.abs(w[0]), V[:, 0]
+
+
+def dominant_eig_power(A, iters=200, backend=None):
+    """Gershgorin-shifted power iteration for the largest *algebraic*
+    eigenvalue of a hermitian matrix. Fixed iteration count → jittable
+    and vmappable over a batch of matrices."""
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    A = xp.asarray(A)
+    n = A.shape[0]
+    # shift so the target eigenvalue is the largest in magnitude
+    shift = xp.max(xp.sum(xp.abs(A), axis=1))
+    v = A[n // 2, :]
+    nrm = xp.sqrt(xp.sum(xp.abs(v) ** 2))
+    v = xp.where(nrm > 0, v / (nrm + 1e-30),
+                 xp.ones_like(v) / np.sqrt(n))
+
+    if backend == "jax":
+        jax = get_jax()
+
+        def body(_, v):
+            w = A @ v + shift * v
+            return w / xp.sqrt(xp.sum(xp.abs(w) ** 2) + 1e-300)
+
+        v = jax.lax.fori_loop(0, iters, body, v)
+    else:
+        for _ in range(iters):
+            w = A @ v + shift * v
+            v = w / np.sqrt(np.sum(np.abs(w) ** 2) + 1e-300)
+    lam = xp.real(xp.vdot(v, A @ v) / (xp.vdot(v, v)))
+    return lam, v
+
+
+def eval_calc(CS, tau, fd, eta, edges, backend=None):
+    """Dominant eigenvalue of the reduced θ-θ at curvature η
+    (ththmod.py:371-401)."""
+    backend = resolve_backend(backend)
+    thth_red, _ = thth_redmap(CS, tau, fd, eta, edges, backend=backend)
+    if backend == "numpy":
+        lam, _ = _dominant_eig_numpy(thth_red)
+        return lam
+    lam, _ = dominant_eig_power(thth_red, backend=backend)
+    return abs(float(lam))
+
+
+def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
+    """Batched eigenvalue-vs-η curve: one jitted vmap over the η grid
+    on jax (the reference's python loop, ththmod.py:789-799), masked
+    fixed-shape matrices instead of per-η crops."""
+    backend = resolve_backend(backend)
+    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+    if backend == "numpy":
+        out = np.empty(len(etas))
+        for i, eta in enumerate(etas):
+            try:
+                out[i] = eval_calc(CS, tau, fd, eta, edges,
+                                   backend="numpy")
+            except Exception:
+                out[i] = np.nan
+        return out
+
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    th_cents = th_cents_from_edges(edges_a)
+    n_th = len(th_cents)
+    th1 = th_cents[None, :] * np.ones((n_th, 1))
+    th2 = th1.T
+    dtau = np.diff(tau_a).mean()
+    dfd = np.diff(fd_a).mean()
+    CS_j = jnp.asarray(CS)
+    tril_mask = jnp.asarray(np.tril(np.ones((n_th, n_th))) > 0)
+    anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
+
+    def one_eta(eta):
+        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau_a[0]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = jnp.floor(((th1 - th2) - fd_a[0] + dfd / 2)
+                           / dfd).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < len(tau_a))
+                & (fd_inv < len(fd_a)) & (fd_inv >= -len(fd_a)))
+        # negative fd_inv wraps (numpy semantics, kept by the reference)
+        vals = CS_j[jnp.where(pnts, tau_inv, 0),
+                    jnp.where(pnts, fd_inv % len(fd_a), 0)]
+        thth = jnp.where(pnts, vals, 0.0)
+        thth = thth * jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
+        # hermitian symmetrisation (ththmod.py:109-114)
+        thth = jnp.where(tril_mask, 0.0, thth)
+        thth = thth + jnp.conj(thth.T)
+        thth = thth - jnp.diag(jnp.diag(thth))
+        thth = jnp.where(anti_eye, 0.0, thth)
+        thth = jnp.nan_to_num(thth)
+        # mask instead of crop: zeroed rows/cols keep the top eigenvalue
+        valid = ((jnp.asarray(th_cents) ** 2 * eta
+                  < jnp.abs(tau_a.max()))
+                 & (jnp.abs(jnp.asarray(th_cents))
+                    < jnp.abs(fd_a.max()) / 2))
+        thth = thth * valid[None, :] * valid[:, None]
+        lam, _ = dominant_eig_power(thth, iters=iters, backend="jax")
+        return jnp.abs(lam)
+
+    return np.asarray(jax.jit(jax.vmap(one_eta))(jnp.asarray(etas)))
+
+
+def modeler(CS, tau, fd, eta, edges, hermetian=True, backend=None):
+    """Rank-1 θ-θ model → CS model → dynspec model
+    (ththmod.py:274-327)."""
+    thth_red, edges_red = thth_redmap(CS, tau, fd, eta, edges,
+                                      hermetian=hermetian,
+                                      backend=backend)
+    if hermetian:
+        if resolve_backend(backend) == "numpy":
+            w, V = _dominant_eig_numpy(thth_red, v0_seed=False)
+        else:
+            lam, V = dominant_eig_power(thth_red, backend=backend)
+            w, V = abs(float(lam)), np.asarray(V)
+        thth2_red = np.outer(V, np.conj(V)) * np.abs(w)
+        extras = (w, V)
+    else:
+        U, S, W = np.linalg.svd(np.asarray(thth_red))
+        thth2_red = np.outer(U[:, 0], W[0, :]) * S[0]
+        extras = (U[:, 0], S[0], W[0, :])
+    recov = np.asarray(rev_map(thth2_red, tau, fd, eta, edges_red,
+                               hermetian=hermetian, backend=backend))
+    model = np.fft.ifft2(np.fft.ifftshift(recov))
+    if hermetian:
+        model = model.real
+    return (thth_red, thth2_red, recov, model, edges_red) + extras
+
+
+def chisq_calc(dspec, CS, tau, fd, eta, edges, N, mask=None,
+               backend=None):
+    """χ² of the rank-1 θ-θ dynspec model against data
+    (ththmod.py:330-368)."""
+    if mask is None:
+        mask = np.isfinite(dspec)
+    model = modeler(CS, tau, fd, eta, edges,
+                    backend=backend)[3][: dspec.shape[0],
+                                        : dspec.shape[1]]
+    return np.sum((model - dspec)[mask] ** 2) / N
+
+
+def two_curve_map(CS, tau, fd, eta1, edges1, eta2, edges2, backend=None):
+    """θ-θ with distinct main-arc and arclet curvatures
+    (ththmod.py:1557-1636)."""
+    tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    eta1 = float(unit_checks(eta1, "eta1"))
+    eta2 = float(unit_checks(eta2, "eta2"))
+    edges1 = np.asarray(unit_checks(edges1, "edges1"), dtype=float)
+    edges2 = np.asarray(unit_checks(edges2, "edges2"), dtype=float)
+
+    c1 = (edges1[1:] + edges1[:-1]) / 2
+    c2 = (edges2[1:] + edges2[:-1]) / 2
+    th1 = np.ones((len(c2), len(c1))) * c1
+    th2 = np.ones((len(c2), len(c1))) * c2[:, None]
+    dtau = np.diff(tau).mean()
+    dfd = np.diff(fd).mean()
+    tau_inv = ((eta1 * th1 ** 2 - eta2 * th2 ** 2 - tau[1] + dtau / 2)
+               // dtau).astype(int)
+    fd_inv = ((th1 - th2 - fd[1] + dfd / 2) // dfd).astype(int)
+    thth = np.zeros(tau_inv.shape, dtype=complex)
+    pnts = ((tau_inv > 0) & (tau_inv < tau.shape[0] - 1)
+            & (fd_inv < fd.shape[0] - 1))
+    thth[pnts] = np.asarray(CS)[tau_inv[pnts], fd_inv[pnts]]
+    thth *= np.sqrt(np.abs(2 * eta1 * th1 - 2 * eta2 * th2))
+
+    th2_max = np.sqrt(tau.max() / eta2)
+    th1_max = np.sqrt(tau.max() / eta1)
+    p1 = np.abs(c1) < th1_max
+    p2 = np.abs(c2) < th2_max
+    e1 = np.zeros(p1.sum() + 1)
+    e1[:-1] = edges1[:-1][p1]
+    e1[-1] = edges1[1:][p1].max()
+    e2 = np.zeros(p2.sum() + 1)
+    e2[:-1] = edges2[:-1][p2]
+    e2[-1] = edges2[1:][p2].max()
+    return thth[p2, :][:, p1], e1, e2
+
+
+def singularvalue_calc(CS, tau, fd, eta, edges, etaArclet, edgesArclet,
+                       centerCut, backend=None):
+    """Largest singular value of the two-curvature θ-θ with the centre
+    masked (ththmod.py:496-513)."""
+    thth_red, e1, e2 = two_curve_map(CS, tau, fd, eta, edges, etaArclet,
+                                     edgesArclet, backend=backend)
+    cents1 = (e1[1:] + e1[:-1]) / 2
+    thth_red = np.array(thth_red)
+    thth_red[:, np.abs(cents1) < float(unit_checks(centerCut))] = 0
+    return np.linalg.svd(thth_red, compute_uv=False)[0]
+
+
+def min_edges(fd_lim, fd, tau, eta, factor=2):
+    """Minimum edges array oversampling the CS everywhere
+    (ththmod.py:1671-1705)."""
+    fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    eta = float(unit_checks(eta, "eta"))
+    fd_lim = float(unit_checks(fd_lim, "fd_lim"))
+    dtau_lim = (tau[1] - tau[0]) / factor / (2 * eta * fd_lim)
+    dfd_lim = (fd[1] - fd[0]) / factor
+    npoints = int((2 * fd_lim) // min(dfd_lim, dtau_lim))
+    npoints += npoints % 2
+    return np.linspace(-fd_lim, fd_lim, npoints)
+
+
+def len_arc(x, eta):
+    """Arc length along the parabola (ththmod.py:404-417)."""
+    a = 2 * eta
+    return (a * x * np.sqrt((a * x) ** 2 + 1)
+            + np.arcsinh(a * x)) / (2.0 * a)
+
+
+def arc_edges(eta, dfd, dtau, fd_max, n):
+    """Equal-arc-length edges array (ththmod.py:420-447)."""
+    dfd = float(unit_checks(dfd))
+    dtau = float(unit_checks(dtau))
+    fd_max = float(unit_checks(fd_max))
+    eta = float(unit_checks(eta))
+    x_max = fd_max / dfd
+    eta_ul = dfd ** 2 * eta / dtau
+    l_max = len_arc(x_max, eta_ul)
+    dl = l_max / (n // 2 - 0.5)
+    x = np.zeros(int(n // 2))
+    x[0] = dl / 2
+    for i in range(x.shape[0] - 1):
+        x[i + 1] = x[i] + dl / np.sqrt(1 + (2 * eta_ul * x[i]) ** 2)
+    return np.concatenate((-x[::-1], x)) * dfd
+
+
+def ext_find(x, y):
+    """imshow extent helper (ththmod.py:450-470)."""
+    x = np.asarray(unit_checks(x), dtype=float)
+    y = np.asarray(unit_checks(y), dtype=float)
+    dx = np.diff(x).mean()
+    dy = np.diff(y).mean()
+    return [x[0] - dx / 2, x[-1] + dx / 2, y[0] - dy / 2, y[-1] + dy / 2]

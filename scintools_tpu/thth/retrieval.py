@@ -1,0 +1,323 @@
+"""Chunked phase retrieval, wavefield mosaicking and refinement.
+
+Re-design of ththmod.py:1223-1554 (chunk retrieval, mosaic) and
+:1708-2310 (rotMos/fullMos global refinements). The reference
+hand-derives gradients and Hessians over ~400 lines; here the same
+objectives are written once as pure JAX functions and differentiated
+with autodiff (SURVEY.md §2.2 'mosaic stitching').
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core import modeler, rev_map, thth_redmap, unit_checks
+from .search import chunk_conjugate_spectrum
+from ..backend import resolve_backend, get_jax
+
+
+def single_chunk_retrieval(dspec, edges, time, freq, eta, idx_t=0,
+                           idx_f=0, npad=3, tau_mask=0.0, verbose=False,
+                           backend=None):
+    """Phase retrieval on one chunk (ththmod.py:1390-1476): rank-1
+    θ-θ model → wavefield row → inverse map → ifft2. Failures return a
+    zero chunk so one bad chunk doesn't end retrieval."""
+    dspec = np.asarray(dspec)
+    CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad,
+                                           tau_mask=tau_mask)
+    try:
+        thth_red, thth2_red, recov, model, edges_red, w, V = modeler(
+            CS, tau, fd, eta, edges, backend=backend)
+        ththE = np.zeros_like(np.asarray(thth_red))
+        ththE[ththE.shape[0] // 2, :] = np.conj(V) * np.sqrt(w)
+        recov_E = np.asarray(rev_map(ththE, tau, fd, eta, edges_red,
+                                     hermetian=False, backend=backend))
+        model_E = np.fft.ifft2(np.fft.ifftshift(recov_E))[
+            : dspec.shape[0], : dspec.shape[1]]
+        model_E *= dspec.shape[0] * dspec.shape[1] / 4
+    except Exception as e:
+        if verbose:
+            print(e, flush=True)
+        model_E = np.zeros(dspec.shape, dtype=complex)
+    return model_E, idx_f, idx_t
+
+
+def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
+                         idx_f=0, npad=3, n_dish=2, tau_mask=0.0,
+                         verbose=False, backend=None):
+    """Multi-station composite θ-θ retrieval (ththmod.py:1223-1387).
+
+    dspec_list is ordered [I1, V12, ..., V1N, I2, V23, ..., IN]; the
+    composite block-hermitian θ-θ's top eigenvector yields per-dish
+    wavefields.
+    """
+    from scipy.sparse.linalg import eigsh
+
+    time = np.asarray(unit_checks(time, "time"), dtype=float)
+    freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
+    eta = float(unit_checks(eta, "eta"))
+
+    from .core import fft_axis
+    fd = fft_axis(time, pad=npad, scale=1e3)
+    tau = fft_axis(freq, pad=npad, scale=1.0)
+
+    dspec_args = (n_dish * (n_dish + 1)) / 2 - np.cumsum(
+        np.linspace(1, n_dish, n_dish))
+    from .search import pad_chunk
+
+    thth_red = []
+    edges_red = None
+    for i, ds in enumerate(dspec_list):
+        is_dspec = np.isin(i, dspec_args)
+        pad = pad_chunk(np.asarray(ds), npad,
+                        fill="mean" if is_dspec else "zero")
+        CS = np.fft.fftshift(np.fft.fft2(pad))
+        if tau_mask:
+            CS[np.abs(tau) < tau_mask] = 0
+        t_single, edges_red = thth_redmap(CS, tau, fd, eta, edges,
+                                          hermetian=is_dspec,
+                                          backend=backend)
+        thth_red.append(np.asarray(t_single))
+
+    size = thth_red[0].shape[0]
+    comp = np.zeros((size * n_dish, size * n_dish), dtype=complex)
+    for d1 in range(n_dish):
+        for d2 in range(n_dish - d1):
+            idx = int(((n_dish * (n_dish + 1)) // 2)
+                      - (((n_dish - d1) * (n_dish - d1 + 1)) // 2) + d2)
+            comp[d1 * size:(d1 + 1) * size,
+                 (d1 + d2) * size:(d1 + d2 + 1) * size] = \
+                np.conj(thth_red[idx].T)
+            comp[(d1 + d2) * size:(d1 + d2 + 1) * size,
+                 d1 * size:(d1 + 1) * size] = thth_red[idx]
+
+    w, V = eigsh(comp, 1, which="LA")
+    w = w[0]
+    V = V[:, 0]
+    model_E = []
+    for d in range(n_dish):
+        ththE = np.zeros((size, size), dtype=complex)
+        ththE[size // 2, :] = np.conj(V[d * size:(d + 1) * size]) * np.sqrt(w)
+        recov_E = np.asarray(rev_map(ththE, tau, fd, eta, edges_red,
+                                     hermetian=False, backend=backend))
+        mE = np.fft.ifft2(np.fft.ifftshift(recov_E))[
+            : dspec_list[0].shape[0], : dspec_list[0].shape[1]]
+        mE *= dspec_list[0].shape[0] * dspec_list[0].shape[1] / 4
+        model_E.append(mE)
+    return model_E, idx_f, idx_t
+
+
+# --------------------------------------------------------------------------
+# Mosaic stitching
+# --------------------------------------------------------------------------
+
+def mask_func(w):
+    """sin² overlap ramp (ththmod.py:1479-1489)."""
+    x = np.linspace(0, w - 1, w)
+    return np.sin((np.pi / 2) * x / w) ** 2
+
+
+def chunk_mask(cf, ct, ncf, nct, cwf, cwt):
+    """Overlap-add weight mask for chunk (cf, ct)
+    (ththmod.py:1525-1544)."""
+    mask = np.ones((cwf, cwt))
+    if cf > 0:
+        mask[: cwf // 2, :] *= mask_func(cwf // 2)[:, None]
+    if cf < ncf - 1:
+        mask[cwf // 2:, :] *= 1 - mask_func(cwf // 2)[:, None]
+    if ct > 0:
+        mask[:, : cwt // 2] *= mask_func(cwt // 2)
+    if ct < nct - 1:
+        mask[:, cwt // 2:] *= 1 - mask_func(cwt // 2)
+    return mask
+
+
+def mosaic_shape(ncf, nct, cwf, cwt):
+    return ((ncf - 1) * (cwf // 2) + cwf, (nct - 1) * (cwt // 2) + cwt)
+
+
+def mosaic(chunks):
+    """Greedy phase-aligned overlap-add of half-overlapping wavefield
+    chunks (ththmod.py:1492-1554)."""
+    chunks = np.asarray(chunks)
+    ncf, nct, cwf, cwt = chunks.shape
+    E = np.zeros(mosaic_shape(ncf, nct, cwf, cwt), dtype=complex)
+    for cf in range(ncf):
+        for ct in range(nct):
+            new = chunks[cf, ct]
+            old = E[cf * cwf // 2: cf * cwf // 2 + cwf,
+                    ct * cwt // 2: ct * cwt // 2 + cwt]
+            mask = chunk_mask(cf, ct, ncf, nct, cwf, cwt)
+            rot = np.angle((old * np.conj(new) * mask).mean())
+            E[cf * cwf // 2: cf * cwf // 2 + cwf,
+              ct * cwt // 2: ct * cwt // 2 + cwt] += \
+                new * mask * np.exp(1j * rot)
+    return E
+
+
+def _masks_array(ncf, nct, cwf, cwt):
+    return np.array([[chunk_mask(cf, ct, ncf, nct, cwf, cwt)
+                      for ct in range(nct)] for cf in range(ncf)])
+
+
+def rot_mos(chunks, x):
+    """Stack with explicit per-chunk phases (ththmod.py:1708-1770).
+    x[k] is the phase of chunk k (flattened, first chunk fixed at 0)."""
+    chunks = np.asarray(chunks)
+    ncf, nct, cwf, cwt = chunks.shape
+    E = np.zeros(mosaic_shape(ncf, nct, cwf, cwt), dtype=complex)
+    masks = _masks_array(ncf, nct, cwf, cwt)
+    for cf in range(ncf):
+        for ct in range(nct):
+            rot = 0.0 if (cf == 0 and ct == 0) else x[nct * cf + ct - 1]
+            E[cf * cwf // 2: cf * cwf // 2 + cwf,
+              ct * cwt // 2: ct * cwt // 2 + cwt] += \
+                chunks[cf, ct] * masks[cf, ct] * np.exp(1j * rot)
+    return E
+
+
+def rot_init(chunks):
+    """Greedy initial phases for the global rotation fit
+    (ththmod.py:1791-1856)."""
+    chunks = np.asarray(chunks)
+    ncf, nct, cwf, cwt = chunks.shape
+    E = np.zeros(mosaic_shape(ncf, nct, cwf, cwt), dtype=complex)
+    x = np.zeros(ncf * nct - 1)
+    for cf in range(ncf):
+        for ct in range(nct):
+            new = chunks[cf, ct]
+            old = E[cf * cwf // 2: cf * cwf // 2 + cwf,
+                    ct * cwt // 2: ct * cwt // 2 + cwt]
+            mask = chunk_mask(cf, ct, ncf, nct, cwf, cwt)
+            rot = np.angle((old * np.conj(new) * mask).mean())
+            E[cf * cwf // 2: cf * cwf // 2 + cwf,
+              ct * cwt // 2: ct * cwt // 2 + cwt] += \
+                new * mask * np.exp(1j * rot)
+            if cf > 0 or ct > 0:
+                x[cf * nct + ct - 1] = rot
+    return x
+
+
+def _jax_stack(chunks_j, masks_j, phases, amps, jnp):
+    """Differentiable overlap-add: scatter each phased chunk into the
+    mosaic canvas (jax path shared by both refinement objectives)."""
+    ncf, nct, cwf, cwt = chunks_j.shape
+    shape = mosaic_shape(ncf, nct, cwf, cwt)
+    E = jnp.zeros(shape, dtype=chunks_j.dtype)
+    k = 0
+    for cf in range(ncf):
+        for ct in range(nct):
+            phi = phases[k - 1] if k > 0 else 0.0  # first chunk fixed
+            contrib = (amps[k] * chunks_j[cf, ct] * masks_j[cf, ct]
+                       * jnp.exp(1j * phi))
+            E = E.at[cf * cwf // 2: cf * cwf // 2 + cwf,
+                     ct * cwt // 2: ct * cwt // 2 + cwt].add(contrib)
+            k += 1
+    return E
+
+
+def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
+                  maxiter=200, backend=None):
+    """Global mosaic refinement by autodiff L-BFGS.
+
+    mode='rot': maximise Σ|E|² over per-chunk phases (rotFit,
+    ththmod.py:1773-1788). mode='full': fit phases+amplitudes against
+    the observed dynamic spectrum (fullMosFit, ththmod.py:1990-2016).
+    The reference's 400 lines of hand-derived gradient/Hessian
+    (rotDer/fullMosGrad/fullMosHess) are replaced by jax.grad.
+    """
+    from scipy.optimize import minimize
+
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    chunks = np.asarray(chunks)
+    ncf, nct, cwf, cwt = chunks.shape
+    nchunk = ncf * nct
+    masks = _masks_array(ncf, nct, cwf, cwt)
+    chunks_j = jnp.asarray(chunks)
+    masks_j = jnp.asarray(masks)
+
+    x0_phase = rot_init(chunks)
+    if mode == "rot":
+        def objective(x):
+            E = _jax_stack(chunks_j, masks_j, x, jnp.ones(nchunk), jnp)
+            return -jnp.sum(jnp.abs(E) ** 2)
+        x0 = x0_phase
+    elif mode == "full":
+        if dspec is None:
+            raise ValueError("mode='full' requires the observed dspec")
+        shape = mosaic_shape(ncf, nct, cwf, cwt)
+        d = np.asarray(dspec, dtype=float)[: shape[0], : shape[1]]
+        N = (np.ones_like(d) if noise is None
+             else np.asarray(noise, dtype=float)[: shape[0], : shape[1]])
+        d_j = jnp.asarray(np.nan_to_num(d))
+        w_j = jnp.asarray(np.where(np.isfinite(d), 1.0 / N, 0.0))
+
+        def objective(p):
+            phases = p[: nchunk - 1]
+            amps = p[nchunk - 1:]
+            E = _jax_stack(chunks_j, masks_j, phases, amps, jnp)
+            M = jnp.abs(E) ** 2
+            return jnp.sum(((M - d_j) * w_j) ** 2)
+        x0 = np.concatenate([x0_phase, np.ones(nchunk)])
+    else:
+        raise ValueError("mode must be 'rot' or 'full'")
+
+    obj_grad = jax.jit(jax.value_and_grad(objective))
+
+    def fun(x):
+        v, g = obj_grad(jnp.asarray(x))
+        return float(v), np.asarray(g, dtype=float)
+
+    res = minimize(fun, x0, jac=True, method="L-BFGS-B",
+                   options={"maxiter": maxiter})
+    if mode == "rot":
+        return rot_mos(chunks, res.x), res
+    phases = res.x[: nchunk - 1]
+    amps = res.x[nchunk - 1:]
+    E = np.asarray(_jax_stack(chunks_j, masks_j, jnp.asarray(phases),
+                              jnp.asarray(amps), jnp))
+    return E, res
+
+
+def gerchberg_saxton(wavefield, dyn, niter=10):
+    """Gerchberg–Saxton amplitude-replacement + causality iterations
+    (dynspec.py:1854-1890): replace |E| with √dyn, then zero acausal
+    (τ<0) components."""
+    E = np.array(wavefield, dtype=complex)
+    dyn = np.asarray(dyn, dtype=float)[: E.shape[0], : E.shape[1]]
+    # replace amplitudes only at finite, positive dynspec pixels
+    # (dynspec.py:1871-1880) so RFI-flagged NaNs don't poison the FFT
+    good = np.isfinite(dyn) & (dyn > 0)
+    amp = np.sqrt(np.where(good, dyn, 0.0))
+    for _ in range(niter):
+        E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
+        spec = np.fft.fft2(E)
+        nf = spec.shape[0]
+        spec[nf // 2:, :] = 0  # causality: zero negative delays
+        E = np.fft.ifft2(spec)
+    return E
+
+
+def calc_asymmetry(eigenvector, edges_red):
+    """L/R eigenvector-power asymmetry (ththmod.py:2385-2463 core):
+    A = (P+ − P−)/(P+ + P−) over θ>0 vs θ<0 components."""
+    from .core import th_cents_from_edges
+    cents = th_cents_from_edges(edges_red)
+    V = np.asarray(eigenvector)
+    p_pos = np.sum(np.abs(V[cents > 0]) ** 2)
+    p_neg = np.sum(np.abs(V[cents < 0]) ** 2)
+    return (p_pos - p_neg) / (p_pos + p_neg)
+
+
+def err_string(value, error):
+    """Scientific-notation value±error formatter (ththmod.py:2313-2365
+    role)."""
+    if not np.isfinite(value) or not np.isfinite(error) or error <= 0:
+        return f"{value}"
+    exp = int(np.floor(np.log10(np.abs(value)))) if value != 0 else 0
+    v = value / 10 ** exp
+    e = error / 10 ** exp
+    dig = max(0, 1 - int(np.floor(np.log10(e)))) if e > 0 else 2
+    return f"({v:.{dig}f}±{e:.{dig}f})e{exp}"

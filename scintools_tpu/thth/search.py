@@ -1,0 +1,134 @@
+"""Chunked θ-θ curvature search.
+
+Re-design of ``single_search``/``single_search_thin``
+(/root/reference/scintools/ththmod.py:516-895). The reference fans
+chunks out over an MPI/multiprocessing pool and loops η in python; here
+each chunk's η curve is one batched device kernel
+(:func:`eval_calc_batch`) and chunks batch via vmap/shard_map
+(see parallel/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+from scipy.optimize import curve_fit
+
+from .core import (fft_axis, eval_calc_batch, unit_checks,
+                   singularvalue_calc)
+from ..backend import resolve_backend
+
+
+def chi_par(x, A, x0, C):
+    """Parabola for peak fitting (ththmod.py:38-53)."""
+    return A * (x - x0) ** 2 + C
+
+
+@dataclass
+class ChunkSearchResult:
+    eta: float          # fitted curvature (s³ ≡ us/mHz²)
+    eta_sig: float      # fit error
+    freq_mean: float    # mean frequency of chunk (MHz)
+    time_mean: float    # mean time of chunk (s)
+    eigs: np.ndarray    # eigenvalue-vs-η curve
+    etas: np.ndarray    # η grid
+
+
+def pad_chunk(dspec, npad, fill="mean"):
+    """Pad a dynamic-spectrum chunk with npad extra copies of its mean
+    (ththmod.py:777-782)."""
+    value = dspec.mean() if fill == "mean" else 0.0
+    return np.pad(dspec,
+                  ((0, npad * dspec.shape[0]), (0, npad * dspec.shape[1])),
+                  mode="constant", constant_values=value)
+
+
+def chunk_conjugate_spectrum(dspec, time, freq, npad=3, tau_mask=0.0):
+    """(CS, tau, fd) of a padded chunk (ththmod.py:772-787)."""
+    time = np.asarray(unit_checks(time, "time"), dtype=float)
+    freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
+    fd = fft_axis(time, pad=npad, scale=1e3)    # s → mHz
+    tau = fft_axis(freq, pad=npad, scale=1.0)   # MHz → us
+    dspec_pad = pad_chunk(np.asarray(dspec), npad)
+    CS = np.fft.fftshift(np.fft.fft2(dspec_pad))
+    if tau_mask:
+        CS[np.abs(tau) < float(unit_checks(tau_mask))] = 0
+    return CS, tau, fd
+
+
+def fit_eig_peak(etas, eigs, fw=0.1):
+    """Parabola fit around the eigenvalue peak
+    (ththmod.py:813-852)."""
+    etas = np.asarray(etas, dtype=float)
+    eigs = np.asarray(eigs, dtype=float)
+    ok = np.isfinite(eigs)
+    etas, eigs = etas[ok], eigs[ok]
+    if len(etas) < 3:
+        return np.nan, np.nan
+    e_pk = etas[eigs == eigs.max()][0]
+    sel = np.abs(etas - e_pk) < fw * e_pk
+    etas_fit, eigs_fit = etas[sel], eigs[sel]
+    if len(etas_fit) < 3:
+        return np.nan, np.nan
+    C = eigs_fit.max()
+    x0 = etas_fit[eigs_fit == C][0]
+    if x0 == etas_fit[0]:
+        A = (eigs_fit[-1] - C) / ((etas_fit[-1] - x0) ** 2)
+    else:
+        A = (eigs_fit[0] - C) / ((etas_fit[0] - x0) ** 2)
+    try:
+        popt, _ = curve_fit(chi_par, etas_fit, eigs_fit,
+                            p0=np.array([A, x0, C]))
+    except Exception:
+        return np.nan, np.nan
+    eta_fit = popt[1]
+    eta_sig = np.sqrt((eigs_fit - chi_par(etas_fit, *popt)).std()
+                      / np.abs(popt[0]))
+    return eta_fit, eta_sig
+
+
+def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
+                  coher=True, tau_mask=0.0, verbose=False, backend=None):
+    """Curvature search on one chunk (ththmod.py:715-895 semantics,
+    positional-params version).
+
+    coher=True uses the conjugate spectrum; False its magnitude.
+    """
+    backend = resolve_backend(backend)
+    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+    CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad,
+                                           tau_mask=tau_mask)
+    base = CS if coher else np.abs(CS)
+    eigs = eval_calc_batch(base, tau, fd, etas, edges, backend=backend)
+    eta_fit, eta_sig = fit_eig_peak(etas, eigs, fw=fw)
+    freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
+    time = np.asarray(unit_checks(time, "time"), dtype=float)
+    return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
+                             freq_mean=float(freq.mean()),
+                             time_mean=float(time.mean()),
+                             eigs=np.asarray(eigs), etas=etas)
+
+
+def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
+                       centerCut, fw=0.1, npad=3, coher=True,
+                       verbose=False, backend=None):
+    """Two-curvature (thin-screen) search: largest singular value of
+    the two-curve θ-θ per η (ththmod.py:516-712)."""
+    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+    CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad)
+    base = CS if coher else np.abs(CS) ** 2
+    eigs = np.empty(len(etas))
+    for i, eta in enumerate(etas):
+        try:
+            eigs[i] = singularvalue_calc(base, tau, fd, eta, edges, eta,
+                                         edgesArclet, centerCut)
+        except Exception:
+            eigs[i] = np.nan
+    eta_fit, eta_sig = fit_eig_peak(etas, eigs, fw=fw)
+    freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
+    time = np.asarray(unit_checks(time, "time"), dtype=float)
+    return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
+                             freq_mean=float(freq.mean()),
+                             time_mean=float(time.mean()),
+                             eigs=eigs, etas=etas)

@@ -1,0 +1,270 @@
+"""θ-θ engine tests on synthetic 1-D-screen wavefields with known
+curvature (the reference validates against exactly such simulations,
+docs/source/tutorials/thth_intro.rst)."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.thth.core import (thth_map, thth_redmap, rev_map,
+                                     modeler, eval_calc, eval_calc_batch,
+                                     fft_axis, min_edges,
+                                     th_cents_from_edges, two_curve_map)
+from scintools_tpu.thth.search import (single_search, fit_eig_peak,
+                                       chunk_conjugate_spectrum)
+from scintools_tpu.thth.retrieval import (single_chunk_retrieval, mosaic,
+                                          rot_mos, rot_init,
+                                          refine_mosaic,
+                                          gerchberg_saxton,
+                                          calc_asymmetry, mask_func)
+
+ETA_TRUE = 0.3  # s^3 (us/mHz^2)
+
+
+def make_arc_wavefield(nt=128, nf=128, eta=ETA_TRUE, seed=2,
+                       dt=30.0, df=0.2, f0=1400.0, npix=16):
+    """Wavefield from a dense 1-D screen: one image per padded-CS
+    Doppler pixel on the arc tau = eta*fd^2, dominated by a central
+    (unscattered) image."""
+    rng = np.random.default_rng(seed)
+    times = np.arange(nt) * dt            # s
+    freqs = f0 + np.arange(nf) * df       # MHz
+    dfd_pad = 1e3 / (2 * nt * dt)         # padded CS pixel, mHz
+    fd_k = np.arange(-npix, npix + 1) * dfd_pad
+    tau_k = eta * fd_k ** 2               # us
+    amps = ((0.05 + 0.3 * rng.random(len(fd_k))
+             * np.exp(-(fd_k / 1.2) ** 2))
+            * np.exp(2j * np.pi * rng.random(len(fd_k))))
+    amps[len(fd_k) // 2] = 3.0
+    F, T = np.meshgrid(freqs - f0, times, indexing="ij")
+    E = np.zeros((nf, nt), dtype=complex)
+    for a, td, fdk in zip(amps, tau_k, fd_k):
+        # phase = 2π(τ[us]·ν[MHz] + f_D[mHz]·1e-3·t[s])
+        E += a * np.exp(2j * np.pi * (td * F + fdk * 1e-3 * T))
+    return E, times, freqs
+
+
+def make_arc_edges(nt=128, dt=30.0, half=20):
+    dfd_pad = 1e3 / (2 * nt * dt)
+    return np.arange(-half - 0.5, half + 1.5) * dfd_pad
+
+
+def make_arc_dspec(**kw):
+    E, times, freqs = make_arc_wavefield(**kw)
+    return np.abs(E) ** 2, times, freqs
+
+
+@pytest.fixture(scope="module")
+def arc_data():
+    dspec, times, freqs = make_arc_dspec()
+    edges = make_arc_edges()
+    return dspec, times, freqs, edges
+
+
+class TestCore:
+    def test_fft_axis(self):
+        t = np.arange(32) * 10.0
+        fd = fft_axis(t, pad=0, scale=1e3)
+        assert len(fd) == 32
+        np.testing.assert_allclose(np.diff(fd), 1e3 / 320.0)
+        f = 1400 + np.arange(16) * 0.5
+        tau = fft_axis(f, pad=1, scale=1.0)
+        assert len(tau) == 32
+
+    def test_th_cents_centred(self):
+        edges = np.linspace(-2, 2, 10)
+        c = th_cents_from_edges(edges)
+        assert np.min(np.abs(c)) == 0.0
+
+    def test_thth_map_hermitian(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        thth = np.asarray(thth_map(CS, tau, fd, ETA_TRUE, edges,
+                                   backend="numpy"))
+        np.testing.assert_allclose(thth, np.conj(thth.T), atol=1e-8)
+
+    def test_redmap_square(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        red, edges_red = thth_redmap(CS, tau, fd, ETA_TRUE, edges,
+                                     backend="numpy")
+        assert red.shape[0] == red.shape[1]
+        assert len(edges_red) == red.shape[0] + 1
+
+    def test_modeler_reconstructs_dspec(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        out = modeler(CS, tau, fd, ETA_TRUE, edges, backend="numpy")
+        model = out[3][: dspec.shape[0], : dspec.shape[1]]
+        d = dspec - dspec.mean()
+        m = model - model.mean()
+        corr = np.sum(d * m) / np.sqrt(np.sum(d ** 2) * np.sum(m ** 2))
+        assert corr > 0.8
+
+    def test_eval_peak_at_true_eta(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        etas = np.linspace(0.1, 0.6, 41)
+        eigs = eval_calc_batch(CS, tau, fd, etas, edges, backend="numpy")
+        eta_pk = etas[np.nanargmax(eigs)]
+        assert eta_pk == pytest.approx(ETA_TRUE, rel=0.15)
+
+    def test_eval_batch_jax_matches_numpy(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        etas = np.linspace(0.15, 0.5, 15)
+        e_np = eval_calc_batch(CS, tau, fd, etas, edges, backend="numpy")
+        e_jx = eval_calc_batch(CS, tau, fd, etas, edges, backend="jax")
+        # same curve within power-iteration tolerance
+        np.testing.assert_allclose(e_jx, e_np, rtol=1e-3)
+
+    def test_rev_map_roundtrip_flux(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        red, edges_red = thth_redmap(CS, tau, fd, ETA_TRUE, edges,
+                                     backend="numpy")
+        recov = np.asarray(rev_map(red, tau, fd, ETA_TRUE, edges_red,
+                                   backend="numpy"))
+        assert recov.shape == CS.shape
+        # the mapped-back CS matches the original over the support the
+        # θ-θ covers (the arc-pair difference set)
+        sup = np.abs(recov) > 0
+        num = np.abs(np.vdot(recov[sup], CS[sup]))
+        den = np.linalg.norm(recov[sup]) * np.linalg.norm(CS[sup])
+        assert num / den > 0.7
+
+    def test_two_curve_map_runs(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        CS, tau, fd = chunk_conjugate_spectrum(dspec, times, freqs,
+                                               npad=1)
+        red, e1, e2 = two_curve_map(CS, tau, fd, ETA_TRUE, edges,
+                                    ETA_TRUE, edges)
+        assert red.shape == (len(e2) - 1, len(e1) - 1)
+
+    def test_min_edges(self):
+        fd = np.linspace(-10, 10, 64)
+        tau = np.linspace(0, 5, 64)
+        e = min_edges(2.0, fd, tau, 0.3)
+        assert len(e) % 2 == 0
+        assert e[0] == -2.0 and e[-1] == 2.0
+
+
+class TestSearch:
+    def test_single_search_recovers_eta(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        etas = np.linspace(0.15, 0.6, 60)
+        res = single_search(dspec, freqs, times, etas, edges, npad=1,
+                            backend="numpy")
+        assert res.eta == pytest.approx(ETA_TRUE, rel=0.1)
+        assert np.isfinite(res.eta_sig)
+
+    def test_single_search_jax(self, arc_data):
+        dspec, times, freqs, edges = arc_data
+        etas = np.linspace(0.15, 0.6, 60)
+        res = single_search(dspec, freqs, times, etas, edges, npad=1,
+                            backend="jax")
+        assert res.eta == pytest.approx(ETA_TRUE, rel=0.1)
+
+    def test_fit_eig_peak_parabola(self):
+        etas = np.linspace(0.1, 0.5, 100)
+        eigs = 10 - 200 * (etas - 0.3) ** 2
+        eta, sig = fit_eig_peak(etas, eigs, fw=0.3)
+        assert eta == pytest.approx(0.3, abs=1e-3)
+
+    def test_fit_eig_peak_all_nan(self):
+        etas = np.linspace(0.1, 0.5, 10)
+        eta, sig = fit_eig_peak(etas, np.full(10, np.nan))
+        assert np.isnan(eta)
+
+
+class TestRetrieval:
+    def test_phase_retrieval_recovers_wavefield(self):
+        E_true, times, freqs = make_arc_wavefield()
+        dspec = np.abs(E_true) ** 2
+        edges = make_arc_edges()
+        model_E, _, _ = single_chunk_retrieval(dspec, edges, times,
+                                               freqs, ETA_TRUE, npad=1,
+                                               backend="numpy")
+        assert model_E.shape == dspec.shape
+        assert np.any(model_E != 0)
+        # match up to a global phase: normalised cross-correlation
+        # (the rank-1 θ-θ approximation on a dense screen with discrete
+        # binning gives ~0.65 here — same as the reference algorithm)
+        num = np.abs(np.vdot(model_E, E_true))
+        den = np.linalg.norm(model_E) * np.linalg.norm(E_true)
+        assert num / den > 0.6
+
+    def test_mosaic_stitches_smooth_field(self, rng):
+        # smooth global field split into half-overlapping chunks with
+        # random per-chunk phases: mosaic should undo the phases
+        nf_g, nt_g = 48, 48
+        x = np.linspace(0, 2 * np.pi, nf_g)
+        field = (np.exp(1j * np.outer(x, np.ones(nt_g)))
+                 + 0.5 * np.exp(1j * 3 * np.outer(np.ones(nf_g), x)))
+        cwf = cwt = 16
+        ncf = nct = (nf_g - cwf) // (cwf // 2) + 1
+        chunks = np.zeros((ncf, nct, cwf, cwt), dtype=complex)
+        for cf in range(ncf):
+            for ct in range(nct):
+                block = field[cf * cwf // 2: cf * cwf // 2 + cwf,
+                              ct * cwt // 2: ct * cwt // 2 + cwt]
+                chunks[cf, ct] = block * np.exp(
+                    2j * np.pi * rng.random())
+        E = mosaic(chunks)
+        num = np.abs(np.vdot(E, field[: E.shape[0], : E.shape[1]]))
+        den = (np.linalg.norm(E)
+               * np.linalg.norm(field[: E.shape[0], : E.shape[1]]))
+        assert num / den > 0.98
+
+    def test_rot_mos_matches_mosaic_with_init(self, rng):
+        chunks = (rng.standard_normal((2, 3, 8, 8))
+                  + 1j * rng.standard_normal((2, 3, 8, 8)))
+        x = rot_init(chunks)
+        E1 = rot_mos(chunks, x)
+        E2 = mosaic(chunks)
+        np.testing.assert_allclose(E1, E2, atol=1e-10)
+
+    def test_refine_mosaic_rot_improves_power(self, rng):
+        nf_g = nt_g = 24
+        x = np.linspace(0, 2 * np.pi, nf_g)
+        field = np.exp(1j * np.outer(x, np.ones(nt_g)))
+        cwf = cwt = 8
+        ncf = nct = (nf_g - cwf) // (cwf // 2) + 1
+        chunks = np.zeros((ncf, nct, cwf, cwt), dtype=complex)
+        for cf in range(ncf):
+            for ct in range(nct):
+                block = field[cf * cwf // 2: cf * cwf // 2 + cwf,
+                              ct * cwt // 2: ct * cwt // 2 + cwt]
+                chunks[cf, ct] = block * np.exp(
+                    2j * np.pi * rng.random())
+        E_ref, res = refine_mosaic(chunks, mode="rot", maxiter=50)
+        p_init = np.sum(np.abs(rot_mos(chunks, rot_init(chunks))) ** 2)
+        p_ref = np.sum(np.abs(E_ref) ** 2)
+        assert p_ref >= p_init * 0.999  # no worse than greedy
+
+    def test_gerchberg_saxton_amplitude(self, rng):
+        E = rng.standard_normal((16, 16)) + 1j * rng.standard_normal(
+            (16, 16))
+        dyn = rng.random((16, 16)) + 0.5
+        out = gerchberg_saxton(E, dyn, niter=3)
+        assert out.shape == E.shape
+        # after GS, fourier spectrum is causal (negative delays zero)
+        spec = np.fft.fft2(out)
+        assert np.allclose(spec[8:, :], 0, atol=1e-8)
+
+    def test_calc_asymmetry(self):
+        edges = np.linspace(-2, 2, 11)
+        V = np.zeros(10)
+        V[-3:] = 1.0  # all power at positive theta
+        assert calc_asymmetry(V, edges) == pytest.approx(1.0)
+
+    def test_mask_func_ramp(self):
+        m = mask_func(8)
+        assert m[0] == 0
+        assert np.all(np.diff(m) > 0)
+        assert m[-1] < 1.0
