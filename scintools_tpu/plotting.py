@@ -1,0 +1,259 @@
+"""Host-side matplotlib plotting (reference: dynspec.py:442-968 and the
+plot branches throughout). Plots are a presentation layer only — all
+numerics live in the ops/ kernels."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .utils.misc import is_valid, centres_to_edges
+
+
+def _mpl():
+    import matplotlib
+    if matplotlib.get_backend().lower() != "agg" and not hasattr(
+            _mpl, "_interactive"):
+        try:
+            matplotlib.use("Agg", force=False)
+        except Exception:
+            pass
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def _finish(plt, fig, filename, display, dpi):
+    if filename is not None:
+        fig.savefig(filename, dpi=dpi, bbox_inches="tight",
+                    pad_inches=0.1)
+        plt.close(fig)
+    elif display:
+        plt.show()
+    return fig
+
+
+def plot_dyn(ds, lamsteps=False, input_dyn=None, filename=None,
+             input_x=None, input_y=None, trap=False, display=True,
+             figsize=(9, 9), dpi=200, title=None, velocity=False):
+    """Dynamic spectrum (dynspec.py:442-545)."""
+    plt = _mpl()
+    if input_dyn is None:
+        if lamsteps:
+            if not hasattr(ds, "lamdyn"):
+                ds.scale_dyn()
+            dyn = ds.lamdyn
+            yaxis = ds.lam
+            ylabel = "Wavelength (m)"
+        elif trap:
+            if not hasattr(ds, "trapdyn"):
+                ds.scale_dyn(scale="trapezoid")
+            dyn = ds.trapdyn
+            yaxis = ds.freqs
+            ylabel = "Frequency (MHz)"
+        else:
+            dyn = ds.vdyn if velocity else ds.dyn
+            yaxis = ds.freqs
+            ylabel = "Frequency (MHz)"
+        xaxis = ds.times / 60
+    else:
+        dyn = input_dyn
+        xaxis = input_x
+        yaxis = input_y
+        ylabel = ""
+    fig = plt.figure(figsize=figsize)
+    valid = dyn[is_valid(dyn)]
+    if valid.size:
+        medval = np.median(valid[np.abs(valid) > 0])
+        minval = np.min(valid)
+        std = np.std(valid)
+        vmin, vmax = max(minval, medval - 5 * std), medval + 5 * std
+    else:
+        vmin = vmax = None
+    plt.pcolormesh(centres_to_edges(xaxis), centres_to_edges(yaxis),
+                   dyn, vmin=vmin, vmax=vmax, linewidth=0,
+                   rasterized=True, shading="auto")
+    plt.xlabel("Time (mins)")
+    plt.ylabel(ylabel)
+    if title:
+        plt.title(title)
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def plot_acf(ds, contour=False, filename=None, input_acf=None,
+             input_t=None, input_f=None, display=True, figsize=(9, 9),
+             dpi=200):
+    """ACF (dynspec.py:547-691 core)."""
+    plt = _mpl()
+    if input_acf is None:
+        if not hasattr(ds, "acf"):
+            ds.calc_acf()
+        acf = ds.acf
+        t_delays = np.linspace(-ds.tobs / 60, ds.tobs / 60,
+                               acf.shape[1] + 1)[:-1]
+        f_shifts = np.linspace(-ds.bw, ds.bw, acf.shape[0] + 1)[:-1]
+    else:
+        acf = input_acf
+        t_delays = np.linspace(-max(input_t) / 60, max(input_t) / 60,
+                               acf.shape[1] + 1)[:-1]
+        f_shifts = np.linspace(-np.ptp(input_f), np.ptp(input_f),
+                               acf.shape[0] + 1)[:-1]
+    fig = plt.figure(figsize=figsize)
+    plt.pcolormesh(centres_to_edges(t_delays),
+                   centres_to_edges(f_shifts), acf, linewidth=0,
+                   rasterized=True, shading="auto")
+    if contour:
+        plt.contour(t_delays, f_shifts, acf,
+                    levels=np.linspace(0.2, 0.8, 4), colors="k")
+    plt.xlabel("Time lag (mins)")
+    plt.ylabel("Frequency lag (MHz)")
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def plot_sspec(ds, lamsteps=False, input_sspec=None, filename=None,
+               input_x=None, input_y=None, trap=False, plotarc=False,
+               maxfdop=np.inf, delmax=None, cutmid=0, startbin=0,
+               display=True, colorbar=True, title=None, figsize=(9, 9),
+               dpi=200, velocity=False):
+    """Secondary spectrum (dynspec.py:693-853 core)."""
+    plt = _mpl()
+    if input_sspec is None:
+        sspec, yaxis = ds._select_sspec(lamsteps=lamsteps, trap=trap,
+                                        velocity=velocity)
+        xaxis = ds.fdop
+    else:
+        sspec = input_sspec
+        xaxis = input_x
+        yaxis = input_y
+    sspec = np.asarray(sspec)
+    fig = plt.figure(figsize=figsize)
+    valid = sspec[is_valid(sspec) & (np.abs(sspec) > 0)]
+    vmin = np.median(valid) - 3 if valid.size else None
+    vmax = np.max(valid) - 3 if valid.size else None
+    sel = np.abs(xaxis) <= maxfdop
+    plt.pcolormesh(centres_to_edges(xaxis[sel]),
+                   centres_to_edges(yaxis[startbin:]),
+                   sspec[startbin:, sel], vmin=vmin, vmax=vmax,
+                   linewidth=0, rasterized=True, shading="auto")
+    if plotarc:
+        eta = ds.betaeta if lamsteps else ds.eta
+        x = np.linspace(max(-maxfdop, np.min(xaxis)),
+                        min(maxfdop, np.max(xaxis)), 200)
+        plt.plot(x, eta * x ** 2, "r--", alpha=0.7)
+        plt.ylim(yaxis[startbin], np.max(yaxis))
+    plt.xlabel(r"$f_t$ (mHz)")
+    plt.ylabel(r"$f_\lambda$ (m$^{-1}$)" if lamsteps
+               else r"$f_\nu$ ($\mu$s)")
+    if colorbar:
+        plt.colorbar()
+    if title:
+        plt.title(title)
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def plot_arc_fit(fit, lamsteps=False, filename=None, display=True,
+                 figsize=(9, 9), dpi=200):
+    """Curvature-fit diagnostic (dynspec.py:1315-1346)."""
+    plt = _mpl()
+    fig = plt.figure(figsize=figsize)
+    plt.plot(fit.eta_array[10:], fit.profile[10:])
+    if fit.xdata is not None:
+        plt.plot(fit.xdata, fit.yfit, "k")
+    plt.axvspan(xmin=fit.eta - fit.etaerr, xmax=fit.eta + fit.etaerr,
+                facecolor="C2", alpha=0.5)
+    plt.xscale("log")
+    if lamsteps:
+        plt.xlabel(r"Arc curvature, "
+                   r"$\eta$ (${\rm m}^{-1}\,{\rm mHz}^{-2}$)")
+    else:
+        plt.xlabel("eta (tdel)")
+    plt.ylabel("Mean power (dB)")
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def plot_norm_sspec(ds, scrunched=True, unscrunched=True, powerspec=True,
+                    plot_fit=True, maxnormfac=5, lamsteps=True,
+                    filename=None, display=True, figsize=(9, 9),
+                    dpi=200):
+    """Normalised sspec panels (dynspec.py:2185-2279)."""
+    plt = _mpl()
+    figs = []
+    if scrunched:
+        fig = plt.figure(figsize=figsize)
+        plt.plot(ds.normsspec_fdop, ds.normsspecavg)
+        if plot_fit:
+            for x in (-1, 1):
+                plt.axvline(x, color="r", linestyle="--", alpha=0.5)
+        plt.xlabel(r"Normalised $f_t$")
+        plt.ylabel("Mean power (dB)")
+        plt.xlim(-maxnormfac, maxnormfac)
+        figs.append(_finish(plt, fig, filename and
+                            filename.replace(".", "_1d.", 1), display,
+                            dpi))
+    if unscrunched:
+        fig = plt.figure(figsize=figsize)
+        arr = np.ma.filled(np.ma.array(ds.normsspec, mask=ds.mask),
+                           np.nan)
+        plt.pcolormesh(centres_to_edges(ds.normsspec_fdop),
+                       centres_to_edges(ds.normsspec_tdel), arr,
+                       linewidth=0, rasterized=True, shading="auto")
+        plt.xlabel(r"Normalised $f_t$")
+        plt.ylabel(r"$f_\lambda$ (m$^{-1}$)" if lamsteps
+                   else r"$f_\nu$ ($\mu$s)")
+        plt.colorbar()
+        figs.append(_finish(plt, fig, filename, display, dpi))
+    if powerspec:
+        fig = plt.figure(figsize=figsize)
+        x = np.sqrt(ds.normsspec_tdel)
+        y = x * ds.powerspectrum
+        plt.loglog(x, y)
+        plt.xlabel(r"$f_\lambda^{1/2}$" if lamsteps
+                   else r"$f_\nu^{1/2}$")
+        plt.ylabel(r"$f^{1/2} D(f^{1/2})$")
+        plt.grid(which="both", axis="both")
+        figs.append(_finish(plt, fig, filename and
+                            filename.replace(".", "_power.", 1),
+                            display, dpi))
+    return figs
+
+
+def plot_scattered_image(ds, input_scattered_image=None, input_fdop=None,
+                         display=True, plot_log=True, filename=None,
+                         figsize=(9, 9), dpi=200):
+    """Scattered image (dynspec.py:855-968 core)."""
+    plt = _mpl()
+    im = (input_scattered_image if input_scattered_image is not None
+          else ds.scattered_image)
+    ax = input_fdop if input_fdop is not None else ds.scattered_image_ax
+    fig = plt.figure(figsize=figsize)
+    data = 10 * np.log10(np.abs(im) + 1e-30) if plot_log else im
+    plt.pcolormesh(centres_to_edges(ax), centres_to_edges(ax), data,
+                   linewidth=0, rasterized=True, shading="auto")
+    plt.xlabel(r"$f_t$ (mHz)")
+    plt.ylabel(r"$f_t$ (mHz)")
+    plt.colorbar()
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def plot_all(ds, lamsteps=False, filename=None, display=True,
+             figsize=(9, 9), dpi=200):
+    """Composite 2×2 summary (dynspec.py role of plot_all)."""
+    plt = _mpl()
+    fig, axes = plt.subplots(2, 2, figsize=figsize)
+    plt.sca(axes[0, 0])
+    plt.pcolormesh(centres_to_edges(ds.times / 60),
+                   centres_to_edges(ds.freqs), ds.dyn, shading="auto")
+    plt.title("Dynamic spectrum")
+    if not hasattr(ds, "acf"):
+        ds.calc_acf()
+    plt.sca(axes[0, 1])
+    plt.pcolormesh(ds.acf, shading="auto")
+    plt.title("ACF")
+    sspec, yaxis = ds._select_sspec(lamsteps=lamsteps)
+    plt.sca(axes[1, 0])
+    valid = sspec[is_valid(sspec) & (np.abs(sspec) > 0)]
+    plt.pcolormesh(centres_to_edges(ds.fdop), centres_to_edges(yaxis),
+                   sspec, vmin=np.median(valid) - 3,
+                   vmax=np.max(valid) - 3, shading="auto")
+    plt.title("Secondary spectrum")
+    axes[1, 1].axis("off")
+    plt.tight_layout()
+    return _finish(plt, fig, filename, display, dpi)

@@ -281,22 +281,35 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
     return E, res
 
 
-def gerchberg_saxton(wavefield, dyn, niter=10):
+def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True):
     """Gerchberg–Saxton amplitude-replacement + causality iterations
-    (dynspec.py:1854-1890): replace |E| with √dyn, then zero acausal
-    (τ<0) components."""
+    (dynspec.py:1854-1890): rescale |E|² to the dynspec mean, replace
+    |E| with √dyn at finite positive pixels, then zero acausal (τ<0)
+    components each iteration. Single implementation shared with
+    ``Dynspec.gerchberg_saxton``."""
     E = np.array(wavefield, dtype=complex)
     dyn = np.asarray(dyn, dtype=float)[: E.shape[0], : E.shape[1]]
     # replace amplitudes only at finite, positive dynspec pixels
     # (dynspec.py:1871-1880) so RFI-flagged NaNs don't poison the FFT
     good = np.isfinite(dyn) & (dyn > 0)
     amp = np.sqrt(np.where(good, dyn, 0.0))
+    if rescale:
+        E = E * np.sqrt(dyn[good].mean()
+                        / np.abs(E[good] ** 2).mean())
+    if freqs is not None:
+        tau = np.fft.fftshift(
+            np.fft.fftfreq(E.shape[0],
+                           float(np.mean(np.diff(freqs)))))
+        neg = np.fft.ifftshift(tau < 0)
+    else:
+        neg = np.zeros(E.shape[0], dtype=bool)
+        neg[E.shape[0] // 2:] = True  # default: negative-delay half
+    E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
     for _ in range(niter):
-        E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
         spec = np.fft.fft2(E)
-        nf = spec.shape[0]
-        spec[nf // 2:, :] = 0  # causality: zero negative delays
+        spec[neg, :] = 0  # causality: zero negative delays
         E = np.fft.ifft2(spec)
+        E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
     return E
 
 

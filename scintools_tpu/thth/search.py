@@ -31,8 +31,9 @@ class ChunkSearchResult:
     eta_sig: float      # fit error
     freq_mean: float    # mean frequency of chunk (MHz)
     time_mean: float    # mean time of chunk (s)
-    eigs: np.ndarray    # eigenvalue-vs-η curve
-    etas: np.ndarray    # η grid
+    eigs: np.ndarray    # eigenvalue-vs-η curve (NaN entries stripped)
+    etas: np.ndarray    # η grid matching ``eigs``
+    popt: np.ndarray = None  # parabola-fit coefficients (A, x0, C)
 
 
 def pad_chunk(dspec, npad, fill="mean"):
@@ -57,20 +58,29 @@ def chunk_conjugate_spectrum(dspec, time, freq, npad=3, tau_mask=0.0):
     return CS, tau, fd
 
 
-def fit_eig_peak(etas, eigs, fw=0.1):
-    """Parabola fit around the eigenvalue peak
-    (ththmod.py:813-852)."""
+def fit_eig_peak(etas, eigs, fw=0.1, full=False):
+    """Parabola fit around the eigenvalue peak (ththmod.py:813-852).
+
+    With ``full=True`` also returns (popt, etas_clean, eigs_clean)
+    where the clean arrays have NaN eigenvalues stripped.
+    """
     etas = np.asarray(etas, dtype=float)
     eigs = np.asarray(eigs, dtype=float)
     ok = np.isfinite(eigs)
     etas, eigs = etas[ok], eigs[ok]
+
+    def out(eta_fit, eta_sig, popt):
+        if full:
+            return eta_fit, eta_sig, popt, etas, eigs
+        return eta_fit, eta_sig
+
     if len(etas) < 3:
-        return np.nan, np.nan
+        return out(np.nan, np.nan, None)
     e_pk = etas[eigs == eigs.max()][0]
     sel = np.abs(etas - e_pk) < fw * e_pk
     etas_fit, eigs_fit = etas[sel], eigs[sel]
     if len(etas_fit) < 3:
-        return np.nan, np.nan
+        return out(np.nan, np.nan, None)
     C = eigs_fit.max()
     x0 = etas_fit[eigs_fit == C][0]
     if x0 == etas_fit[0]:
@@ -81,11 +91,11 @@ def fit_eig_peak(etas, eigs, fw=0.1):
         popt, _ = curve_fit(chi_par, etas_fit, eigs_fit,
                             p0=np.array([A, x0, C]))
     except Exception:
-        return np.nan, np.nan
+        return out(np.nan, np.nan, None)
     eta_fit = popt[1]
     eta_sig = np.sqrt((eigs_fit - chi_par(etas_fit, *popt)).std()
                       / np.abs(popt[0]))
-    return eta_fit, eta_sig
+    return out(eta_fit, eta_sig, popt)
 
 
 def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
@@ -101,22 +111,24 @@ def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
                                            tau_mask=tau_mask)
     base = CS if coher else np.abs(CS)
     eigs = eval_calc_batch(base, tau, fd, etas, edges, backend=backend)
-    eta_fit, eta_sig = fit_eig_peak(etas, eigs, fw=fw)
+    eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
+        etas, eigs, fw=fw, full=True)
     freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
     time = np.asarray(unit_checks(time, "time"), dtype=float)
     return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
                              freq_mean=float(freq.mean()),
                              time_mean=float(time.mean()),
-                             eigs=np.asarray(eigs), etas=etas)
+                             eigs=eigs_c, etas=etas_c, popt=popt)
 
 
 def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
                        centerCut, fw=0.1, npad=3, coher=True,
-                       verbose=False, backend=None):
+                       tau_mask=0.0, verbose=False, backend=None):
     """Two-curvature (thin-screen) search: largest singular value of
     the two-curve θ-θ per η (ththmod.py:516-712)."""
     etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
-    CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad)
+    CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad,
+                                           tau_mask=tau_mask)
     base = CS if coher else np.abs(CS) ** 2
     eigs = np.empty(len(etas))
     for i, eta in enumerate(etas):
@@ -125,10 +137,11 @@ def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
                                          edgesArclet, centerCut)
         except Exception:
             eigs[i] = np.nan
-    eta_fit, eta_sig = fit_eig_peak(etas, eigs, fw=fw)
+    eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
+        etas, eigs, fw=fw, full=True)
     freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
     time = np.asarray(unit_checks(time, "time"), dtype=float)
     return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
                              freq_mean=float(freq.mean()),
                              time_mean=float(time.mean()),
-                             eigs=eigs, etas=etas)
+                             eigs=eigs_c, etas=etas_c, popt=popt)

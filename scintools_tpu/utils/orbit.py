@@ -1,0 +1,68 @@
+"""Binary-orbit utilities: true anomaly and binary phase.
+
+Re-design of scint_utils.py:509-572. The reference solves Kepler's
+equation with a python loop of scipy ``fsolve`` per epoch; here a
+vectorised Newton iteration handles all epochs at once (and jits on
+the jax backend for batched survey pipelines).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_xp, resolve_backend
+
+
+def kepler_solve(M, ecc, iters=30, backend=None):
+    """Solve E − e·sin E = M for arrays of mean anomaly (Newton)."""
+    xp = get_xp(resolve_backend(backend))
+    M = xp.asarray(M, dtype=float)
+    E = M + ecc * xp.sin(M)
+    for _ in range(iters):
+        E = E - (E - ecc * xp.sin(E) - M) / (1 - ecc * xp.cos(E))
+    return E
+
+
+def get_true_anomaly(mjds, pars, backend=None):
+    """True anomalies for barycentric MJDs + parameter dict
+    (scint_utils.py:509-554)."""
+    xp = get_xp(resolve_backend(backend))
+    p = pars.valuesdict() if hasattr(pars, "valuesdict") else pars
+    if "TASC" in p:
+        T0 = p["TASC"]
+        ECC = np.sqrt(p["EPS1"] ** 2 + p["EPS2"] ** 2)
+    else:
+        T0 = p["T0"]
+        ECC = p["ECC"]
+    PB = p["PB"]
+    PBDOT = p.get("PBDOT", 0)
+    if np.abs(PBDOT) > 1e-10:
+        PBDOT *= 1e-12  # tempo format
+
+    nb = 2 * np.pi / PB
+    mjds = xp.asarray(mjds, dtype=float)
+    M = nb * ((mjds - T0) - 0.5 * (PBDOT / PB) * (mjds - T0) ** 2)
+
+    if ECC < 1e-4:
+        E = M  # circular-orbit approximation (reference behaviour)
+    else:
+        E = kepler_solve(M, ECC, backend=backend)
+
+    U = 2 * xp.arctan2(np.sqrt(1 + ECC) * xp.sin(E / 2),
+                       np.sqrt(1 - ECC) * xp.cos(E / 2))
+    U = xp.where(U < 0, U + 2 * np.pi, U)
+    return U
+
+
+def get_binphase(mjds, pars, backend=None):
+    """Binary phase = true anomaly + ω(t) (scint_utils.py:557-572)."""
+    p = pars.valuesdict() if hasattr(pars, "valuesdict") else pars
+    U = get_true_anomaly(mjds, p, backend=backend)
+    if "TASC" in p:
+        OM = 0.0
+    else:
+        OM = p["OM"] * np.pi / 180
+        if "OMDOT" in p:
+            OM = OM + (p["OMDOT"] * (np.pi / 180) / 365.2425
+                       * (np.asarray(mjds) - p["T0"]))
+    return U + OM

@@ -1,0 +1,295 @@
+"""Façade tests: the full user-facing Dynspec workflow."""
+
+import os
+
+import numpy as np
+import pytest
+
+import matplotlib
+matplotlib.use("Agg")
+
+from scintools_tpu.sim.simulation import Simulation
+from scintools_tpu.dynspec import Dynspec, BasicDyn, SimDyn, sort_dyn
+from scintools_tpu.io.results import (write_results, read_results,
+                                      float_array_from_dict)
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return Simulation(seed=64, ns=128, nf=128, mb2=2, dt=30, freq=1400,
+                      dlam=0.02)
+
+
+@pytest.fixture(scope="module")
+def dyn(sim):
+    d = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+    return d
+
+
+class TestFacadeBasics:
+    def test_load_simdyn(self, dyn, sim):
+        assert dyn.dyn.shape == (128, 128)
+        assert dyn.freq == sim.freq
+        assert dyn.nchan == 128 and dyn.nsub == 128
+
+    def test_basicdyn_requires_axes(self):
+        with pytest.raises(ValueError):
+            BasicDyn(np.ones((4, 4)))
+
+    def test_add_concatenates(self, sim):
+        d1 = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        d2 = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        d2.mjd = d1.mjd + (d1.tobs + 60) / 86400
+        cat = d1 + d2
+        assert cat.nsub > d1.nsub + d2.nsub - 1
+        assert cat.nchan == d1.nchan
+
+    def test_write_file_roundtrip(self, dyn, tmp_path):
+        path = str(tmp_path / "out.dynspec")
+        dyn.write_file(filename=path, verbose=False)
+        d2 = Dynspec(filename=path, verbose=False)
+        np.testing.assert_allclose(d2.dyn, dyn.dyn, rtol=1e-10)
+
+    def test_info_prints(self, dyn, capsys):
+        dyn.info()
+        out = capsys.readouterr().out
+        assert "OBSERVATION PROPERTIES" in out
+
+
+class TestPreprocessing:
+    def _noisy_dyn(self, seed=0):
+        rng = np.random.default_rng(seed)
+        arr = rng.random((32, 40)) + 1.0
+        times = np.arange(40) * 10.0
+        freqs = np.linspace(1300, 1400, 32)
+        bd = BasicDyn(arr, name="t", times=times, freqs=freqs, mjd=60000)
+        return Dynspec(dyn=bd, verbose=False, process=False)
+
+    def test_trim_edges(self):
+        d = self._noisy_dyn()
+        d.dyn[0, :] = 0
+        d.dyn[-1, :] = 0
+        d.dyn[:, 0] = 0
+        nchan0, nsub0 = d.nchan, d.nsub
+        d.trim_edges()
+        assert d.nchan == nchan0 - 2
+        assert d.nsub == nsub0 - 1
+
+    def test_zap_and_refill_linear(self):
+        d = self._noisy_dyn()
+        d.dyn[5, 7] = 1000.0  # RFI spike
+        d.zap(sigma=7)
+        assert np.isnan(d.dyn[5, 7])
+        d.refill(method="linear")
+        assert np.isfinite(d.dyn).all()
+        assert abs(d.dyn[5, 7]) < 10
+
+    def test_refill_biharmonic(self):
+        d = self._noisy_dyn()
+        d.dyn[10:12, 20:23] = np.nan
+        d.refill(method="biharmonic")
+        assert np.isfinite(d.dyn).all()
+        # inpainted values in the data range
+        assert 0.5 < d.dyn[11, 21] < 2.5
+
+    def test_refill_median(self):
+        d = self._noisy_dyn()
+        d.dyn[3, 3] = np.nan
+        d.refill(method="median")
+        assert np.isfinite(d.dyn).all()
+
+    def test_crop_dyn(self):
+        d = self._noisy_dyn()
+        d.crop_dyn(fmin=1320, fmax=1380, tmin=0, tmax=5)
+        assert d.freqs.min() >= 1320 and d.freqs.max() <= 1380
+        assert d.tobs <= 5 * 60
+
+    def test_correct_dyn_svd(self):
+        d = self._noisy_dyn()
+        bandpass = np.linspace(1, 3, 32)
+        d.dyn = d.dyn * bandpass[:, None]
+        d.correct_dyn(svd=True)
+        assert hasattr(d, "svd_model_arr")
+        # bandpass structure removed: per-channel means near-constant
+        means = d.dyn.mean(axis=1)
+        assert np.std(means) / np.mean(means) < 0.1
+
+    def test_correct_dyn_mean_profiles(self):
+        d = self._noisy_dyn()
+        d.correct_dyn(svd=False, frequency=True, time=True)
+        assert np.isfinite(d.dyn).all()
+
+    def test_auto_processing(self, sim):
+        d = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        d.auto_processing(lamsteps=True)
+        assert hasattr(d, "acf")
+        assert hasattr(d, "lamsspec")
+
+
+class TestScintParams:
+    def test_nofit(self, dyn):
+        dyn.get_scint_params(method="nofit")
+        assert dyn.tau > 0 and dyn.dnu > 0
+        assert dyn.nscint > 1
+        assert dyn.modulation_index > 0
+
+    def test_acf1d(self, dyn):
+        res = dyn.get_scint_params(method="acf1d")
+        assert res.params["tau"].value > 0
+        assert dyn.tauerr > 0 and dyn.dnuerr > 0
+        assert dyn.scint_param_method == "acf1d"
+        assert hasattr(dyn, "report")
+        # simulated spectrum: timescale within the observation
+        assert dyn.dt < dyn.tau < dyn.tobs
+
+    def test_acf2d_approx(self, sim):
+        d = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        res = d.get_scint_params(method="acf2d_approx")
+        assert hasattr(d, "phasegrad")
+        assert hasattr(d, "acf_model")
+        assert d.tau > 0 and d.dnu > 0
+
+    def test_acf_tilt(self, sim):
+        d = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        d.get_acf_tilt()
+        assert hasattr(d, "acf_tilt")
+        assert hasattr(d, "acf_tilt_err")
+        assert np.isfinite(d.acf_tilt)
+
+    def test_cut_dyn(self, sim):
+        d = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        d.cut_dyn(tcuts=1, fcuts=1)
+        assert d.cutdyn.shape[:2] == (2, 2)
+        assert d.cutsspec.shape[:2] == (2, 2)
+
+
+class TestArcFacade:
+    def test_fit_arc_lamsteps_recovers_betaeta(self, dyn, sim):
+        dyn.fit_arc(lamsteps=True, numsteps=3000)
+        assert dyn.betaeta == pytest.approx(sim.betaeta, rel=0.1)
+        assert dyn.betaetaerr > 0
+
+    def test_fit_arc_freq_axis_recovers_eta(self, dyn, sim):
+        dyn.fit_arc(lamsteps=False, numsteps=3000)
+        assert dyn.eta == pytest.approx(sim.eta, rel=0.1)
+
+    def test_norm_sspec_facade(self, dyn):
+        ns = dyn.norm_sspec(lamsteps=True, numsteps=200)
+        assert hasattr(dyn, "normsspecavg")
+        assert hasattr(dyn, "powerspectrum")
+        assert dyn.normsspec_fdop.shape == dyn.normsspecavg.shape
+
+    def test_scattered_image(self, dyn):
+        im = dyn.calc_scattered_image(sampling=32, lamsteps=True)
+        assert im.shape == (65, 65)
+        assert np.isfinite(im).all()
+
+
+class TestResultsIO:
+    def test_write_read_results(self, dyn, tmp_path):
+        path = str(tmp_path / "results.csv")
+        dyn.get_scint_params(method="acf1d")
+        write_results(path, dyn)
+        out = read_results(path)
+        assert out["name"][0] == dyn.name
+        assert float_array_from_dict(out, "tau") == pytest.approx(
+            dyn.tau)
+        # appending a second row keeps one header
+        write_results(path, dyn)
+        out = read_results(path)
+        assert len(out["name"]) == 2
+
+    def test_sort_dyn(self, sim, tmp_path):
+        d = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
+        f1 = str(tmp_path / "a.dynspec")
+        d.write_file(filename=f1, verbose=False)
+        good, bad = sort_dyn([f1], outdir=str(tmp_path), verbose=False,
+                             min_nchan=5, min_nsub=5, min_tsub=1)
+        good_list = open(good).read().strip().splitlines()
+        assert len(good_list) == 1
+
+
+class TestThthDriver:
+    def test_fit_thetatheta_and_wavefield(self):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_thth import make_arc_wavefield, ETA_TRUE
+
+        E, times, freqs = make_arc_wavefield(nt=192, nf=192)
+        bd = BasicDyn(np.abs(E) ** 2, name="arcsim", times=times,
+                      freqs=freqs, mjd=60000)
+        d = Dynspec(dyn=bd, verbose=False, process=False)
+        d.prep_thetatheta(cwf=128, cwt=128, eta_min=0.1, eta_max=0.9,
+                          nedge=64, edges_lim=2.6, npad=1)
+        d.fit_thetatheta()
+        assert d.ththeta == pytest.approx(ETA_TRUE, rel=0.25)
+        d.calc_wavefield()
+        assert d.wavefield.shape == (192, 192)
+        wf = d.wavefield
+        cc = (np.abs(np.vdot(wf, E))
+              / (np.linalg.norm(wf) * np.linalg.norm(E)))
+        assert cc > 0.35
+        d.gerchberg_saxton(niter=2)
+        assert np.isfinite(d.wavefield).all()
+        asym = d.calc_asymmetry()
+        assert np.isfinite(asym).all()
+
+    def test_thetatheta_single_diag(self):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_thth import make_arc_wavefield
+
+        E, times, freqs = make_arc_wavefield()
+        bd = BasicDyn(np.abs(E) ** 2, name="arcsim", times=times,
+                      freqs=freqs, mjd=60000)
+        d = Dynspec(dyn=bd, verbose=False, process=False)
+        d.prep_thetatheta(eta_min=0.1, eta_max=0.9, nedge=48,
+                          edges_lim=2.6, npad=1)
+        etas, eigs, _ = d.thetatheta_single(arrays=True)
+        assert len(etas) == len(eigs)
+        assert np.nanmax(eigs) > 0
+
+
+class TestEphemeris:
+    def test_earth_speed(self):
+        from scintools_tpu.utils.ephemeris import earth_velocity_bary
+        mjds = np.linspace(58000, 58365, 12)
+        v = earth_velocity_bary(mjds) * 149597870.7 / 86400  # km/s
+        speed = np.linalg.norm(v, axis=-1)
+        # Earth orbital speed 29.3-30.3 km/s
+        assert np.all(speed > 29.0) and np.all(speed < 30.5)
+
+    def test_ssb_delay_annual_amplitude(self):
+        from scintools_tpu.utils.ephemeris import get_ssb_delay
+        mjds = np.linspace(58000, 58365, 80)
+        # source near the ecliptic plane: amplitude ~ 499 s
+        t = get_ssb_delay(mjds, "12:00:00", "00:00:00")
+        assert 480 < np.max(np.abs(t)) < 510
+
+    def test_earth_velocity_projection(self):
+        from scintools_tpu.utils.ephemeris import get_earth_velocity
+        mjds = np.linspace(58000, 58365, 40)
+        vra, vdec = get_earth_velocity(mjds, "06:00:00", "66:33:00")
+        assert np.max(np.abs(vra)) < 31
+        assert np.all(np.isfinite(vdec))
+
+    def test_true_anomaly_circular(self):
+        from scintools_tpu.utils.orbit import get_true_anomaly
+        pars = {"T0": 58000.0, "PB": 10.0, "ECC": 0.0}
+        mjds = np.array([58000.0, 58002.5, 58005.0])
+        U = get_true_anomaly(mjds, pars)
+        np.testing.assert_allclose(U, [0, np.pi / 2, np.pi], atol=1e-8)
+
+    def test_true_anomaly_eccentric_kepler(self):
+        from scintools_tpu.utils.orbit import get_true_anomaly
+        ecc = 0.5
+        pars = {"T0": 58000.0, "PB": 10.0, "ECC": ecc}
+        mjds = 58000.0 + np.linspace(0, 10, 50)
+        U = np.asarray(get_true_anomaly(mjds, pars))
+        # verify Kepler: M = E - e sinE with E from U inversion
+        E = 2 * np.arctan2(np.sqrt(1 - ecc) * np.sin(U / 2),
+                           np.sqrt(1 + ecc) * np.cos(U / 2))
+        M = E - ecc * np.sin(E)
+        M_true = 2 * np.pi / 10.0 * (mjds - 58000.0)
+        np.testing.assert_allclose(np.mod(M, 2 * np.pi),
+                                   np.mod(M_true, 2 * np.pi), atol=1e-6)
