@@ -1023,6 +1023,29 @@ class Dynspec:
         else:
             eta = input_eta
 
+        # crop sspec so the arc tdel_est = eta·fdop² stays inside the
+        # delay axis and the spline never extrapolates
+        # (dynspec.py:3514-3525). In the flim == 0 branch the reference
+        # assigns ``tdel = fdop[:tlim]`` — an upstream bug (axis values
+        # from the wrong array); we keep the intended ``tdel[:tlim]``.
+        nf_ax = len(fdop)
+        inside = np.flatnonzero(eta * fdop ** 2 < np.max(tdel))
+        flim = int(inside[0]) if len(inside) else 0
+        if flim == 0:
+            above = np.flatnonzero(tdel > eta * fdop[0] ** 2)
+            if len(above):
+                # ≥4 rows so the cubic spline stays well-posed
+                tlim = max(int(above[0]), 4)
+                linsspec = linsspec[:tlim, :]
+                tdel = tdel[:tlim]
+        else:
+            pad = int(0.02 * nf_ax)
+            lo = max(flim - pad, 0)
+            hi = min(nf_ax - flim + pad, nf_ax)
+            if hi - lo >= 4:
+                linsspec = linsspec[:, lo:hi]
+                fdop = fdop[lo:hi]
+
         if clean:
             arr = np.ma.masked_where(linsspec < 1e-22, linsspec)
             if arr.mask.any():

@@ -1,0 +1,17 @@
+"""Multi-device execution: mesh construction, distributed FFTs, and
+the sharded survey pipeline (the TPU replacement for the reference's
+``multiprocessing.Pool``/``MPIPool`` fan-out, /root/reference/
+scintools/dynspec.py:1669-1671)."""
+
+from .mesh import (make_mesh, device_count, DATA_AXIS, SEQ_AXIS,
+                   data_sharding, batch_freq_sharding, replicated)
+from .fft import make_fft2_sharded, make_sspec_power_sharded
+from .survey import (make_survey_step, make_eta_search_sharded,
+                     init_survey_params)
+
+__all__ = [
+    "make_mesh", "device_count", "DATA_AXIS", "SEQ_AXIS",
+    "data_sharding", "batch_freq_sharding", "replicated",
+    "make_fft2_sharded", "make_sspec_power_sharded",
+    "make_survey_step", "make_eta_search_sharded", "init_survey_params",
+]

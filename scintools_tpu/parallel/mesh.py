@@ -1,0 +1,80 @@
+"""Device-mesh construction for the sharded pipelines.
+
+The reference's only parallelism is chunk fan-out over
+``multiprocessing.Pool``/``MPIPool`` (/root/reference/scintools/
+dynspec.py:1669-1671). The TPU-native replacement is single-controller
+JAX: a 2-D ``jax.sharding.Mesh`` with a ``data`` axis (epochs / chunks /
+screens — the pool's fan-out axis) and a ``seq`` axis (the frequency
+axis of one spectrum, for distributed FFTs when a single array exceeds
+one chip). Collectives ride ICI within a pod slice and DCN across pods;
+a survey job shards epochs over DCN and each epoch's FFT over ICI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def device_count():
+    return get_jax().device_count()
+
+
+def _largest_pow2_divisor(n, cap):
+    p = 1
+    while p * 2 <= cap and n % (p * 2) == 0:
+        p *= 2
+    return p
+
+
+def make_mesh(n_devices=None, seq=None):
+    """Build a ``Mesh`` with axes ``('data', 'seq')``.
+
+    ``seq`` devices cooperate on one spectrum's distributed FFT
+    (power of two so padded FFT lengths stay divisible); the rest fan
+    out over epochs/chunks. Default: seq = largest power of two ≤ √n
+    dividing n — e.g. 8 devices → (4 data, 2 seq).
+    """
+    jax = get_jax()
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    devs = devs[:n_devices]
+    if seq is None:
+        seq = _largest_pow2_divisor(n_devices,
+                                    int(np.sqrt(n_devices)) or 1)
+    if n_devices % seq:
+        raise ValueError(f"seq={seq} does not divide {n_devices} devices")
+    from jax.sharding import Mesh
+
+    arr = np.asarray(devs).reshape(n_devices // seq, seq)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+def data_sharding(mesh, ndim=3):
+    """NamedSharding: leading axis over ('data','seq') combined — pure
+    fan-out over every device (the MPIPool replacement)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * ndim
+    spec[0] = (DATA_AXIS, SEQ_AXIS)
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_freq_sharding(mesh):
+    """NamedSharding for dyn batches [B, nf, nt]: B over 'data', the
+    frequency axis over 'seq' (sequence/context parallelism for the
+    2-D FFTs)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

@@ -1,0 +1,142 @@
+"""Sharded survey pipeline: the TPU replacement for the reference's
+pool fan-out (/root/reference/scintools/dynspec.py:1669-1671, :4357).
+
+One step processes a batch of dynamic-spectrum epochs end-to-end on a
+device mesh:
+
+- **dp** ('data' axis): epochs sharded across devices — the
+  ``sort_dyn``/MPIPool axis.
+- **sp** ('seq' axis): each epoch's 2-D FFT sharded over the frequency
+  axis via ``all_to_all`` (parallel/fft.py) — the long-sequence axis.
+- **η-grid parallelism**: the θ-θ eigenvalue curve shards its η axis
+  over the whole mesh (a tensor-parallel-style split of one search).
+- **fit step**: scintillation-parameter estimation as a *gradient*
+  step on the differentiable ACF model (fit/models.py semantics),
+  with XLA inserting the gradient ``psum`` over 'data'.
+
+Everything compiles to one XLA program per shape; ``dryrun_multichip``
+in ``__graft_entry__`` drives it on a virtual mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..backend import get_jax
+from .mesh import DATA_AXIS, SEQ_AXIS, batch_freq_sharding, replicated
+from .fft import make_sspec_power_sharded, make_fft2_sharded
+from ..ops.sspec import fft_shapes
+from ..ops.windows import get_window
+from ..thth.core import make_eval_fn
+
+
+def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
+    """Sharded θ-θ eigenvalue curve: ``fn(CS, etas) → eigs`` with the η
+    grid split over every device of the mesh (CS replicated). The per-η
+    kernel is thth.core.make_eval_fn; GSPMD partitions the vmap axis."""
+    jax = get_jax()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    eval_fn = make_eval_fn(tau, fd, edges, iters=iters)
+    eta_sharding = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    return jax.jit(eval_fn,
+                   in_shardings=(replicated(mesh), eta_sharding),
+                   out_shardings=eta_sharding)
+
+
+def _acf_cuts_fn(mesh, nf, nt):
+    """Batched ACF via the sharded FFT path → central 1-D cuts.
+
+    calc_acf semantics (dynspec.py:3750-3814): zero-pad to 2N, fft2,
+    |·|², ifft2, real part; row 0 / col 0 of the unshifted ACF are the
+    zero-lag cuts used by the 1-D scint fits.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fft2 = make_fft2_sharded(mesh)
+    ifft2 = make_fft2_sharded(mesh, inverse=True)
+    sharded = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
+
+    def fn(dyns):
+        mu = jnp.mean(dyns, axis=(1, 2), keepdims=True)
+        d = (dyns - mu).astype(jnp.complex64)
+        d = jnp.pad(d, ((0, 0), (0, nf), (0, nt)))
+        d = jax.lax.with_sharding_constraint(d, sharded)
+        spec = fft2(d)
+        acf = jnp.real(ifft2(spec * jnp.conj(spec)))
+        norm = acf[:, 0:1, 0:1]
+        acf = acf / jnp.where(norm == 0, 1.0, norm)
+        tcut = acf[:, 0, 1:nt]       # time lags > 0
+        fcut = acf[:, 1:nf, 0]       # freq lags > 0
+        return tcut, fcut
+
+    return fn
+
+
+def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
+                     lr=0.05, window="hanning", window_frac=0.1):
+    """Build the jitted end-to-end survey step.
+
+    ``fn(dyns[B, nf, nt], params) → (params', loss, power, tcut, fcut)``
+    where ``params = {'tau': [B], 'dnu': [B], 'amp': [B]}`` are
+    per-epoch scintillation parameters advanced by one gradient step on
+    the 1-D ACF model residuals (scint_models.py:62-120 semantics:
+    amp·exp(−(t/τ)^α), amp·exp(−ln2·f/Δν)), and ``power`` is the
+    sharded secondary spectrum of every epoch.
+
+    B must be divisible by the mesh's 'data' axis size.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    k = mesh.shape[SEQ_AXIS]
+    if (2 * nf) % k or (2 * nt) % k:
+        raise ValueError(f"seq axis {k} must divide the ACF FFT shape "
+                         f"({2 * nf}, {2 * nt})")
+    wins = None
+    if window is not None:
+        wins = get_window(nt, nf, window=window, frac=window_frac)
+    sspec_fn = make_sspec_power_sharded(mesh, nf, nt, window_arrays=wins)
+    acf_fn = _acf_cuts_fn(mesh, nf, nt)
+
+    tlags = jnp.asarray(np.arange(1, nt) * dt)
+    flags = jnp.asarray(np.arange(1, nf) * df)
+    tobs = nt * dt
+
+    def loss_fn(params, tcut, fcut):
+        tau = jnp.abs(params["tau"])[:, None]
+        dnu = jnp.abs(params["dnu"])[:, None]
+        amp = params["amp"][:, None]
+        # triangle taper from the finite observation (scint_models.py:81)
+        tri = 1.0 - tlags[None, :] / tobs
+        mt = amp * jnp.exp(-(tlags[None, :] / tau) ** alpha) * tri
+        mf = amp * jnp.exp(-jnp.log(2.0) * flags[None, :] / dnu)
+        r = jnp.concatenate([(mt - tcut), (mf - fcut)], axis=1)
+        return jnp.mean(r ** 2)
+
+    def step(dyns, params):
+        power = sspec_fn(dyns)
+        tcut, fcut = acf_fn(dyns)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tcut, fcut)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                        params, grads)
+        return params, loss, power, tcut, fcut
+
+    dyn_sh = batch_freq_sharding(mesh)
+    param_sh = {k: NamedSharding(mesh, P(DATA_AXIS))
+                for k in ("tau", "dnu", "amp")}
+    return jax.jit(step, in_shardings=(dyn_sh, param_sh))
+
+
+def init_survey_params(batch, tau0=10.0, dnu0=1.0, amp0=1.0):
+    """Per-epoch initial guesses as a pytree matching make_survey_step."""
+    import jax.numpy as jnp
+
+    return {"tau": jnp.full((batch,), float(tau0)),
+            "dnu": jnp.full((batch,), float(dnu0)),
+            "amp": jnp.full((batch,), float(amp0))}

@@ -214,19 +214,21 @@ def dominant_eig_power(A, iters=200, backend=None):
     v = xp.where(nrm > 0, v / (nrm + 1e-30),
                  xp.ones_like(v) / np.sqrt(n))
 
+    # eps added *after* the sqrt: it must survive float32 (an all-zero
+    # masked matrix would otherwise give 0/0 = NaN)
     if backend == "jax":
         jax = get_jax()
 
         def body(_, v):
             w = A @ v + shift * v
-            return w / xp.sqrt(xp.sum(xp.abs(w) ** 2) + 1e-300)
+            return w / (xp.sqrt(xp.sum(xp.abs(w) ** 2)) + 1e-30)
 
         v = jax.lax.fori_loop(0, iters, body, v)
     else:
         for _ in range(iters):
             w = A @ v + shift * v
-            v = w / np.sqrt(np.sum(np.abs(w) ** 2) + 1e-300)
-    lam = xp.real(xp.vdot(v, A @ v) / (xp.vdot(v, v)))
+            v = w / (np.sqrt(np.sum(np.abs(w) ** 2)) + 1e-30)
+    lam = xp.real(xp.vdot(v, A @ v) / (xp.vdot(v, v) + 1e-30))
     return lam, v
 
 
@@ -242,22 +244,16 @@ def eval_calc(CS, tau, fd, eta, edges, backend=None):
     return abs(float(lam))
 
 
-def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
-    """Batched eigenvalue-vs-η curve: one jitted vmap over the η grid
-    on jax (the reference's python loop, ththmod.py:789-799), masked
-    fixed-shape matrices instead of per-η crops."""
-    backend = resolve_backend(backend)
-    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
-    if backend == "numpy":
-        out = np.empty(len(etas))
-        for i, eta in enumerate(etas):
-            try:
-                out[i] = eval_calc(CS, tau, fd, eta, edges,
-                                   backend="numpy")
-            except Exception:
-                out[i] = np.nan
-        return out
+def make_eval_fn(tau, fd, edges, iters=200):
+    """Build the pure-jax batched eigenvalue kernel ``fn(CS, etas) →
+    eigs``: a vmap over the η grid with masked fixed-shape θ-θ matrices
+    instead of per-η crops, so one jit serves every η (and shards over
+    the η axis under pjit — see parallel/).
 
+    Geometry (tau/fd/edges) is baked in host-side; CS and etas are
+    traced arguments. Used by :func:`eval_calc_batch`, the sharded
+    η-search in parallel/, and the driver entry point.
+    """
     jax = get_jax()
     import jax.numpy as jnp
 
@@ -270,11 +266,10 @@ def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
     th2 = th1.T
     dtau = np.diff(tau_a).mean()
     dfd = np.diff(fd_a).mean()
-    CS_j = jnp.asarray(CS)
-    tril_mask = jnp.asarray(np.tril(np.ones((n_th, n_th))) > 0)
-    anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
+    tril_mask = np.tril(np.ones((n_th, n_th))) > 0
+    anti_eye = np.eye(n_th)[::-1] > 0
 
-    def one_eta(eta):
+    def one_eta(CS_j, eta):
         tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau_a[0]
                              + dtau / 2) / dtau).astype(int)
         fd_inv = jnp.floor(((th1 - th2) - fd_a[0] + dfd / 2)
@@ -287,10 +282,10 @@ def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
         thth = jnp.where(pnts, vals, 0.0)
         thth = thth * jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
         # hermitian symmetrisation (ththmod.py:109-114)
-        thth = jnp.where(tril_mask, 0.0, thth)
+        thth = jnp.where(jnp.asarray(tril_mask), 0.0, thth)
         thth = thth + jnp.conj(thth.T)
         thth = thth - jnp.diag(jnp.diag(thth))
-        thth = jnp.where(anti_eye, 0.0, thth)
+        thth = jnp.where(jnp.asarray(anti_eye), 0.0, thth)
         thth = jnp.nan_to_num(thth)
         # mask instead of crop: zeroed rows/cols keep the top eigenvalue
         valid = ((jnp.asarray(th_cents) ** 2 * eta
@@ -301,7 +296,50 @@ def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
         lam, _ = dominant_eig_power(thth, iters=iters, backend="jax")
         return jnp.abs(lam)
 
-    return np.asarray(jax.jit(jax.vmap(one_eta))(jnp.asarray(etas)))
+    return jax.vmap(one_eta, in_axes=(None, 0))
+
+
+# jax.jit caches on function identity, so jitting a fresh make_eval_fn
+# closure per call would retrace every chunk; key the compiled kernel
+# on the geometry instead (fit_thetatheta reuses one geometry across
+# all time-chunks of a frequency row).
+_EVAL_JIT_CACHE = {}
+_EVAL_JIT_CACHE_MAX = 32
+
+
+def _jitted_eval_fn(tau, fd, edges, iters):
+    key = (tau.tobytes(), fd.tobytes(), edges.tobytes(), int(iters))
+    fn = _EVAL_JIT_CACHE.get(key)
+    if fn is None:
+        fn = get_jax().jit(make_eval_fn(tau, fd, edges, iters=iters))
+        if len(_EVAL_JIT_CACHE) >= _EVAL_JIT_CACHE_MAX:
+            _EVAL_JIT_CACHE.pop(next(iter(_EVAL_JIT_CACHE)))
+        _EVAL_JIT_CACHE[key] = fn
+    return fn
+
+
+def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
+    """Batched eigenvalue-vs-η curve: one jitted vmap over the η grid
+    on jax (the reference's python loop, ththmod.py:789-799)."""
+    backend = resolve_backend(backend)
+    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+    if backend == "numpy":
+        out = np.empty(len(etas))
+        for i, eta in enumerate(etas):
+            try:
+                out[i] = eval_calc(CS, tau, fd, eta, edges,
+                                   backend="numpy")
+            except Exception:
+                out[i] = np.nan
+        return out
+
+    import jax.numpy as jnp
+
+    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    fn = _jitted_eval_fn(tau_a, fd_a, edges_a, iters)
+    return np.asarray(fn(jnp.asarray(CS), jnp.asarray(etas)))
 
 
 def modeler(CS, tau, fd, eta, edges, hermetian=True, backend=None):

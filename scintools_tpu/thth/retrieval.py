@@ -302,8 +302,10 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True):
                            float(np.mean(np.diff(freqs)))))
         neg = np.fft.ifftshift(tau < 0)
     else:
+        # default: negative-frequency rows of an unshifted fft axis
+        # start at (n+1)//2 (for odd n, index n//2 is still positive)
         neg = np.zeros(E.shape[0], dtype=bool)
-        neg[E.shape[0] // 2:] = True  # default: negative-delay half
+        neg[(E.shape[0] + 1) // 2:] = True
     E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
     for _ in range(niter):
         spec = np.fft.fft2(E)

@@ -36,6 +36,21 @@ class ChunkSearchResult:
     popt: np.ndarray = None  # parabola-fit coefficients (A, x0, C)
 
 
+def chunk_geometry(nf=64, nt=64, npad=3, dt=2.0, df=0.05, f0=1400.0,
+                   eta_max=4e-3, n_edges=64):
+    """Static axes for one θ-θ chunk: (freqs MHz, times s, tau µs,
+    fd mHz, edges mHz). The θ edges are sized so the reduced θ-θ stays
+    inside the conjugate spectrum at the largest search curvature
+    (η·θ² < τmax and |θ| < fdmax/2, ththmod.py:151-155)."""
+    freqs = f0 + np.arange(nf) * df
+    times = np.arange(nt) * dt
+    fd = fft_axis(times, pad=npad, scale=1e3)   # mHz
+    tau = fft_axis(freqs, pad=npad, scale=1.0)  # µs
+    th_lim = 0.95 * min(np.sqrt(tau.max() / eta_max), fd.max() / 2)
+    edges = np.linspace(-th_lim, th_lim, n_edges)
+    return freqs, times, tau, fd, edges
+
+
 def pad_chunk(dspec, npad, fill="mean"):
     """Pad a dynamic-spectrum chunk with npad extra copies of its mean
     (ththmod.py:777-782)."""
