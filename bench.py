@@ -70,7 +70,7 @@ def main():
     from scintools_tpu.ops.sspec import secondary_spectrum_power
     from scintools_tpu.ops.windows import get_window
     from scintools_tpu.thth.core import (make_eval_fn, eval_calc_batch,
-                                         fft_axis)
+                                         fft_axis, cs_to_ri)
     from scintools_tpu.thth.search import fit_eig_peak
 
     # ---- workload generation (not timed) ----------------------------
@@ -108,18 +108,19 @@ def main():
     sec_np, eigs_np = numpy_pipeline()
     t_np = _t(numpy_pipeline, repeats=2)
 
-    # ---- jax path (one jitted program per kernel) -------------------
+    # ---- jax path (one jitted program per kernel; complex stays
+    # internal — the tunneled TPU cannot transfer complex buffers) ----
     eval_fn = make_eval_fn(tau, fd, edges, iters=200)
 
     @jax.jit
-    def jax_pipeline(d, cs, e):
+    def jax_pipeline(d, cs_ri, e):
         sec = secondary_spectrum_power(d, window_arrays=wins,
                                        backend="jax")
-        eigs = eval_fn(cs, e)
+        eigs = eval_fn(cs_ri, e)
         return sec, eigs
 
     d_j = jnp.asarray(dyn)
-    cs_j = jnp.asarray(CS)
+    cs_j = jnp.asarray(cs_to_ri(CS))
     e_j = jnp.asarray(etas)
     sec_j, eigs_j = jax.block_until_ready(jax_pipeline(d_j, cs_j, e_j))
 

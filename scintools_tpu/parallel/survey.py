@@ -33,9 +33,11 @@ from ..thth.core import make_eval_fn
 
 
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
-    """Sharded θ-θ eigenvalue curve: ``fn(CS, etas) → eigs`` with the η
-    grid split over every device of the mesh (CS replicated). The per-η
-    kernel is thth.core.make_eval_fn; GSPMD partitions the vmap axis."""
+    """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
+    the η grid split over every device of the mesh (CS replicated;
+    passed as stacked (real, imag) floats of shape (2, ntau, nfd) —
+    see make_eval_fn). The per-η kernel is thth.core.make_eval_fn;
+    GSPMD partitions the vmap axis."""
     jax = get_jax()
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -98,25 +98,75 @@ def fresnel_filter_q2(nx, ny, ffconx, ffcony):
 
 def propagate(xyp, q2, scales, xp, column):
     """Fresnel-propagate phase screen to the observer plane for each
-    frequency scale; returns complex field spe[nx, nf].
+    frequency scale; returns complex field spe[nx, nf] as a **host
+    numpy** array on both backends.
 
     xye(f) = ifft2( fft2(exp(i·φ·scale)) · exp(−i·q2·scale) ), sampled
     along the centre column (scint_sim.py:226-230).
-    """
-    def one_freq(scale):
-        xye = xp.fft.fft2(xp.exp(1j * xyp * scale))
-        xye = xye * xp.exp(-1j * q2 * scale)
-        xye = xp.fft.ifft2(xye)
-        return xye[:, column]
 
+    TPU note: the jax path runs as ONE jitted program whose outputs are
+    the stacked (real, imag) floats — complex buffers must not cross
+    program boundaries on TPU runtimes that can't transfer them (the
+    tunneled-TPU transfer of complex arrays is UNIMPLEMENTED).
+    """
     if xp is np:
+        def one_freq(scale):
+            xye = np.fft.fft2(np.exp(1j * xyp * scale))
+            xye = xye * np.exp(-1j * q2 * scale)
+            return np.fft.ifft2(xye)[:, column]
+
         nf = len(scales)
         spe = np.zeros((xyp.shape[0], nf), dtype=complex)
         for i, s in enumerate(scales):
             spe[:, i] = one_freq(s)
         return spe
-    jax = get_jax()
-    return jax.vmap(one_freq, out_axes=1)(xp.asarray(scales))
+    fn = _jax_propagate_program()
+    sre, sim_ = fn(xp.asarray(xyp), xp.asarray(q2),
+                   xp.asarray(np.asarray(scales)), column)
+    return np.asarray(sre) + 1j * np.asarray(sim_)
+
+
+_PROP_JIT = None
+_SCREEN_JIT = None
+
+
+def _jax_screen_program():
+    """Cached jitted phase-screen draw: (w, key) → φ = Re fft2(w·(N+iN))
+    (scint_sim.py:199-207), real output."""
+    global _SCREEN_JIT
+    if _SCREEN_JIT is None:
+        jax = get_jax()
+        import jax.numpy as jnp
+
+        def run(w, key):
+            k1, k2 = jax.random.split(key)
+            re = jax.random.normal(k1, w.shape)
+            im = jax.random.normal(k2, w.shape)
+            return jnp.real(jnp.fft.fft2(w * (re + 1j * im)))
+
+        _SCREEN_JIT = jax.jit(run)
+    return _SCREEN_JIT
+
+
+def _jax_propagate_program():
+    """Cached jitted Fresnel propagation: (xyp, q2, scales, column) →
+    (spe.real, spe.imag). Real-only program boundaries (see propagate)."""
+    global _PROP_JIT
+    if _PROP_JIT is None:
+        jax = get_jax()
+        import jax.numpy as jnp
+
+        def run(xyp, q2, scales, column):
+            def one_freq(scale):
+                xye = jnp.fft.fft2(jnp.exp(1j * xyp * scale))
+                xye = xye * jnp.exp(-1j * q2 * scale)
+                col = jnp.fft.ifft2(xye)[:, column]
+                return col.real, col.imag
+
+            return jax.vmap(one_freq, out_axes=1)(scales)
+
+        _PROP_JIT = jax.jit(run, static_argnames=("column",))
+    return _PROP_JIT
 
 
 class Simulation:
@@ -226,13 +276,14 @@ class Simulation:
         self.w = w
         if self.backend == "jax":
             jax = get_jax()
-            xp = get_xp("jax")
+            import jax.numpy as jnp
             key = jax.random.PRNGKey(0 if self.seed in (None, -1)
                                      else int(self.seed))
-            k1, k2 = jax.random.split(key)
-            re = jax.random.normal(k1, (self.nx, self.ny))
-            im = jax.random.normal(k2, (self.nx, self.ny))
-            xyp = xp.real(xp.fft.fft2(xp.asarray(w) * (re + 1j * im)))
+            # one jitted program, real in / real out (complex buffers
+            # cannot cross program boundaries on the tunneled TPU);
+            # real buffers can, so keep the device copy for propagate
+            self._xyp_dev = _jax_screen_program()(jnp.asarray(w), key)
+            xyp = np.asarray(self._xyp_dev)
         else:
             nprandom.seed(self.seed)
             xyp = np.real(np.fft.fft2(
@@ -254,29 +305,31 @@ class Simulation:
         q2 = fresnel_filter_q2(self.nx, self.ny, self.ffconx, self.ffcony)
         scales = self.frequency_scales()
         column = int(np.floor(self.ny / 2))
-        spe = propagate(xp.asarray(self.xyp), xp.asarray(q2), scales, xp,
-                        column)
-        self.spe = spe
+        # use the device-resident screen if get_screen just made one
+        # (skips a host→device re-upload), then drop it: it is only
+        # needed here, and keeping it would pin HBM and go stale if
+        # the caller redraws or edits self.xyp
+        xyp = self.__dict__.pop("_xyp_dev", self.xyp)
+        self.spe = propagate(xyp, q2, scales, xp, column)
         self._q2 = q2
 
     @property
     def xyi(self):
         """Intensity image at the last frequency (the reference keeps the
         loop's final plane, scint_sim.py:232-234). Computed lazily —
-        only plotting uses it."""
+        only plotting uses it (host numpy; one plane)."""
         if not hasattr(self, "_xyi"):
-            xp = get_xp(self.backend)
             scale = self.frequency_scales()[-1]
-            xye = xp.fft.ifft2(
-                xp.fft.fft2(xp.exp(1j * xp.asarray(self.xyp) * scale))
-                * xp.exp(-1j * xp.asarray(self._q2) * scale))
-            self._xyi = xp.real(xye * xp.conj(xye))
+            xye = np.fft.ifft2(
+                np.fft.fft2(np.exp(1j * self.xyp * scale))
+                * np.exp(-1j * self._q2 * scale))
+            self._xyi = np.real(xye * np.conj(xye))
         return self._xyi
 
     def get_dynspec(self):
-        """spi = |spe|² plus normalised axes (scint_sim.py:238-252)."""
-        xp = get_xp(self.backend)
-        self.spi = np.asarray(xp.real(self.spe * xp.conj(self.spe)))
+        """spi = |spe|² plus normalised axes (scint_sim.py:238-252).
+        ``spe`` is always a host array after get_intensity."""
+        self.spi = np.real(self.spe * np.conj(self.spe))
         self.x = np.linspace(0, self.dx * self.nx, self.nx)
         ifreq = np.linspace(0, self.nf - 1, self.nf)
         lam_norm = 1.0 + self.dlam * (ifreq - 1 - self.nf / 2) / self.nf
@@ -285,12 +338,12 @@ class Simulation:
         self.freqs = frfreq / np.mean(frfreq)
 
     def get_pulse(self):
-        """Intensity impulse response vs position (scint_sim.py:254-274)."""
-        xp = get_xp(self.backend)
-        spe = xp.asarray(self.spe)
-        p = xp.fft.fft(spe * xp.asarray(np.blackman(self.nf)), 2 * self.nf)
-        p = xp.real(p * xp.conj(p))
-        self.pulsewin = np.transpose(np.asarray(xp.roll(p, self.nf, axis=-1)))
+        """Intensity impulse response vs position (scint_sim.py:254-274).
+        Host-side: ``spe`` is a host array and this is a one-shot small
+        FFT (complex buffers can't cross TPU program boundaries)."""
+        p = np.fft.fft(self.spe * np.blackman(self.nf), 2 * self.nf)
+        p = np.real(p * np.conj(p))
+        self.pulsewin = np.transpose(np.roll(p, self.nf, axis=-1))
         self.dm = np.asarray(self.xyp)[:, int(self.ny / 2)] * self.dlam / np.pi
 
 

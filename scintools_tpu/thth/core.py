@@ -244,13 +244,31 @@ def eval_calc(CS, tau, fd, eta, edges, backend=None):
     return abs(float(lam))
 
 
-def make_eval_fn(tau, fd, edges, iters=200):
-    """Build the pure-jax batched eigenvalue kernel ``fn(CS, etas) →
-    eigs``: a vmap over the η grid with masked fixed-shape θ-θ matrices
-    instead of per-η crops, so one jit serves every η (and shards over
-    the η axis under pjit — see parallel/).
+def cs_to_ri(CS, xp=np):
+    """Pack a complex conjugate spectrum into the stacked (real, imag)
+    float wire format consumed by :func:`make_eval_fn` kernels. Use
+    this instead of hand-stacking so the packing order is
+    single-sourced. ``xp=jnp`` works on traced values inside jit."""
+    CS = xp.asarray(CS)
+    return xp.stack([CS.real, CS.imag])
 
-    Geometry (tau/fd/edges) is baked in host-side; CS and etas are
+
+def make_eval_fn(tau, fd, edges, iters=200):
+    """Build the pure-jax batched eigenvalue kernel
+    ``fn(CS_ri, etas) → eigs``: a vmap over the η grid with masked
+    fixed-shape θ-θ matrices instead of per-η crops, so one jit serves
+    every η (and shards over the η axis under pjit — see parallel/).
+
+    ``CS_ri`` is the conjugate spectrum as a *float* array of shape
+    ``(2, ntau, nfd)`` holding (real, imag): complex arrays must never
+    cross a program boundary on TPU backends whose runtime cannot
+    transfer/feed complex buffers (observed UNIMPLEMENTED on the
+    tunneled TPU); complex math stays internal to the program. Use
+    :func:`cs_to_ri` at the call site — when calling from inside
+    another traced function, stacking a traced complex CS is free (it
+    never materialises).
+
+    Geometry (tau/fd/edges) is baked in host-side; CS_ri and etas are
     traced arguments. Used by :func:`eval_calc_batch`, the sharded
     η-search in parallel/, and the driver entry point.
     """
@@ -269,7 +287,8 @@ def make_eval_fn(tau, fd, edges, iters=200):
     tril_mask = np.tril(np.ones((n_th, n_th))) > 0
     anti_eye = np.eye(n_th)[::-1] > 0
 
-    def one_eta(CS_j, eta):
+    def one_eta(CS_ri, eta):
+        CS_j = CS_ri[0] + 1j * CS_ri[1]
         tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau_a[0]
                              + dtau / 2) / dtau).astype(int)
         fd_inv = jnp.floor(((th1 - th2) - fd_a[0] + dfd / 2)
@@ -339,7 +358,7 @@ def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
     fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
     edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
     fn = _jitted_eval_fn(tau_a, fd_a, edges_a, iters)
-    return np.asarray(fn(jnp.asarray(CS), jnp.asarray(etas)))
+    return np.asarray(fn(jnp.asarray(cs_to_ri(CS)), jnp.asarray(etas)))
 
 
 def modeler(CS, tau, fd, eta, edges, hermetian=True, backend=None):

@@ -14,7 +14,7 @@ import pytest
 from scintools_tpu import parallel as par
 from scintools_tpu.ops.sspec import secondary_spectrum_power, fft_shapes
 from scintools_tpu.ops.windows import get_window
-from scintools_tpu.thth.core import eval_calc_batch
+from scintools_tpu.thth.core import eval_calc_batch, cs_to_ri
 import __graft_entry__ as graft
 
 
@@ -71,7 +71,8 @@ def test_eta_search_sharded_matches_batch(mesh, rng):
         np.pad(dyn, ((0, npad * nf), (0, npad * nt)))))
     etas = np.linspace(5e-4, 4e-3, 16)
     search = par.make_eta_search_sharded(mesh, tau, fd, edges, iters=200)
-    got = np.asarray(search(jnp.asarray(CS), jnp.asarray(etas)))
+    cs_ri = jnp.asarray(cs_to_ri(CS))
+    got = np.asarray(search(cs_ri, jnp.asarray(etas)))
     want = eval_calc_batch(CS, tau, fd, etas, edges, backend="jax")
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
