@@ -3,10 +3,15 @@
 Workload (BASELINE.json configs #1 and #3, scaled to one chip):
   - calc_sspec on a 1024×512 simulated dynamic spectrum
     (scint_sim.Simulation equivalent, sim/simulation.py), and
-  - a 200-η θ-θ eigenvalue curvature search on a 256×256 chunk
-    (thth/core.py), the reference's ththmod.single_search hot loop.
+  - a 200-η θ-θ eigenvalue curvature search over the full 4×2 grid of
+    256×256 chunks — the reference's fit_thetatheta workload
+    (dynspec.py:1681-1719), which it fans over an MPI/multiprocessing
+    pool; here it is one chunk-batched device program with a
+    VMEM-resident warm-start Pallas eigensolver (thth/batch.py).
 
-Prints ONE JSON line:
+Both backends run the identical workload: the numpy path is the
+reference's per-chunk loop (scipy eigsh per η), the jax path the
+batched kernel. Prints ONE JSON line:
   {"metric": ..., "value": pixels/sec (jax), "unit": ..., "vs_baseline":
    speedup over the single-process numpy path on this host's CPU}.
 """
@@ -69,8 +74,9 @@ def main():
     from scintools_tpu.sim.simulation import Simulation
     from scintools_tpu.ops.sspec import secondary_spectrum_power
     from scintools_tpu.ops.windows import get_window
-    from scintools_tpu.thth.core import (make_eval_fn, eval_calc_batch,
-                                         fft_axis, cs_to_ri)
+    from scintools_tpu.thth.core import (eval_calc_batch, fft_axis,
+                                         cs_to_ri)
+    from scintools_tpu.thth.batch import make_multi_eval_fn
     from scintools_tpu.thth.search import fit_eig_peak
 
     # ---- workload generation (not timed) ----------------------------
@@ -80,8 +86,8 @@ def main():
     nf, nt = dyn.shape
     dt, df = sim.dt, sim.df
 
-    cf, ct = 256, 256                                 # θ-θ chunk
-    chunk = dyn[:cf, :ct]
+    cf, ct = 256, 256                                 # chunk size
+    ncf, nct = nf // cf, nt // ct                     # 4×2 chunk grid
     npad = 1
     times = np.arange(ct) * dt
     freqs = sim.freqs[:cf]
@@ -91,26 +97,36 @@ def main():
     etas = np.linspace(0.5 * eta_c, 2.0 * eta_c, 200)
     th_lim = 0.95 * min(np.sqrt(tau.max() / etas.max()), fd.max() / 2)
     edges = np.linspace(-th_lim, th_lim, 256)
-    mu = chunk.mean()
-    chunk_pad = np.pad(chunk, ((0, npad * cf), (0, npad * ct)),
-                       constant_values=mu)
-    CS = np.fft.fftshift(np.fft.fft2(chunk_pad))
+
+    CS_list = []
+    for icf in range(ncf):
+        for ict in range(nct):
+            chunk = dyn[icf * cf:(icf + 1) * cf,
+                        ict * ct:(ict + 1) * ct]
+            CS_list.append(np.fft.fftshift(np.fft.fft2(
+                np.pad(chunk, ((0, npad * cf), (0, npad * ct)),
+                       constant_values=chunk.mean()))))
 
     wins = get_window(nt, nf, window="hanning", frac=0.1)
 
-    # ---- numpy baseline (single CPU process, reference semantics) ---
+    # ---- numpy baseline (single CPU process, reference semantics:
+    # per-chunk loop, scipy eigsh per η — ththmod.py:789-799) ---------
     def numpy_pipeline():
         sec = secondary_spectrum_power(dyn, window_arrays=wins,
                                        backend="numpy")
-        eigs = eval_calc_batch(CS, tau, fd, etas, edges, backend="numpy")
+        eigs = [eval_calc_batch(CS, tau, fd, etas, edges,
+                                backend="numpy") for CS in CS_list]
         return sec, eigs
 
     sec_np, eigs_np = numpy_pipeline()
     t_np = _t(numpy_pipeline, repeats=2)
 
-    # ---- jax path (one jitted program per kernel; complex stays
-    # internal — the tunneled TPU cannot transfer complex buffers) ----
-    eval_fn = make_eval_fn(tau, fd, edges, iters=200)
+    # ---- jax path: one jitted program per kernel; complex stays
+    # internal (the tunneled TPU cannot transfer complex buffers);
+    # 'auto' → chunk-batched gather + VMEM-resident warm-start Pallas
+    # eigensolver on TPU (thth/batch.py), power iteration elsewhere ---
+    eval_fn = make_multi_eval_fn(tau, fd, edges, iters=200,
+                                 method="auto")
 
     @jax.jit
     def jax_pipeline(d, cs_ri, e):
@@ -120,7 +136,8 @@ def main():
         return sec, eigs
 
     d_j = jnp.asarray(dyn)
-    cs_j = jnp.asarray(cs_to_ri(CS))
+    cs_j = jnp.asarray(np.stack([cs_to_ri(CS) for CS in CS_list],
+                                dtype=np.float32))
     e_j = jnp.asarray(etas)
     sec_j, eigs_j = jax.block_until_ready(jax_pipeline(d_j, cs_j, e_j))
 
@@ -129,14 +146,21 @@ def main():
 
     t_jax = _t(run_jax, repeats=3)
 
-    # ---- cross-backend curvature consistency (north-star Δη) --------
-    eta_np, _ = fit_eig_peak(etas, np.asarray(eigs_np), fw=0.2)
-    eta_jx, _ = fit_eig_peak(etas, np.asarray(eigs_j), fw=0.2)
-    if np.isfinite(eta_np) and np.isfinite(eta_jx) and eta_np != 0:
-        deta = abs(eta_jx - eta_np) / abs(eta_np)
-        if deta > 0.01:
-            print(f"WARNING: cross-backend eta mismatch {deta:.3%}",
-                  file=sys.stderr)
+    # ---- cross-backend curvature consistency (north-star Δη):
+    # flag only significant disagreement — flat-peak (arc-free) chunks
+    # have η-fit 1σ errors of tens of percent, so Δη must exceed both
+    # 1% and half the fit's own uncertainty to count ----------------
+    for b in range(len(CS_list)):
+        eta_np, sig_np = fit_eig_peak(etas, np.asarray(eigs_np[b]),
+                                      fw=0.2)
+        eta_jx, _ = fit_eig_peak(etas, np.asarray(eigs_j[b]), fw=0.2)
+        if np.isfinite(eta_np) and np.isfinite(eta_jx) and eta_np != 0:
+            deta = abs(eta_jx - eta_np)
+            if deta > 0.01 * abs(eta_np) and not (
+                    np.isfinite(sig_np) and deta < 0.5 * sig_np):
+                print(f"WARNING: chunk {b} cross-backend eta mismatch "
+                      f"{deta/abs(eta_np):.3%} (sigma {sig_np:.3g})",
+                      file=sys.stderr)
 
     pixels = nf * nt
     print(json.dumps({

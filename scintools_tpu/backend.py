@@ -64,3 +64,21 @@ def to_numpy(x):
     return np.asarray(x)
 
 
+def complex_transfer_safe():
+    """False when the default jax device cannot transfer complex
+    buffers across the host↔device boundary (the tunneled 'axon' TPU
+    fails with UNIMPLEMENTED and poisons the process). Complex math
+    *inside* a single jitted program is always fine; this gates only
+    eager helpers that would device_put complex arrays."""
+    return os.environ.get("JAX_PLATFORMS", "") != "axon"
+
+
+def eager_backend(backend=None):
+    """Backend for eager (non-jitted) complex array helpers: resolves
+    'jax' down to 'numpy' when complex transfers are unsafe."""
+    backend = resolve_backend(backend)
+    if backend == "jax" and not complex_transfer_safe():
+        return "numpy"
+    return backend
+
+

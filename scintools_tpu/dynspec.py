@@ -1211,6 +1211,36 @@ class Dynspec:
                 npad=self.npad,
                 coher=(self.thetatheta_proc != "incoherent"),
                 tau_mask=self.thth_tau_mask, backend=self.backend)
+        if plot:
+            from .thth.plots import plot_func
+            from .thth.search import chunk_conjugate_spectrum
+
+            CS, tau, fd = chunk_conjugate_spectrum(
+                dspec2, time2, freq2, npad=self.npad,
+                tau_mask=self.thth_tau_mask)
+            # marginal chunks can strip the whole curve to NaN — fall
+            # back to the raw η grid so the diagnostic still renders
+            if len(res.etas) and np.any(np.isfinite(res.eigs)):
+                petas, peigs = res.etas, res.eigs
+            else:
+                petas, peigs = etas, np.full(len(etas), np.nan)
+            if np.isfinite(res.eta):
+                e_pk = res.eta
+            elif np.any(np.isfinite(peigs)):
+                e_pk = petas[np.nanargmax(peigs)]
+            else:
+                e_pk = petas.mean()
+            sel = np.abs(petas - e_pk) < self.fw * e_pk
+            fig = plot_func(dspec2, time2, freq2, CS, fd, tau, edges,
+                            res.eta, res.eta_sig, petas, peigs,
+                            petas[sel], res.popt,
+                            backend=self.backend)
+            if fname is not None:
+                fig.savefig(fname, bbox_inches="tight")
+            else:
+                import matplotlib.pyplot as plt
+
+                plt.show()
         if arrays:
             return res.etas, res.eigs, res.popt
         return res
@@ -1225,13 +1255,41 @@ class Dynspec:
         self.eta_evo_err = np.zeros((self.ncf_fit, self.nct_fit))
         self.f0s = np.zeros(self.ncf_fit)
         self.t0s = np.zeros(self.nct_fit)
-        for cf in range(self.ncf_fit):
-            for ct in range(self.nct_fit):
-                res = self.thetatheta_single(cf, ct, verbose=verbose)
-                self.eta_evo[cf, ct] = res.eta
-                self.eta_evo_err[cf, ct] = res.eta_sig
-                self.f0s[cf] = res.freq_mean
-                self.t0s[ct] = res.time_mean
+        if (self.backend != "numpy"
+                and self.thetatheta_proc != "thin"
+                and self.nct_fit > 1):
+            # all time-chunks of one frequency row share geometry →
+            # one batched device program per row (replaces the
+            # reference's pool.map chunk fan-out, dynspec.py:1715-1719)
+            for cf in range(self.ncf_fit):
+                chunks, tlist, freq2 = [], [], None
+                for ct in range(self.nct_fit):
+                    dspec2, freq2, time2 = self._chunk(cf, ct, fit=True)
+                    chunks.append(dspec2)
+                    tlist.append(time2)
+                etas = np.logspace(np.log10(self.eta_min),
+                                   np.log10(self.eta_max), self.neta) \
+                    * (self.fref / freq2.mean()) ** 2
+                edges = self.edges * (freq2.mean() / self.fref)
+                results = thth_search.multi_chunk_search(
+                    chunks, freq2, tlist, etas, edges, fw=self.fw,
+                    npad=self.npad,
+                    coher=(self.thetatheta_proc != "incoherent"),
+                    tau_mask=self.thth_tau_mask, backend=self.backend)
+                for ct, res in enumerate(results):
+                    self.eta_evo[cf, ct] = res.eta
+                    self.eta_evo_err[cf, ct] = res.eta_sig
+                    self.f0s[cf] = res.freq_mean
+                    self.t0s[ct] = res.time_mean
+        else:
+            for cf in range(self.ncf_fit):
+                for ct in range(self.nct_fit):
+                    res = self.thetatheta_single(cf, ct,
+                                                 verbose=verbose)
+                    self.eta_evo[cf, ct] = res.eta
+                    self.eta_evo_err[cf, ct] = res.eta_sig
+                    self.f0s[cf] = res.freq_mean
+                    self.t0s[ct] = res.time_mean
 
         f0s = self.f0s[:, None]
         if time_avg:

@@ -1,0 +1,158 @@
+"""Chunk-batched θ-θ curvature search.
+
+The reference fans `single_search` over an MPI/multiprocessing pool,
+one process per (frequency, time) chunk (dynspec.py:1715-1719,
+ththmod.py:717-718). On TPU the same work is one device program over
+the whole chunk batch, built around two hardware facts measured on
+v5e:
+
+1. **Gathers are index-bound, not byte-bound** (~10 ns/index + ~60 ms
+   fixed, regardless of element size). The θ-θ gather indices depend
+   only on (geometry, η) — *not* on the chunk — so laying the chunk
+   batch out as the contiguous minor axis lets one index fetch B
+   chunk-values as a contiguous slice: the 13M-index cost of a 200-η
+   search is paid once per *batch* instead of once per chunk
+   (~6.5× amortisation at B=16).
+
+2. **The eigensolve is latency-bound**, so consecutive η values —
+   whose θ-θ matrices differ slightly — warm-start each other: the
+   Pallas kernel carries the dominant eigenvector across sequential
+   grid steps in VMEM scratch and needs ~24 shifted power iterations
+   per η instead of ~2^10 from a cold seed (see pallas_eig.py).
+
+Geometry note: all time-chunks of one frequency row share (tau, fd,
+edges, etas) — frequency scaling enters only via the per-row edge/η
+rescale (dynspec.py:1693-1698) — so `fit_thetatheta` batches a full
+row at a time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core import th_cents_from_edges, unit_checks
+from ..backend import get_jax
+
+
+def _geometry(tau, fd, edges):
+    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    th_cents = th_cents_from_edges(edges_a)
+    return tau_a, fd_a, th_cents
+
+
+def make_multi_eval_fn(tau, fd, edges, iters=200, method="auto",
+                       squarings=10, warm_iters=24, interpret=False):
+    """Build ``fn(CS_ri_batch, etas) -> eigs`` where ``CS_ri_batch``
+    is (B, 2, ntau, nfd) float (real, imag) conjugate spectra sharing
+    one geometry and ``eigs`` is (B, neta).
+
+    method 'power' runs the vmapped power iteration (CPU-safe);
+    'pallas' (or 'auto' on TPU) runs the warm-start Pallas kernel.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    tau_a, fd_a, th_cents = _geometry(tau, fd, edges)
+    n_th = len(th_cents)
+    th1 = th_cents[None, :] * np.ones((n_th, 1))
+    th2 = th1.T
+    dtau = np.diff(tau_a).mean()
+    dfd = np.diff(fd_a).mean()
+    tril_mask = np.tril(np.ones((n_th, n_th))) > 0
+    anti_eye = np.eye(n_th)[::-1] > 0
+    # |θ| < fd_max/2 is η-independent; θ²η < τ_max applied per η below
+    half_valid = np.abs(th_cents) < np.abs(fd_a.max()) / 2
+
+    if method == "auto":
+        from .pallas_eig import pallas_available, pad_to_multiple
+
+        if pallas_available() and pad_to_multiple(n_th) <= 768:
+            method = "pallas"
+        else:
+            method = "power"
+
+    def build_batch(CS_ri, etas):
+        """(B, 2, ntau, nfd), (neta,) → θ-θ batch (neta, n, n, B)
+        complex, built with one chunk-minor gather."""
+        # chunk-minor complex layout: (ntau, nfd, B)
+        CS_c = jnp.transpose(CS_ri[:, 0] + 1j * CS_ri[:, 1], (1, 2, 0))
+
+        e = etas[:, None, None]
+        tau_inv = jnp.floor((e * (th1 ** 2 - th2 ** 2) - tau_a[0]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = np.floor(((th1 - th2) - fd_a[0] + dfd / 2)
+                          / dfd).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < len(tau_a))
+                & (fd_inv < len(fd_a))[None]
+                & (fd_inv >= -len(fd_a))[None])
+        # one gather, B contiguous values per index (ththmod.py:96-99
+        # semantics: negative fd_inv wraps)
+        vals = CS_c[jnp.where(pnts, tau_inv, 0),
+                    jnp.broadcast_to((fd_inv % len(fd_a))[None],
+                                     pnts.shape), :]
+        thth = jnp.where(pnts[..., None], vals, 0.0)
+        w = np.sqrt(np.abs(2 * (th2 - th1)))[None, ..., None] \
+            * jnp.sqrt(jnp.abs(etas))[:, None, None, None]
+        thth = thth * w
+        # hermitian symmetrisation (ththmod.py:109-114)
+        thth = jnp.where(jnp.asarray(tril_mask)[None, ..., None], 0.0,
+                         thth)
+        thth = thth + jnp.conj(jnp.transpose(thth, (0, 2, 1, 3)))
+        thth = jnp.where(jnp.asarray(anti_eye)[None, ..., None], 0.0,
+                         thth)
+        thth = jnp.nan_to_num(thth)
+        valid = ((jnp.asarray(th_cents)[None, :] ** 2 * etas[:, None]
+                  < np.abs(tau_a.max()))
+                 & jnp.asarray(half_valid)[None, :])
+        thth = (thth * valid[:, None, :, None]
+                * valid[:, :, None, None])
+        return thth
+
+    if method == "power":
+        from .core import dominant_eig_power
+
+        def fn(CS_ri, etas):
+            thth = build_batch(CS_ri, etas)         # (neta, n, n, B)
+            flat = jnp.transpose(thth, (0, 3, 1, 2))
+
+            def one(A):
+                lam, _ = dominant_eig_power(A, iters=iters,
+                                            backend="jax")
+                return jnp.abs(lam)
+
+            eigs = jax.vmap(jax.vmap(one))(flat)    # (neta, B)
+            return jnp.transpose(eigs)
+
+        return fn
+
+    if method not in ("pallas", "square"):
+        raise ValueError(f"unknown method {method!r}")
+
+    from .pallas_eig import (batched_eig_squaring_xla,
+                             batched_eig_warmstart, pad_to_multiple)
+
+    n_pad = pad_to_multiple(n_th)
+
+    def fn(CS_ri, etas):
+        thth = build_batch(CS_ri, etas)             # (neta, n, n, B)
+        # (B, neta, 2, N, N) float for the kernel, chunk-major so the
+        # warm-start carry walks the η axis within each chunk
+        a = jnp.transpose(thth, (3, 0, 1, 2))
+        a_ri = jnp.stack([a.real, a.imag], axis=2).astype(jnp.float32)
+        a_ri = jnp.pad(a_ri, ((0, 0), (0, 0), (0, 0),
+                              (0, n_pad - n_th), (0, n_pad - n_th)))
+        if method == "square":
+            B = a_ri.shape[0]
+            flat = a_ri.reshape((-1,) + a_ri.shape[2:])
+            lam = batched_eig_squaring_xla(
+                flat, n_th // 2, squarings=squarings).reshape(B, -1)
+        else:
+            lam = batched_eig_warmstart(a_ri, n_th // 2,
+                                        squarings=squarings,
+                                        iters=warm_iters,
+                                        interpret=interpret)
+        return jnp.abs(lam)
+
+    return fn

@@ -65,8 +65,15 @@ def th_cents_from_edges(edges):
 
 
 def thth_map(CS, tau, fd, eta, edges, hermetian=True, backend=None):
-    """Conjugate spectrum → θ-θ matrix (gather; ththmod.py:56-116)."""
-    backend = resolve_backend(backend)
+    """Conjugate spectrum → θ-θ matrix (gather; ththmod.py:56-116).
+
+    Eager helper: complex arrays cross the host↔device boundary here,
+    so 'jax' resolves to numpy on devices that cannot transfer complex
+    buffers (backend.eager_backend). The jitted search path is
+    make_eval_fn."""
+    from ..backend import eager_backend
+
+    backend = eager_backend(backend)
     xp = get_xp(backend)
     tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
     fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
@@ -130,8 +137,11 @@ def thth_redmap(CS, tau, fd, eta, edges, hermetian=True, backend=None):
 
 def rev_map(thth, tau, fd, eta, edges, hermetian=True, backend=None):
     """θ-θ → conjugate spectrum via weighted histogram scatter
-    (ththmod.py:176-271). Returns CS[tau, fd]."""
-    backend = resolve_backend(backend)
+    (ththmod.py:176-271). Returns CS[tau, fd]. Eager helper — see
+    thth_map on complex-transfer safety."""
+    from ..backend import eager_backend
+
+    backend = eager_backend(backend)
     tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
     fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
     eta = float(unit_checks(eta, "eta"))
@@ -234,8 +244,12 @@ def dominant_eig_power(A, iters=200, backend=None):
 
 def eval_calc(CS, tau, fd, eta, edges, backend=None):
     """Dominant eigenvalue of the reduced θ-θ at curvature η
-    (ththmod.py:371-401)."""
-    backend = resolve_backend(backend)
+    (ththmod.py:371-401). Eager helper — see thth_map on
+    complex-transfer safety; the jitted grid search is
+    eval_calc_batch/make_eval_fn."""
+    from ..backend import eager_backend
+
+    backend = eager_backend(backend)
     thth_red, _ = thth_redmap(CS, tau, fd, eta, edges, backend=backend)
     if backend == "numpy":
         lam, _ = _dominant_eig_numpy(thth_red)
@@ -253,11 +267,24 @@ def cs_to_ri(CS, xp=np):
     return xp.stack([CS.real, CS.imag])
 
 
-def make_eval_fn(tau, fd, edges, iters=200):
+def make_eval_fn(tau, fd, edges, iters=200, method="power", squarings=10,
+                 interpret=False):
     """Build the pure-jax batched eigenvalue kernel
     ``fn(CS_ri, etas) → eigs``: a vmap over the η grid with masked
     fixed-shape θ-θ matrices instead of per-η crops, so one jit serves
     every η (and shards over the η axis under pjit — see parallel/).
+
+    ``method`` selects the eigen-solver stage:
+
+    - ``'power'``: vmapped shifted power iteration (``iters`` matvecs;
+      HBM-bound — every matrix is re-read each iteration).
+    - ``'square'``: repeated matrix squaring (``squarings`` in-place
+      MXU matmuls ≈ 2^squarings power iterations) in plain XLA.
+    - ``'pallas'``: the same squaring algorithm as a Pallas TPU kernel
+      with the matrix resident in VMEM (thth/pallas_eig.py) — each
+      matrix crosses HBM exactly once.
+    - ``'auto'``: 'pallas' on TPU when the padded matrix fits VMEM,
+      else 'power'.
 
     ``CS_ri`` is the conjugate spectrum as a *float* array of shape
     ``(2, ntau, nfd)`` holding (real, imag): complex arrays must never
@@ -271,75 +298,58 @@ def make_eval_fn(tau, fd, edges, iters=200):
     Geometry (tau/fd/edges) is baked in host-side; CS_ri and etas are
     traced arguments. Used by :func:`eval_calc_batch`, the sharded
     η-search in parallel/, and the driver entry point.
+
+    Thin wrapper over the chunk-batched builder with B=1 — the θ-θ
+    build/symmetrise/mask semantics live in exactly one place
+    (thth/batch.py: build_batch).
     """
-    jax = get_jax()
-    import jax.numpy as jnp
+    from .batch import make_multi_eval_fn
 
-    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
-    fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
-    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
-    th_cents = th_cents_from_edges(edges_a)
-    n_th = len(th_cents)
-    th1 = th_cents[None, :] * np.ones((n_th, 1))
-    th2 = th1.T
-    dtau = np.diff(tau_a).mean()
-    dfd = np.diff(fd_a).mean()
-    tril_mask = np.tril(np.ones((n_th, n_th))) > 0
-    anti_eye = np.eye(n_th)[::-1] > 0
+    multi = make_multi_eval_fn(tau, fd, edges, iters=iters,
+                               method=method, squarings=squarings,
+                               interpret=interpret)
 
-    def one_eta(CS_ri, eta):
-        CS_j = CS_ri[0] + 1j * CS_ri[1]
-        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau_a[0]
-                             + dtau / 2) / dtau).astype(int)
-        fd_inv = jnp.floor(((th1 - th2) - fd_a[0] + dfd / 2)
-                           / dfd).astype(int)
-        pnts = ((tau_inv > 0) & (tau_inv < len(tau_a))
-                & (fd_inv < len(fd_a)) & (fd_inv >= -len(fd_a)))
-        # negative fd_inv wraps (numpy semantics, kept by the reference)
-        vals = CS_j[jnp.where(pnts, tau_inv, 0),
-                    jnp.where(pnts, fd_inv % len(fd_a), 0)]
-        thth = jnp.where(pnts, vals, 0.0)
-        thth = thth * jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
-        # hermitian symmetrisation (ththmod.py:109-114)
-        thth = jnp.where(jnp.asarray(tril_mask), 0.0, thth)
-        thth = thth + jnp.conj(thth.T)
-        thth = thth - jnp.diag(jnp.diag(thth))
-        thth = jnp.where(jnp.asarray(anti_eye), 0.0, thth)
-        thth = jnp.nan_to_num(thth)
-        # mask instead of crop: zeroed rows/cols keep the top eigenvalue
-        valid = ((jnp.asarray(th_cents) ** 2 * eta
-                  < jnp.abs(tau_a.max()))
-                 & (jnp.abs(jnp.asarray(th_cents))
-                    < jnp.abs(fd_a.max()) / 2))
-        thth = thth * valid[None, :] * valid[:, None]
-        lam, _ = dominant_eig_power(thth, iters=iters, backend="jax")
-        return jnp.abs(lam)
+    def fn(CS_ri, etas):
+        return multi(CS_ri[None], etas)[0]
 
-    return jax.vmap(one_eta, in_axes=(None, 0))
+    return fn
 
 
 # jax.jit caches on function identity, so jitting a fresh make_eval_fn
 # closure per call would retrace every chunk; key the compiled kernel
 # on the geometry instead (fit_thetatheta reuses one geometry across
 # all time-chunks of a frequency row).
-_EVAL_JIT_CACHE = {}
-_EVAL_JIT_CACHE_MAX = 32
-
-
-def _jitted_eval_fn(tau, fd, edges, iters):
-    key = (tau.tobytes(), fd.tobytes(), edges.tobytes(), int(iters))
-    fn = _EVAL_JIT_CACHE.get(key)
+def keyed_jit_cache(cache, key, builder, maxsize=32):
+    """FIFO-bounded cache of jitted kernels keyed on geometry bytes.
+    Shared by the per-chunk and chunk-batched search paths."""
+    fn = cache.get(key)
     if fn is None:
-        fn = get_jax().jit(make_eval_fn(tau, fd, edges, iters=iters))
-        if len(_EVAL_JIT_CACHE) >= _EVAL_JIT_CACHE_MAX:
-            _EVAL_JIT_CACHE.pop(next(iter(_EVAL_JIT_CACHE)))
-        _EVAL_JIT_CACHE[key] = fn
+        fn = get_jax().jit(builder())
+        if len(cache) >= maxsize:
+            cache.pop(next(iter(cache)))
+        cache[key] = fn
     return fn
 
 
-def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
+_EVAL_JIT_CACHE = {}
+
+
+def _jitted_eval_fn(tau, fd, edges, iters, method="power"):
+    key = (tau.tobytes(), fd.tobytes(), edges.tobytes(), int(iters),
+           method)
+    return keyed_jit_cache(
+        _EVAL_JIT_CACHE, key,
+        lambda: make_eval_fn(tau, fd, edges, iters=iters, method=method))
+
+
+def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None,
+                    method="auto"):
     """Batched eigenvalue-vs-η curve: one jitted vmap over the η grid
-    on jax (the reference's python loop, ththmod.py:789-799)."""
+    on jax (the reference's python loop, ththmod.py:789-799).
+
+    ``method='auto'`` uses the VMEM-resident Pallas squaring kernel on
+    TPU (see :func:`make_eval_fn`) and the power iteration elsewhere.
+    """
     backend = resolve_backend(backend)
     etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
     if backend == "numpy":
@@ -357,7 +367,7 @@ def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None):
     tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
     fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
     edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
-    fn = _jitted_eval_fn(tau_a, fd_a, edges_a, iters)
+    fn = _jitted_eval_fn(tau_a, fd_a, edges_a, iters, method=method)
     return np.asarray(fn(jnp.asarray(cs_to_ri(CS)), jnp.asarray(etas)))
 
 
@@ -368,7 +378,9 @@ def modeler(CS, tau, fd, eta, edges, hermetian=True, backend=None):
                                       hermetian=hermetian,
                                       backend=backend)
     if hermetian:
-        if resolve_backend(backend) == "numpy":
+        from ..backend import eager_backend
+
+        if eager_backend(backend) == "numpy":
             w, V = _dominant_eig_numpy(thth_red, v0_seed=False)
         else:
             lam, V = dominant_eig_power(thth_red, backend=backend)

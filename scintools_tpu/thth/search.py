@@ -136,6 +136,74 @@ def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
                              eigs=eigs_c, etas=etas_c, popt=popt)
 
 
+_MULTI_JIT_CACHE = {}
+
+
+def _jitted_multi_eval(tau, fd, edges, method):
+    from .batch import make_multi_eval_fn
+    from .core import keyed_jit_cache
+
+    key = (tau.tobytes(), fd.tobytes(), edges.tobytes(), method)
+    return keyed_jit_cache(
+        _MULTI_JIT_CACHE, key,
+        lambda: make_multi_eval_fn(tau, fd, edges, method=method),
+        maxsize=16)
+
+
+def multi_chunk_search(dspecs, freq, times, etas, edges, fw=0.1, npad=3,
+                       coher=True, tau_mask=0.0, backend=None,
+                       method="auto"):
+    """Curvature search on a batch of same-geometry chunks in one
+    device program.
+
+    Replaces the reference's pool.map over per-chunk `single_search`
+    calls (dynspec.py:1715-1719) for chunks sharing (freq, dt, shape)
+    — e.g. all time-chunks of one frequency row. The batched kernel
+    amortises the η-grid gather across the chunk batch and warm-starts
+    the eigensolver along η (thth/batch.py).
+
+    dspecs : list of (nf, nt) chunk arrays; times : list of per-chunk
+    time axes (same spacing). Returns a list of ChunkSearchResult.
+    """
+    backend = resolve_backend(backend)
+    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+    if backend == "numpy" or len(dspecs) == 1:
+        return [single_search(d, freq, t, etas, edges, fw=fw, npad=npad,
+                              coher=coher, tau_mask=tau_mask,
+                              backend=backend)
+                for d, t in zip(dspecs, times)]
+
+    import jax.numpy as jnp
+
+    from .core import cs_to_ri
+
+    cs_ri = []
+    tau = fd = None
+    for d, t in zip(dspecs, times):
+        CS, tau, fd = chunk_conjugate_spectrum(d, t, freq, npad=npad,
+                                               tau_mask=tau_mask)
+        base = CS if coher else np.abs(CS)
+        cs_ri.append(cs_to_ri(base).astype(np.float32))
+    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    fn = _jitted_multi_eval(tau, fd, edges_a, method)
+    eigs_all = np.asarray(fn(jnp.asarray(np.stack(cs_ri)),
+                             jnp.asarray(etas)))
+
+    freq_m = float(np.asarray(unit_checks(freq, "freq"),
+                              dtype=float).mean())
+    out = []
+    for b, t in enumerate(times):
+        eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
+            etas, eigs_all[b], fw=fw, full=True)
+        t_a = np.asarray(unit_checks(t, "time"), dtype=float)
+        out.append(ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
+                                     freq_mean=freq_m,
+                                     time_mean=float(t_a.mean()),
+                                     eigs=eigs_c, etas=etas_c,
+                                     popt=popt))
+    return out
+
+
 def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
                        centerCut, fw=0.1, npad=3, coher=True,
                        tau_mask=0.0, verbose=False, backend=None):

@@ -1,0 +1,128 @@
+"""Tests for the Pallas/squaring eigenvalue kernels (thth/pallas_eig.py).
+
+The Pallas kernel runs in interpret mode on CPU; on real TPU the same
+code path compiles via Mosaic (exercised by bench.py / the driver).
+"""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.thth.pallas_eig import (batched_eig_pallas,
+                                           batched_eig_squaring_xla,
+                                           pack_padded, pad_to_multiple)
+
+
+def _random_hermitian(rng, n, batch):
+    a = (rng.normal(size=(batch, n, n))
+         + 1j * rng.normal(size=(batch, n, n)))
+    return (a + np.conj(np.transpose(a, (0, 2, 1)))) / 2
+
+
+def _eigsh_top(mats):
+    return np.array([np.linalg.eigvalsh(m)[-1] for m in mats])
+
+
+class TestSquaringXLA:
+    def test_matches_dense_eigh(self, rng):
+        import jax.numpy as jnp
+
+        n, batch = 48, 6
+        mats = _random_hermitian(rng, n, batch)
+        a_ri = pack_padded(mats, n)
+        lam = np.asarray(batched_eig_squaring_xla(jnp.asarray(a_ri),
+                                                  n // 2))
+        np.testing.assert_allclose(lam, _eigsh_top(mats), rtol=2e-4)
+
+    def test_padding_does_not_change_eigenvalue(self, rng):
+        import jax.numpy as jnp
+
+        n, batch = 30, 3
+        mats = _random_hermitian(rng, n, batch)
+        a_ri = pack_padded(mats, n)          # pads 30 → 128
+        assert a_ri.shape[-1] == pad_to_multiple(n) == 128
+        lam = np.asarray(batched_eig_squaring_xla(jnp.asarray(a_ri),
+                                                  n // 2))
+        np.testing.assert_allclose(lam, _eigsh_top(mats), rtol=2e-4)
+
+    def test_zero_matrix_gives_zero(self):
+        import jax.numpy as jnp
+
+        a_ri = jnp.zeros((2, 2, 128, 128), dtype=jnp.float32)
+        lam = np.asarray(batched_eig_squaring_xla(a_ri, 64))
+        np.testing.assert_allclose(lam, 0.0, atol=1e-6)
+
+
+class TestPallasInterpret:
+    def test_matches_xla_squaring(self, rng):
+        import jax.numpy as jnp
+
+        n, batch = 40, 4
+        mats = _random_hermitian(rng, n, batch)
+        a_ri = jnp.asarray(pack_padded(mats, n))
+        lam_p = np.asarray(batched_eig_pallas(a_ri, n // 2,
+                                              interpret=True))
+        lam_x = np.asarray(batched_eig_squaring_xla(a_ri, n // 2))
+        np.testing.assert_allclose(lam_p, lam_x, rtol=1e-5)
+        np.testing.assert_allclose(lam_p, _eigsh_top(mats), rtol=2e-4)
+
+
+class TestEvalFnMethods:
+    @pytest.fixture(scope="class")
+    def workload(self):
+        from scintools_tpu.thth.core import fft_axis
+
+        rng = np.random.default_rng(7)
+        nf = nt = 32
+        dyn = rng.normal(size=(nf, nt)) ** 2
+        npad = 1
+        times = np.arange(nt) * 2.0
+        freqs = 1400.0 + np.arange(nf) * 0.05
+        fd = fft_axis(times, pad=npad, scale=1e3)
+        tau = fft_axis(freqs, pad=npad, scale=1.0)
+        CS = np.fft.fftshift(np.fft.fft2(
+            np.pad(dyn, ((0, npad * nf), (0, npad * nt)),
+                   constant_values=dyn.mean())))
+        eta_c = tau.max() / (fd.max() / 4) ** 2
+        etas = np.linspace(0.5 * eta_c, 2.0 * eta_c, 12)
+        edges = np.linspace(-fd.max() / 2, fd.max() / 2, 32)
+        return CS, tau, fd, etas, edges
+
+    def test_square_matches_power(self, workload):
+        import jax.numpy as jnp
+
+        from scintools_tpu.thth.core import cs_to_ri, make_eval_fn
+
+        CS, tau, fd, etas, edges = workload
+        cs_ri = jnp.asarray(cs_to_ri(CS))
+        e_j = jnp.asarray(etas)
+        e_pow = np.asarray(make_eval_fn(tau, fd, edges,
+                                        iters=400)(cs_ri, e_j))
+        e_sq = np.asarray(make_eval_fn(tau, fd, edges, method="square",
+                                       squarings=9)(cs_ri, e_j))
+        np.testing.assert_allclose(e_sq, e_pow, rtol=1e-3)
+
+    def test_pallas_interpret_matches_power(self, workload):
+        import jax.numpy as jnp
+
+        from scintools_tpu.thth.core import cs_to_ri, make_eval_fn
+
+        CS, tau, fd, etas, edges = workload
+        cs_ri = jnp.asarray(cs_to_ri(CS))
+        e_j = jnp.asarray(etas)
+        e_pow = np.asarray(make_eval_fn(tau, fd, edges,
+                                        iters=400)(cs_ri, e_j))
+        e_pal = np.asarray(make_eval_fn(tau, fd, edges, method="pallas",
+                                        squarings=9,
+                                        interpret=True)(cs_ri, e_j))
+        np.testing.assert_allclose(e_pal, e_pow, rtol=2e-3)
+
+    def test_auto_resolves_on_cpu(self, workload):
+        import jax.numpy as jnp
+
+        from scintools_tpu.thth.core import cs_to_ri, make_eval_fn
+
+        CS, tau, fd, etas, edges = workload
+        fn = make_eval_fn(tau, fd, edges, method="auto")
+        eigs = np.asarray(fn(jnp.asarray(cs_to_ri(CS)),
+                             jnp.asarray(etas)))
+        assert np.all(np.isfinite(eigs))

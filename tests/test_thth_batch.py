@@ -1,0 +1,119 @@
+"""Chunk-batched θ-θ search (thth/batch.py) vs the per-chunk path."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.thth.core import (cs_to_ri, eval_calc_batch,
+                                     fft_axis)
+from scintools_tpu.thth.batch import make_multi_eval_fn
+
+
+def _workload(nchunk=3, nf=32, nt=32, neta=12, seed=9):
+    rng = np.random.default_rng(seed)
+    npad = 1
+    times = np.arange(nt) * 2.0
+    freqs = 1400.0 + np.arange(nf) * 0.05
+    fd = fft_axis(times, pad=npad, scale=1e3)
+    tau = fft_axis(freqs, pad=npad, scale=1.0)
+    CS_list = []
+    for _ in range(nchunk):
+        dyn = rng.normal(size=(nf, nt)) ** 2
+        CS_list.append(np.fft.fftshift(np.fft.fft2(
+            np.pad(dyn, ((0, npad * nf), (0, npad * nt)),
+                   constant_values=dyn.mean()))))
+    eta_c = tau.max() / (fd.max() / 4) ** 2
+    etas = np.linspace(0.5 * eta_c, 2.0 * eta_c, neta)
+    edges = np.linspace(-fd.max() / 2, fd.max() / 2, 32)
+    return CS_list, tau, fd, etas, edges
+
+
+class TestMultiEval:
+    def test_power_matches_per_chunk(self):
+        import jax.numpy as jnp
+
+        CS_list, tau, fd, etas, edges = _workload()
+        fn = make_multi_eval_fn(tau, fd, edges, iters=400,
+                                method="power")
+        batch = jnp.asarray(np.stack([cs_to_ri(c) for c in CS_list]))
+        eigs = np.asarray(fn(batch, jnp.asarray(etas)))
+        assert eigs.shape == (len(CS_list), len(etas))
+        for b, CS in enumerate(CS_list):
+            ref = eval_calc_batch(CS, tau, fd, etas, edges, iters=400,
+                                  backend="jax", method="power")
+            np.testing.assert_allclose(eigs[b], ref, rtol=1e-3)
+
+    def test_power_matches_numpy_eigsh(self):
+        import jax.numpy as jnp
+
+        CS_list, tau, fd, etas, edges = _workload(nchunk=2)
+        fn = make_multi_eval_fn(tau, fd, edges, iters=400,
+                                method="power")
+        batch = jnp.asarray(np.stack([cs_to_ri(c) for c in CS_list]))
+        eigs = np.asarray(fn(batch, jnp.asarray(etas)))
+        for b, CS in enumerate(CS_list):
+            ref = eval_calc_batch(CS, tau, fd, etas, edges,
+                                  backend="numpy")
+            np.testing.assert_allclose(eigs[b], ref, rtol=2e-3)
+
+    def test_multi_chunk_search_matches_single(self):
+        from scintools_tpu.thth.search import (multi_chunk_search,
+                                               single_search)
+
+        rng = np.random.default_rng(11)
+        nf = nt = 32
+        freqs = 1400.0 + np.arange(nf) * 0.05
+        chunks, tlist = [], []
+        for b in range(3):
+            chunks.append(rng.normal(size=(nf, nt)) ** 2)
+            tlist.append((b * nt + np.arange(nt)) * 2.0)
+        fd_max = 1e3 / (2 * 2.0)
+        eta_c = (1 / (2 * 0.05)) / (fd_max / 4) ** 2
+        etas = np.linspace(0.5 * eta_c, 2 * eta_c, 16)
+        edges = np.linspace(-fd_max / 2, fd_max / 2, 32)
+        batched = multi_chunk_search(chunks, freqs, tlist, etas, edges,
+                                     npad=1, backend="jax",
+                                     method="power")
+        for b in range(3):
+            single = single_search(chunks[b], freqs, tlist[b], etas,
+                                   edges, npad=1, backend="jax")
+            np.testing.assert_allclose(batched[b].eigs, single.eigs,
+                                       rtol=1e-3)
+            assert batched[b].time_mean == single.time_mean
+
+    def test_fit_thetatheta_batched_row(self):
+        import sys, os
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_thth import make_arc_wavefield, ETA_TRUE
+        from scintools_tpu.dynspec import Dynspec, BasicDyn
+
+        E, times, freqs = make_arc_wavefield(nt=256, nf=128)
+        bd = BasicDyn(np.abs(E) ** 2, name="arcsim", times=times,
+                      freqs=freqs, mjd=60000)
+        d = Dynspec(dyn=bd, verbose=False, process=False)
+        d.backend = "jax"
+        d.prep_thetatheta(cwf=128, cwt=128, eta_min=0.1, eta_max=0.9,
+                          nedge=64, edges_lim=2.6, npad=1)
+        assert d.nct_fit == 2          # exercises the batched row path
+        d.fit_thetatheta()
+        eta_batched = d.ththeta
+        assert eta_batched == pytest.approx(ETA_TRUE, rel=0.3)
+        # same fit through the per-chunk loop (numpy backend)
+        d2 = Dynspec(dyn=bd, verbose=False, process=False)
+        d2.backend = "numpy"
+        d2.prep_thetatheta(cwf=128, cwt=128, eta_min=0.1, eta_max=0.9,
+                           nedge=64, edges_lim=2.6, npad=1)
+        d2.fit_thetatheta()
+        assert eta_batched == pytest.approx(d2.ththeta, rel=0.05)
+
+    def test_warmstart_pallas_interpret(self):
+        import jax.numpy as jnp
+
+        CS_list, tau, fd, etas, edges = _workload(nchunk=2, neta=10)
+        fn_p = make_multi_eval_fn(tau, fd, edges, method="pallas",
+                                  warm_iters=64, interpret=True)
+        fn_ref = make_multi_eval_fn(tau, fd, edges, iters=600,
+                                    method="power")
+        batch = jnp.asarray(np.stack([cs_to_ri(c) for c in CS_list]))
+        e_p = np.asarray(fn_p(batch, jnp.asarray(etas)))
+        e_r = np.asarray(fn_ref(batch, jnp.asarray(etas)))
+        np.testing.assert_allclose(e_p, e_r, rtol=2e-3)
