@@ -369,19 +369,32 @@ def simulate_dynspec_batch(nscreens, mb2=2, rf=1, ds=0.01, alpha=5 / 3,
         1.0 / (1.0 + dlam * (-0.5 + np.arange(nf) / nf)))
     column = int(np.floor(ns / 2))
 
-    def one(key):
-        k1, k2 = jax.random.split(key)
-        xyp = jnp.real(jnp.fft.fft2(
-            w * (jax.random.normal(k1, (ns, ns))
-                 + 1j * jax.random.normal(k2, (ns, ns)))))
+    def screens(keys):
+        k1, k2 = jax.vmap(jax.random.split, out_axes=1)(keys)
+        noise = (jax.vmap(jax.random.normal, in_axes=(0, None))(
+                     k1, (ns, ns))
+                 + 1j * jax.vmap(jax.random.normal, in_axes=(0, None))(
+                     k2, (ns, ns)))
+        return jnp.real(jnp.fft.fft2(w[None] * noise))
 
-        def one_freq(scale):
-            xye = jnp.fft.ifft2(jnp.fft.fft2(jnp.exp(1j * xyp * scale))
-                                * jnp.exp(-1j * q2 * scale))
-            return xye[:, column]
+    def one_freq(xyp, scale):
+        xye = jnp.fft.ifft2(
+            jnp.fft.fft2(jnp.exp(1j * xyp * scale))
+            * jnp.exp(-1j * q2 * scale)[None])
+        return xye[:, :, column]
 
-        spe = jax.vmap(one_freq, out_axes=1)(scales)
+    def propagate_batch(xyp):
+        # screens stay the (large, MXU-friendly) batch axis; the
+        # frequency loop is a sequential lax.map — vmapping both axes
+        # materialises (nscreens, nf, ns, ns) FFT temporaries (several
+        # multi-GB complex64 buffers at config-#4 sizes; observed 24 GB
+        # total on a 16 GB chip) and OOMs HBM
+        spe = jax.lax.map(lambda s: one_freq(xyp, s), scales)
+        return jnp.transpose(spe, (1, 2, 0))      # (B, ns, nf)
+
+    def run(keys):
+        spe = propagate_batch(screens(keys))
         return jnp.real(spe * jnp.conj(spe))
 
     keys = jax.random.split(jax.random.PRNGKey(seed), nscreens)
-    return jax.jit(jax.vmap(one))(keys)
+    return jax.jit(run)(keys)
