@@ -1131,6 +1131,8 @@ class Dynspec:
 
         l0, l1 = np.log10(self.eta_min), np.log10(self.eta_max)
         self.neta = int(1 + (l1 - l0) / np.log10(1 + self.fw / 10))
+        if "neta" in kwargs:          # explicit η-grid size override
+            self.neta = int(kwargs["neta"])
 
         if self.thetatheta_proc == "thin":
             fd_cut = fd.max() * (self.fref / self.freqs.max())

@@ -1,0 +1,9 @@
+"""Fitting layer: residual models, least squares, ensemble MCMC
+(scint_models.py re-design)."""
+
+from .parameters import Parameters
+from .fitter import fitter, minimize_leastsq, sample_emcee
+from . import models
+
+__all__ = ["Parameters", "fitter", "minimize_leastsq", "sample_emcee",
+           "models"]

@@ -27,7 +27,7 @@ class MinimizerResult:
     chisqr, redchi, nfev, success, plus flatchain for MCMC."""
 
     def __init__(self, params, residual=None, success=True, nfev=0,
-                 message=""):
+                 message="", nextra_vary=0):
         self.params = params
         self.residual = residual
         self.success = success
@@ -35,7 +35,10 @@ class MinimizerResult:
         self.message = message
         if residual is not None:
             self.chisqr = float(np.sum(np.square(residual)))
-            nvary = len(params.varying_names())
+            # nextra_vary counts sampled nuisance parameters that live
+            # outside ``params`` (the __lnsigma noise term), so redchi
+            # uses the same dof as lmfit
+            nvary = len(params.varying_names()) + nextra_vary
             self.nfree = max(len(np.ravel(residual)) - nvary, 1)
             self.redchi = self.chisqr / self.nfree
         self.flatchain = None
@@ -111,15 +114,24 @@ def minimize_leastsq(model, params, args=(), max_nfev=None,
 
 
 def _log_prob(model, params, args, x, lo, hi, is_weighted=True):
+    """lmfit ``Minimizer.emcee`` likelihood semantics: with
+    is_weighted=True the residuals are assumed pre-scaled by 1/σ and
+    lnL = -½Σr²; with is_weighted=False the last element of ``x`` is a
+    ``__lnsigma`` nuisance noise parameter (lmfit docs behaviour)."""
     if np.any(x < lo) or np.any(x > hi):
         return -np.inf
+    if not is_weighted:
+        x, lnsigma = x[:-1], x[-1]
     try:
         r = _residual_vector(model, params.with_values(x), args)
     except Exception:
         return -np.inf
     if not np.all(np.isfinite(r)):
         return -np.inf
-    return -0.5 * float(np.sum(r * r))
+    if is_weighted:
+        return -0.5 * float(np.sum(r * r))
+    s2 = np.exp(2.0 * lnsigma)
+    return -0.5 * float(np.sum(r * r / s2 + np.log(2 * np.pi * s2)))
 
 
 def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
@@ -131,9 +143,15 @@ def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
     rng = np.random.default_rng(None if seed is None else seed)
     params = params.copy()
     names = params.varying_names()
-    ndim = len(names)
     lo, hi = params.varying_bounds()
     x0 = params.varying_values()
+    if not is_weighted:
+        # lmfit parity: sample a __lnsigma noise nuisance parameter
+        names = names + ["__lnsigma"]
+        lo = np.append(lo, -np.inf)
+        hi = np.append(hi, np.inf)
+        x0 = np.append(x0, np.log(0.1))
+    ndim = len(names)
 
     if pos is None:
         scale = np.where(np.isfinite(hi - lo), (hi - lo) * 1e-2,
@@ -143,8 +161,18 @@ def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
     else:
         pos = np.array(pos, dtype=float)
         nwalkers = pos.shape[0]
+        if not is_weighted and pos.shape[1] == ndim - 1:
+            # caller supplied walkers for the model parameters only —
+            # append the __lnsigma column ourselves
+            lns = np.log(0.1) + 1e-4 * rng.standard_normal((nwalkers, 1))
+            pos = np.concatenate([pos, lns], axis=1)
+        if pos.shape[1] != ndim:
+            raise ValueError(
+                f"pos has {pos.shape[1]} columns, expected {ndim} "
+                f"({names})")
 
-    logp = np.array([_log_prob(model, params, args, p, lo, hi)
+    logp = np.array([_log_prob(model, params, args, p, lo, hi,
+                               is_weighted=is_weighted)
                      for p in pos])
     nburn = int(burn * steps) if burn < 1 else int(burn)
     chain = []
@@ -158,7 +186,8 @@ def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
             partners = rng.choice(other, size=len(idx))
             prop = pos[partners] + z[:, None] * (pos[idx] - pos[partners])
             logp_prop = np.array([
-                _log_prob(model, params, args, p, lo, hi) for p in prop])
+                _log_prob(model, params, args, p, lo, hi,
+                          is_weighted=is_weighted) for p in prop])
             log_accept = (ndim - 1) * np.log(z) + logp_prop - logp[idx]
             accept = np.log(rng.random(len(idx))) < log_accept
             pos[idx[accept]] = prop[accept]
@@ -171,10 +200,14 @@ def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
     flat = (np.array(chain).reshape(-1, ndim) if chain
             else pos.reshape(-1, ndim))
     for i, name in enumerate(names):
+        if name == "__lnsigma":
+            continue
         params[name].value = float(np.median(flat[:, i]))
         params[name].stderr = float(np.std(flat[:, i]))
     res = _residual_vector(model, params, args)
-    result = MinimizerResult(params, residual=res, nfev=nwalkers * steps)
+    result = MinimizerResult(params, residual=res,
+                             nfev=nwalkers * steps,
+                             nextra_vary=0 if is_weighted else 1)
     result.flatchain = flat
     result.var_names = names
     return result

@@ -1,0 +1,10 @@
+"""I/O: psrflux dynamic spectra, tempo2 .par files, results CSV,
+FITS."""
+
+from .psrflux import load_psrflux, write_psrflux
+from .parfile import read_par, pars_to_params
+from .results import write_results, read_results, float_array_from_dict
+
+__all__ = ["load_psrflux", "write_psrflux", "read_par",
+           "pars_to_params", "write_results", "read_results",
+           "float_array_from_dict"]

@@ -1,0 +1,13 @@
+"""Array-transform kernels: secondary spectra, ACFs, windows,
+rescaling, normalised sspec, arc fitting, inpainting."""
+
+from .sspec import secondary_spectrum, secondary_spectrum_power
+from .acf import autocovariance, acf_from_sspec, autocorr_direct
+from .windows import get_window
+from .fitarc import fit_arc, ArcFit
+from .normsspec import normalise_sspec
+from .inpaint import inpaint_biharmonic
+
+__all__ = ["secondary_spectrum", "secondary_spectrum_power",
+           "autocovariance", "acf_from_sspec", "autocorr_direct", "get_window", "fit_arc", "ArcFit",
+           "normalise_sspec", "inpaint_biharmonic"]

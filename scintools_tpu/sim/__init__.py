@@ -1,0 +1,8 @@
+"""Simulation subpackage: Coles-2010 EM simulation, Rickett-2014
+analytic ACF, Yao-2020 brightness (scint_sim.py re-design)."""
+
+from .simulation import Simulation, simulate_dynspec_batch
+from .acf_model import ACF
+from .brightness import Brightness
+
+__all__ = ["Simulation", "simulate_dynspec_batch", "ACF", "Brightness"]

@@ -1,0 +1,28 @@
+"""θ-θ transform subpackage (ththmod.py re-design): forward/inverse
+maps, batched eigenvalue curvature search (Pallas on TPU), chunked
+phase retrieval, mosaic stitching and refinement."""
+
+from .core import (thth_map, thth_redmap, rev_map, modeler, eval_calc,
+                   eval_calc_batch, make_eval_fn, chisq_calc,
+                   two_curve_map, singularvalue_calc, min_edges,
+                   arc_edges, len_arc, ext_find, fft_axis, cs_to_ri,
+                   unit_checks)
+from .batch import make_multi_eval_fn
+from .search import (single_search, single_search_thin,
+                     multi_chunk_search, fit_eig_peak, chi_par)
+from .retrieval import (single_chunk_retrieval, vlbi_chunk_retrieval,
+                        mosaic, refine_mosaic, gerchberg_saxton,
+                        calc_asymmetry, mask_func, err_string)
+from .plots import plot_func
+
+__all__ = [
+    "thth_map", "thth_redmap", "rev_map", "modeler", "eval_calc",
+    "eval_calc_batch", "make_eval_fn", "make_multi_eval_fn",
+    "chisq_calc", "two_curve_map", "singularvalue_calc", "min_edges",
+    "arc_edges", "len_arc", "ext_find", "fft_axis", "cs_to_ri",
+    "unit_checks", "single_search", "single_search_thin",
+    "multi_chunk_search", "fit_eig_peak", "chi_par",
+    "single_chunk_retrieval", "vlbi_chunk_retrieval", "mosaic",
+    "refine_mosaic", "gerchberg_saxton", "calc_asymmetry", "mask_func",
+    "err_string", "plot_func",
+]

@@ -1,0 +1,6 @@
+"""Utilities: ephemerides, orbits, velocities, archive hook, misc
+(scint_utils.py re-design)."""
+
+from . import ephemeris, orbit, velocity, misc, archive
+
+__all__ = ["ephemeris", "orbit", "velocity", "misc", "archive"]

@@ -1,0 +1,243 @@
+"""Tests for the L1 velocity/astrometry models (fit/models.py,
+utils/velocity.py) and the MCMC fitting path — the layers behind
+arc-curvature and scintillation-velocity science fits."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.fit.models import (arc_curvature,
+                                      effective_velocity_annual,
+                                      veff_thin_screen)
+from scintools_tpu.utils.velocity import (
+    calculate_curvature_peak_probability, curvature_log_likelihood,
+    scint_velocity)
+
+
+def _binary_params(**over):
+    p = {
+        "d": 0.16, "s": 0.7,             # kpc, fractional distance
+        "A1": 3.37, "PB": 5.74, "ECC": 0.0, "OM": 0.0, "T0": 54501.0,
+        "KIN": 90.0, "KOM": 0.0,
+        "PMRA": 121.0, "PMDEC": -71.0,   # mas/yr (J0437-like)
+    }
+    p.update(over)
+    return p
+
+
+class TestEffectiveVelocity:
+    def test_circular_orbit_speed_amplitude(self):
+        """For ECC=0 the in-plane orbital velocity amplitude is
+        vp_0 = 2π·(A1·c)/(sin i·PB·86400); with KOM=90° the RA
+        component carries the full vp_x = -vp_0·sin(ν+ω) term
+        (scint_models.py:504-587 projection)."""
+        params = _binary_params(PMRA=0.0, PMDEC=0.0, KOM=90.0)
+        nu = np.linspace(0, 2 * np.pi, 400, endpoint=False)
+        z = np.zeros_like(nu)
+        veff_ra, veff_dec, vp_ra, vp_dec = effective_velocity_annual(
+            params, nu, z, z)
+        v_c = 299792.458
+        vp_0 = 2 * np.pi * params["A1"] * v_c / (params["PB"] * 86400)
+        assert np.max(np.abs(vp_ra)) == pytest.approx(vp_0, rel=1e-3)
+        # at KIN=90 (edge-on) vp_y carries cos(i)=0
+        np.testing.assert_allclose(vp_dec, 0.0, atol=1e-9)
+        # veff carries (1-s)·vp
+        np.testing.assert_allclose(
+            veff_ra, (1 - params["s"]) * vp_ra, atol=1e-9)
+
+    def test_earth_term_scales_with_s(self):
+        params = _binary_params(PMRA=0.0, PMDEC=0.0, A1=0.0)
+        nu = np.zeros(8)
+        ve_ra = np.full(8, 20.0)
+        ve_dec = np.full(8, -5.0)
+        veff_ra, veff_dec, _, _ = effective_velocity_annual(
+            params, nu, ve_ra, ve_dec)
+        np.testing.assert_allclose(veff_ra, params["s"] * 20.0,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(veff_dec, params["s"] * -5.0,
+                                   rtol=1e-12)
+
+    def test_inclination_parameterisations_agree(self):
+        """KIN=60° and SINI=sin(60°) (sense<0.5 keeps i<90°) give the
+        same pulsar velocity."""
+        nu = np.linspace(0, 2 * np.pi, 16, endpoint=False)
+        z = np.zeros_like(nu)
+        out_kin = effective_velocity_annual(
+            _binary_params(KIN=60.0), nu, z, z)
+        p_sini = _binary_params()
+        del p_sini["KIN"]
+        p_sini["SINI"] = np.sin(np.radians(60.0))
+        p_sini["sense"] = 0
+        out_sini = effective_velocity_annual(p_sini, nu, z, z)
+        np.testing.assert_allclose(out_kin[2], out_sini[2], rtol=1e-10)
+        np.testing.assert_allclose(out_kin[3], out_sini[3], rtol=1e-10)
+
+
+class TestArcCurvature:
+    def test_isotropic_known_value(self):
+        """η = d·s(1−s)/(2·veff²)/1e9 with only the Earth term
+        (scint_models.py:350-425)."""
+        params = _binary_params(A1=0.0, PMRA=0.0, PMDEC=0.0, nmodel=0)
+        nu = np.zeros(4)
+        ve_ra = np.full(4, 10.0)
+        ve_dec = np.zeros(4)
+        eta = arc_curvature(params, None, None, nu, ve_ra, ve_dec,
+                            model_only=True)
+        kmpkpc = 3.085677581e16
+        d, s = params["d"], params["s"]
+        veff = s * 10.0
+        expected = (d * kmpkpc * s * (1 - s) / (2 * veff ** 2)) / 1e9
+        np.testing.assert_allclose(np.asarray(eta), expected,
+                                   rtol=1e-10)
+
+    def test_anisotropic_zeta_bounds(self):
+        """Anisotropic η (zeta projection) ≥ isotropic η for the same
+        velocity: projecting veff can only reduce its magnitude."""
+        base = _binary_params(A1=0.0, nmodel=0)
+        nu = np.zeros(16)
+        ve_ra = np.full(16, 12.0)
+        ve_dec = np.full(16, 7.0)
+        eta_iso = np.asarray(arc_curvature(base, None, None, nu, ve_ra,
+                                           ve_dec, model_only=True))
+        for zeta in [0.0, 30.0, 77.0]:
+            aniso = {**base, "nmodel": 1, "zeta": zeta}
+            eta_a = np.asarray(arc_curvature(aniso, None, None, nu,
+                                             ve_ra, ve_dec,
+                                             model_only=True))
+            assert np.all(eta_a >= eta_iso - 1e-12)
+
+    def test_legacy_psi_rejected(self):
+        with pytest.raises(KeyError, match="zeta"):
+            arc_curvature({**_binary_params(), "psi": 10.0}, None,
+                          None, np.zeros(2), np.zeros(2), np.zeros(2))
+
+
+class TestVeffThinScreen:
+    def test_isotropic_matches_formula(self):
+        """Without anisotropy params the model is
+        coeff·|veff|/s, coeff = 1/√(2·d·(1−s)/s)
+        (scint_models.py:428-496)."""
+        params = _binary_params(A1=0.0, PMRA=0.0, PMDEC=0.0)
+        nu = np.zeros(6)
+        ve_ra = np.full(6, 15.0)
+        ve_dec = np.full(6, -8.0)
+        residual = np.asarray(veff_thin_screen(
+            params, np.zeros(6), np.ones(6), nu, ve_ra, ve_dec))
+        model = -residual
+        s, d = params["s"], params["d"]
+        veff = np.hypot(s * 15.0, s * -8.0)
+        coeff = 1.0 / np.sqrt(2 * d * (1 - s) / s)
+        np.testing.assert_allclose(model, coeff * veff / s, rtol=1e-10)
+
+    def test_anisotropy_changes_model(self):
+        params = _binary_params(A1=0.0)
+        nu = np.zeros(6)
+        ve_ra = np.full(6, 15.0)
+        ve_dec = np.full(6, -8.0)
+        iso = np.asarray(veff_thin_screen(params, np.zeros(6),
+                                          np.ones(6), nu, ve_ra,
+                                          ve_dec))
+        aniso = np.asarray(veff_thin_screen(
+            {**params, "nmodel": 1, "R": 0.5, "psi": 30.0},
+            np.zeros(6), np.ones(6), nu, ve_ra, ve_dec))
+        assert not np.allclose(iso, aniso)
+
+
+class TestCurvatureLikelihood:
+    def test_peak_probability_maximised_at_peak(self):
+        x = np.linspace(-1, 1, 201)
+        power = np.exp(-0.5 * (x / 0.1) ** 2)
+        probs = calculate_curvature_peak_probability(power, 2.0,
+                                                     smooth=True)
+        assert np.all(np.isfinite(probs))
+        assert np.argmax(probs) == np.argmax(
+            calculate_curvature_peak_probability(power, 2.0))
+        # the profile peak has the highest probability
+        assert np.argmax(probs) == pytest.approx(100, abs=2)
+
+    def test_log_likelihood_prefers_true_peak(self):
+        nfdop = np.linspace(-1, 1, 201)
+        power = np.exp(-0.5 * ((nfdop - 0.3) / 0.05) ** 2)
+        lls = [curvature_log_likelihood(power, nfdop, 1.0, m)
+               for m in [-0.5, 0.3, 0.8]]
+        assert np.argmax(lls) == 1
+        # outside the grid → -200 floor
+        assert curvature_log_likelihood(power, nfdop, 1.0, 2.0) == -200
+
+    def test_log_likelihood_2d_multi_observation(self):
+        nfdop = np.tile(np.linspace(-1, 1, 101), (3, 1))
+        power = np.exp(-0.5 * ((nfdop - 0.2) / 0.1) ** 2)
+        ll_good = curvature_log_likelihood(power, nfdop, 1.0,
+                                           np.full(3, 0.2))
+        ll_bad = curvature_log_likelihood(power, nfdop, 1.0,
+                                          np.full(3, -0.9))
+        assert ll_good > ll_bad
+
+
+class TestScintVelocity:
+    def test_values_and_errors_positive(self):
+        params = {"d": 1.0, "s": 0.5, "derr": 0.1, "serr": 0.05}
+        viss, visserr = scint_velocity(params, dnu=1.0, tau=100.0,
+                                       freq=1000.0, dnuerr=0.1,
+                                       tauerr=5.0)
+        assert viss > 0 and visserr > 0
+        # doubling tau halves viss
+        viss2, _ = scint_velocity(params, dnu=1.0, tau=200.0,
+                                  freq=1000.0, dnuerr=0.1, tauerr=5.0)
+        assert viss2 == pytest.approx(viss / 2, rel=1e-10)
+
+
+class TestMCMCFit:
+    def test_mcmc_recovers_acf_params(self):
+        """Ensemble MCMC on the 1-D time-ACF model recovers the truth
+        (the get_scint_params mcmc=True machinery)."""
+        from scintools_tpu.fit.fitter import fitter
+        from scintools_tpu.fit.models import tau_acf_model
+        from scintools_tpu.fit.parameters import Parameters
+
+        rng = np.random.default_rng(1)
+        t = np.linspace(0, 300.0, 120)
+        tau_true, amp_true, alpha = 60.0, 1.0, 5 / 3
+        sigma = 0.02
+        clean = (amp_true * np.exp(-(t / tau_true) ** alpha)
+                 * (1 - t / t.max()))
+        ydata = clean + sigma * rng.normal(size=len(t))
+
+        params = Parameters()
+        params.add("tau", value=40.0, vary=True, min=5.0, max=200.0)
+        params.add("amp", value=0.8, vary=True, min=0.1, max=2.0)
+        params.add("alpha", value=alpha, vary=False)
+        # is_weighted=True semantics: residuals arrive scaled by 1/σ
+        res = fitter(tau_acf_model, params,
+                     (t, ydata, np.full_like(t, 1.0 / sigma)),
+                     mcmc=True, nwalkers=24, steps=400, burn=0.25,
+                     progress=False, seed=3)
+        tau_fit = res.params["tau"].value
+        assert tau_fit == pytest.approx(tau_true, rel=0.1)
+        assert hasattr(res, "flatchain")
+
+    def test_mcmc_unweighted_samples_lnsigma(self):
+        """is_weighted=False adds the __lnsigma noise nuisance
+        parameter (lmfit Minimizer.emcee parity) and recovers σ."""
+        from scintools_tpu.fit.fitter import fitter
+        from scintools_tpu.fit.models import tau_acf_model
+        from scintools_tpu.fit.parameters import Parameters
+
+        rng = np.random.default_rng(4)
+        t = np.linspace(0, 300.0, 120)
+        sigma = 0.05
+        clean = 1.0 * np.exp(-(t / 60.0) ** (5 / 3)) * (1 - t / t.max())
+        ydata = clean + sigma * rng.normal(size=len(t))
+
+        params = Parameters()
+        params.add("tau", value=40.0, vary=True, min=5.0, max=200.0)
+        params.add("amp", value=0.8, vary=True, min=0.1, max=2.0)
+        params.add("alpha", value=5 / 3, vary=False)
+        res = fitter(tau_acf_model, params,
+                     (t, ydata, np.ones_like(t)), mcmc=True,
+                     nwalkers=24, steps=500, burn=0.3, progress=False,
+                     seed=5, is_weighted=False)
+        assert "__lnsigma" in res.var_names
+        i = res.var_names.index("__lnsigma")
+        sigma_fit = np.exp(np.median(res.flatchain[:, i]))
+        assert sigma_fit == pytest.approx(sigma, rel=0.35)
+        assert res.params["tau"].value == pytest.approx(60.0, rel=0.15)
