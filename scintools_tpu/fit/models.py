@@ -171,6 +171,22 @@ def powerspectrum_model(params, xdata, ydata, backend=None):
     return ydata - (p["wn"] + p["amp"] * xdata ** p["alpha"])
 
 
+def arc_power_curve(params, xdata, ydata, weights, backend=None):
+    """Residuals of a power curve vs √curvature (or normalised fdop).
+
+    The reference declares this model but leaves its body an empty
+    stub returning garbage (scint_models.py:287-297); here it is the
+    same noise-floor + power-law family used for Doppler-profile
+    power spectra, which is what arc power curves are fitted with in
+    practice."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    if weights is None:
+        weights = xp.ones(xp.shape(ydata))
+    model = p["wn"] + p["amp"] * xp.abs(xdata) ** p.get("alpha", -2.0)
+    return (ydata - model) * weights
+
+
 # --------------------------------------------------------------------------
 # Parabola fitters (scint_models.py:300-347) — closed-form polyfit
 # --------------------------------------------------------------------------

@@ -1,0 +1,153 @@
+"""Checkpoint/resume for long survey runs.
+
+The reference has no in-pipeline checkpointing — its nearest analogues
+are chunked pickles and memmapped chunk arrays (scint_utils.py:797-807,
+dynspec.py:1784-1787; SURVEY.md §5). Long archival surveys (hundreds of
+epochs × fits) deserve real resume semantics: this module wraps orbax
+so a survey loop can save its pytree state (fit params, per-epoch
+results, progress cursor) every N epochs and restart from the last
+step after preemption.
+
+Works on single host and under ``jax.distributed`` multi-host
+(orbax coordinates across processes); state must be a pytree of
+arrays/scalars plus a small metadata dict.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class SurveyCheckpointer:
+    """Periodic pytree checkpointing with keep-last-k retention.
+
+    Checkpoints are written *after* a step is processed, so a resume
+    continues at ``latest_step() + 1``:
+
+    >>> ckpt = SurveyCheckpointer(dir, every=50, keep=3)
+    >>> last = ckpt.latest_step()            # None on fresh start
+    >>> state = init if last is None else ckpt.restore(last)
+    >>> for step in range(0 if last is None else last + 1, n_epochs):
+    ...     state = process(state)
+    ...     ckpt.maybe_save(step, state)
+    """
+
+    def __init__(self, directory, every=50, keep=3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(str(directory))
+        self.every = int(every)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=int(keep), create=True)
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    def latest_step(self):
+        """Step of the newest checkpoint, or None."""
+        return self._mgr.latest_step()
+
+    def save(self, step, state, force=True):
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(int(step), args=ocp.args.StandardSave(state),
+                       force=force)
+        self._mgr.wait_until_finished()
+
+    def maybe_save(self, step, state):
+        """Save when ``step`` hits the cadence; returns True if saved."""
+        if (int(step) + 1) % self.every == 0:
+            self.save(step, state)
+            return True
+        return False
+
+    def restore(self, step=None, template=None):
+        """Restore the pytree at ``step`` (default: newest). With
+        ``template`` the restored leaves adopt its structure/dtypes."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        if template is not None:
+            return self._mgr.restore(
+                int(step),
+                args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(int(step))
+
+    def close(self):
+        self._mgr.close()
+
+
+def run_survey_with_checkpoints(step_fn, init_state, n_steps, directory,
+                                every=50, keep=3):
+    """Resumable driver: applies ``state = step_fn(state, i)`` for i in
+    [0, n_steps), checkpointing every ``every`` steps and resuming from
+    the latest checkpoint when one exists. Returns the final state."""
+    ckpt = SurveyCheckpointer(directory, every=every, keep=keep)
+    latest = ckpt.latest_step()
+    if latest is None:
+        state, start = init_state, 0
+    else:
+        state = ckpt.restore(latest, template=init_state)
+        start = int(latest) + 1
+    try:
+        for i in range(start, int(n_steps)):
+            state = step_fn(state, i)
+            ckpt.maybe_save(i, state)
+        if int(n_steps) > 0 and ckpt.latest_step() != int(n_steps) - 1:
+            ckpt.save(int(n_steps) - 1, state)
+    finally:
+        ckpt.close()
+    return state
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host bring-up: ``jax.distributed.initialize`` with
+    environment-variable fallbacks (COORDINATOR_ADDRESS, NUM_PROCESSES,
+    PROCESS_ID). On TPU pods the arguments are auto-detected and this
+    reduces to ``jax.distributed.initialize()``. Safe to call once per
+    process before building the global mesh (parallel.make_mesh uses
+    jax.devices(), which spans all hosts after initialization); no-op
+    when already initialized or single-process."""
+    import jax
+
+    # NOTE: do not touch jax.devices()/process_count() here — any
+    # backend query initializes JAX and makes distributed.initialize
+    # fail afterwards.
+    kwargs = {}
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    explicit = addr is not None
+    if addr:
+        kwargs["coordinator_address"] = addr
+        kwargs["num_processes"] = int(
+            num_processes or os.environ.get("NUM_PROCESSES", 1))
+        kwargs["process_id"] = int(
+            process_id or os.environ.get("PROCESS_ID", 0))
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            return  # initialized earlier in this process — fine
+        if explicit:
+            # a requested multi-host bring-up must not silently
+            # degrade to N independent single-process runs
+            raise
+        # auto-detection on a non-pod single host: run single-process
+    except ValueError:
+        if explicit:
+            raise
+
+
+def results_state(n_epochs, n_params=3):
+    """Canonical survey state pytree: per-epoch fitted parameters,
+    errors, χ², and a validity mask (the write_results CSV columns in
+    array form, scint_utils.py:103-202)."""
+    return {
+        "params": np.zeros((n_epochs, n_params)),
+        "errors": np.zeros((n_epochs, n_params)),
+        "chisqr": np.zeros(n_epochs),
+        "done": np.zeros(n_epochs, dtype=bool),
+    }

@@ -217,7 +217,7 @@ def _jax_stack(chunks_j, masks_j, phases, amps, jnp):
 
 
 def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
-                  maxiter=200, backend=None):
+                  maxiter=200, x0=None, backend=None):
     """Global mosaic refinement by autodiff L-BFGS.
 
     mode='rot': maximise Σ|E|² over per-chunk phases (rotFit,
@@ -225,6 +225,8 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
     the observed dynamic spectrum (fullMosFit, ththmod.py:1990-2016).
     The reference's 400 lines of hand-derived gradient/Hessian
     (rotDer/fullMosGrad/fullMosHess) are replaced by jax.grad.
+    ``x0`` overrides the greedy initial per-chunk phases
+    (nchunk-1 values, first chunk fixed at 0).
     """
     from scipy.optimize import minimize
 
@@ -238,7 +240,8 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
     chunks_j = jnp.asarray(chunks)
     masks_j = jnp.asarray(masks)
 
-    x0_phase = rot_init(chunks)
+    x0_phase = (rot_init(chunks) if x0 is None
+                else np.asarray(x0, dtype=float))
     if mode == "rot":
         def objective(x):
             E = _jax_stack(chunks_j, masks_j, x, jnp.ones(nchunk), jnp)

@@ -25,6 +25,18 @@ def chi_par(x, A, x0, C):
     return A * (x - x0) ** 2 + C
 
 
+def err_calc(etas, eigs, fit_pars):
+    """Peak-position error of the parabola fit from the residual
+    scatter (ththmod.py:2368-2382)."""
+    etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+    eigs = np.asarray(eigs, dtype=float)
+    M = chi_par(etas, *fit_pars)
+    sig_estimate = np.std(eigs - M)
+    A, x0 = fit_pars[0], fit_pars[1]
+    denom = np.sum(4 * A * (2 * A * (x0 - etas) ** 2 + M - eigs))
+    return np.sqrt(2 / denom) * sig_estimate
+
+
 @dataclass
 class ChunkSearchResult:
     eta: float          # fitted curvature (s³ ≡ us/mHz²)

@@ -20,6 +20,32 @@ def archive_tools_available():
     return True
 
 
+def make_dynspec(archive, template=None, phasebin=1):
+    """Create a psrflux-format dynamic spectrum from a pulsar archive
+    by invoking the external ``psrflux`` tool
+    (``psrflux -s template -e dynspec archive``) — the reference's
+    stub documents the command without running it
+    (scint_utils.py:894-899); here it is executed when psrflux is on
+    PATH and raises with the exact command otherwise."""
+    import shutil
+    import subprocess
+
+    if phasebin != 1:
+        # psrflux has no phase-binning option; the reference's stub
+        # carries the parameter but never uses it either
+        raise ValueError("phasebin != 1 is not supported by psrflux")
+    cmd = ["psrflux"]
+    if template is not None:
+        cmd += ["-s", str(template)]
+    cmd += ["-e", "dynspec", str(archive)]
+    if shutil.which("psrflux") is None:
+        raise RuntimeError(
+            "psrflux (psrchive) is not installed; run manually: "
+            + " ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return f"{archive}.dynspec"
+
+
 def clean_archive(archive, template=None, bandwagon=0.99, channel_threshold=5,
                   subint_threshold=5, output_directory=None):
     """Clean RFI from a psrchive archive with coast_guard's surgical and

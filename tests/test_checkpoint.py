@@ -1,0 +1,72 @@
+"""Checkpoint/resume tests (parallel/checkpoint.py)."""
+
+import numpy as np
+
+from scintools_tpu.parallel.checkpoint import (SurveyCheckpointer,
+                                               results_state,
+                                               run_survey_with_checkpoints)
+
+
+def _step(state, i):
+    state = dict(state)
+    state["params"] = state["params"].copy()
+    state["done"] = state["done"].copy()
+    state["params"][i] = [i, 2 * i, 3 * i]
+    state["done"][i] = True
+    return state
+
+
+class TestSurveyCheckpointer:
+    def test_save_restore_roundtrip(self, tmp_path):
+        ckpt = SurveyCheckpointer(tmp_path / "ck", every=2, keep=2)
+        state = results_state(4)
+        state["params"][0] = [1.0, 2.0, 3.0]
+        ckpt.save(0, state)
+        assert ckpt.latest_step() == 0
+        back = ckpt.restore(template=results_state(4))
+        np.testing.assert_allclose(back["params"], state["params"])
+        assert back["done"].dtype == np.bool_
+        ckpt.close()
+
+    def test_keep_last_k(self, tmp_path):
+        ckpt = SurveyCheckpointer(tmp_path / "ck", every=1, keep=2)
+        for s in range(5):
+            ckpt.save(s, {"x": np.full(3, float(s))})
+        assert ckpt.latest_step() == 4
+        back = ckpt.restore()
+        np.testing.assert_allclose(back["x"], 4.0)
+        ckpt.close()
+
+
+class TestResumableDriver:
+    def test_full_run(self, tmp_path):
+        final = run_survey_with_checkpoints(
+            _step, results_state(6), 6, tmp_path / "ck", every=2)
+        assert final["done"].all()
+        np.testing.assert_allclose(final["params"][5], [5, 10, 15])
+
+    def test_resume_after_interruption(self, tmp_path):
+        calls = []
+
+        def crashing_step(state, i):
+            if i == 4 and not (tmp_path / "resumed").exists():
+                raise KeyboardInterrupt
+            calls.append(i)
+            return _step(state, i)
+
+        try:
+            run_survey_with_checkpoints(
+                crashing_step, results_state(6), 6, tmp_path / "ck",
+                every=2)
+        except KeyboardInterrupt:
+            pass
+        (tmp_path / "resumed").touch()
+        first_pass = list(calls)
+        final = run_survey_with_checkpoints(
+            crashing_step, results_state(6), 6, tmp_path / "ck",
+            every=2)
+        resumed = calls[len(first_pass):]
+        # resumed from the step-3 checkpoint, not from scratch
+        assert resumed[0] == 4
+        assert final["done"][2:].all()
+        np.testing.assert_allclose(final["params"][5], [5, 10, 15])

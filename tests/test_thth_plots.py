@@ -66,3 +66,23 @@ class TestArchiveHook:
             pytest.skip("psrchive present")
         with pytest.raises(ImportError, match="psrchive"):
             clean_archive("nonexistent.ar")
+
+
+class TestCompatLayer:
+    def test_reference_names_resolve(self):
+        from scintools_tpu import compat
+
+        for name in compat.__all__:
+            assert callable(getattr(compat, name)), name
+        assert callable(compat.rotFit)
+        assert callable(compat.fullMosFit)
+
+    def test_err_calc_on_parabola(self):
+        from scintools_tpu.thth.search import chi_par, err_calc
+
+        rng = np.random.default_rng(2)
+        etas = np.linspace(0.5, 1.5, 60)
+        pars = (-4.0, 1.0, 10.0)
+        eigs = chi_par(etas, *pars) + 0.01 * rng.normal(size=60)
+        err = err_calc(etas, eigs, pars)
+        assert 0 < err < 0.05
