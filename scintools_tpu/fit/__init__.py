@@ -3,6 +3,7 @@
 
 from .parameters import Parameters
 from .fitter import fitter, minimize_leastsq, sample_emcee
+from .lm_jax import make_lm_solver, lm_covariance
 from . import models
 
 __all__ = ["Parameters", "fitter", "minimize_leastsq", "sample_emcee",

@@ -1,0 +1,92 @@
+"""Fully-jitted batched Levenberg–Marquardt.
+
+The host-side scipy path (fitter.minimize_leastsq) is right for one
+fit; archival surveys need *thousands* of small ACF fits, which on TPU
+want to be one vmapped program (SURVEY.md §2.1 'get_scint_params' →
+'vmapped walkers / batched fits'). This module provides a pure-JAX LM
+with a fixed iteration budget (compiler-friendly: no data-dependent
+trip counts), damped normal equations, and projected box bounds.
+
+Usage::
+
+    residual = lambda x, t, y: model(x, t) - y       # jittable
+    solver = make_lm_solver(residual, n_iter=40)
+    x, cost = solver(x0, t, y)                        # one fit
+    xs, costs = jax.vmap(solver, in_axes=(0, None, 0))(x0s, t, ys)
+
+Gradients flow through the solver (it is plain lax.scan of jnp ops),
+so hierarchical/regularised fits can differentiate through it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+
+
+def make_lm_solver(residual_fn, n_iter=40, lam0=1e-3, lam_up=4.0,
+                   lam_down=0.5, lam_min=1e-9, lam_max=1e9,
+                   bounds=None, eps=1e-12):
+    """Build ``solver(x0, *args) -> (x, cost)`` minimising
+    ``0.5·Σ residual_fn(x, *args)²`` by damped Gauss-Newton steps.
+
+    - fixed ``n_iter`` trip count (jit/vmap/scan friendly);
+    - multiplicative damping: accepted steps shrink λ, rejected steps
+      grow it and keep the old iterate (classic LM);
+    - ``bounds=(lo, hi)`` arrays clip each iterate (projected LM).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    lo = hi = None
+    if bounds is not None:
+        lo = jnp.asarray(np.asarray(bounds[0], dtype=float))
+        hi = jnp.asarray(np.asarray(bounds[1], dtype=float))
+
+    def cost_of(x, args):
+        r = residual_fn(x, *args)
+        return 0.5 * jnp.sum(r * r)
+
+    def solver(x0, *args):
+        x0 = jnp.asarray(x0, dtype=jnp.result_type(float, x0))
+
+        def body(carry, _):
+            x, lam, cost = carry
+            r = residual_fn(x, *args)
+            J = jax.jacfwd(residual_fn)(x, *args)
+            g = J.T @ r
+            H = J.T @ J
+            damp = lam * (jnp.diag(H) + eps)
+            delta = jnp.linalg.solve(H + jnp.diag(damp), -g)
+            x_new = x + delta
+            if lo is not None:
+                x_new = jnp.clip(x_new, lo, hi)
+            cost_new = cost_of(x_new, args)
+            ok = jnp.isfinite(cost_new) & (cost_new < cost)
+            x = jnp.where(ok, x_new, x)
+            cost = jnp.where(ok, cost_new, cost)
+            lam = jnp.clip(jnp.where(ok, lam * lam_down, lam * lam_up),
+                           lam_min, lam_max)
+            return (x, lam, cost), None
+
+        init = (x0, jnp.asarray(lam0, x0.dtype), cost_of(x0, args))
+        (x, _, cost), _ = jax.lax.scan(body, init, None, length=n_iter)
+        return x, cost
+
+    return solver
+
+
+def lm_covariance(residual_fn, x, args=()):
+    """Gauss-Newton parameter covariance at the solution:
+    (JᵀJ)⁻¹ · redχ² — the same stderr convention as
+    fitter.minimize_leastsq / lmfit."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    r = residual_fn(x, *args)
+    J = jax.jacfwd(residual_fn)(x, *args)
+    H = J.T @ J
+    nfree = jnp.maximum(r.size - x.size, 1)
+    redchi = jnp.sum(r * r) / nfree
+    return jnp.linalg.pinv(H) * redchi
