@@ -10,11 +10,15 @@ Run:  python examples/01_simulate_and_fit_arc.py [--backend jax]
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
 
-from scintools_tpu.sim import Simulation
-from scintools_tpu.dynspec import Dynspec, SimDyn
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from scintools_tpu.sim import Simulation  # noqa: E402
+from scintools_tpu.dynspec import Dynspec, SimDyn  # noqa: E402
 
 
 def main():

@@ -12,10 +12,14 @@ Run:  python examples/02_thetatheta_wavefield.py [--backend jax]
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
 
-from scintools_tpu.dynspec import BasicDyn, Dynspec
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from scintools_tpu.dynspec import BasicDyn, Dynspec  # noqa: E402
 
 
 def make_arc_wavefield(nt=192, nf=192, eta=0.4, seed=8, dt=30.0,

@@ -7,15 +7,24 @@ over a ``jax.sharding.Mesh`` (real chips on a pod; virtual CPU
 devices here) and progress checkpoints via orbax so a preempted run
 resumes where it stopped.
 
-Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      JAX_PLATFORMS=cpu python examples/03_survey_with_checkpoints.py
+Run:  python examples/03_survey_with_checkpoints.py
+(the script pins jax onto an 8-way virtual CPU mesh itself — env vars
+alone cannot stop the preloaded TPU plugin from initialising)
 """
 
+import os
+import sys
 import tempfile
 
 import numpy as np
 
-from scintools_tpu import parallel as par
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from scintools_tpu.backend import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
+
+from scintools_tpu import parallel as par  # noqa: E402
 from scintools_tpu.parallel.checkpoint import (
     results_state, run_survey_with_checkpoints)
 from scintools_tpu.sim.simulation import simulate_dynspec_batch
@@ -38,18 +47,20 @@ def main():
                                              seed=1))
     dyns = np.transpose(dyns, (0, 2, 1))           # (epoch, nf, nt)
 
-    # --- sharded survey step: sspec + differentiable ACF fit --------
+    # --- sharded survey step: sspec + vmapped LM ACF fits -----------
     step = par.make_survey_step(mesh, nf, nt, dt=2.0, df=0.05)
 
     def process_batch(state, i):
         sl = slice(i * batch, (i + 1) * batch)
-        params = par.init_survey_params(batch)
-        params, loss, power, tcut, fcut = step(dyns[sl], params)
+        params, chisq, power, tcut, fcut = step(dyns[sl])
         state = {k: v.copy() for k, v in state.items()}
         state["params"][sl] = np.stack(
             [np.asarray(params["tau"]), np.asarray(params["dnu"]),
              np.asarray(params["amp"])], axis=1)
-        state["chisqr"][sl] = float(loss)
+        state["errors"][sl] = np.stack(
+            [np.asarray(params["tauerr"]), np.asarray(params["dnuerr"]),
+             np.asarray(params["amperr"])], axis=1)
+        state["chisqr"][sl] = np.asarray(chisq)
         state["done"][sl] = True
         return state
 

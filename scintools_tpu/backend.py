@@ -70,7 +70,44 @@ def complex_transfer_safe():
     fails with UNIMPLEMENTED and poisons the process). Complex math
     *inside* a single jitted program is always fine; this gates only
     eager helpers that would device_put complex arrays."""
-    return os.environ.get("JAX_PLATFORMS", "") != "axon"
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower().split(",")
+    return "axon" not in [p.strip() for p in platforms]
+
+
+def force_cpu_platform(n_devices=None):
+    """Pin jax onto host CPU (optionally with ``n_devices`` virtual
+    devices for mesh emulation) before any backend touch.
+
+    In this image the axon TPU PJRT plugin is registered by a
+    sitecustomize at interpreter startup, and setting
+    ``JAX_PLATFORMS=cpu`` in the environment does NOT stop jax from
+    initialising it (which hangs indefinitely when the TPU tunnel is
+    down) — only ``jax.config.update('jax_platforms', 'cpu')`` after
+    import reliably does. Call this before the first jax computation
+    in any host-only / virtual-mesh entry point.
+    """
+    if n_devices:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag,
+                flags)
+        else:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax = get_jax()
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        if getattr(jax.config, "jax_platforms", None) != "cpu":
+            raise RuntimeError(
+                "force_cpu_platform() was called after a non-CPU jax "
+                "backend was already initialised; call it before the "
+                "first jax computation") from None
 
 
 def eager_backend(backend=None):

@@ -10,9 +10,10 @@ device mesh:
   axis via ``all_to_all`` (parallel/fft.py) — the long-sequence axis.
 - **η-grid parallelism**: the θ-θ eigenvalue curve shards its η axis
   over the whole mesh (a tensor-parallel-style split of one search).
-- **fit step**: scintillation-parameter estimation as a *gradient*
-  step on the differentiable ACF model (fit/models.py semantics),
-  with XLA inserting the gradient ``psum`` over 'data'.
+- **fit step**: a full vmapped Levenberg–Marquardt fit of the 1-D ACF
+  models per epoch (fit/batch.py — the reference's per-epoch lmfit
+  loop, dynspec.py:2698, as one device program), epochs sharded over
+  'data'.
 
 Everything compiles to one XLA program per shape; ``dryrun_multichip``
 in ``__graft_entry__`` drives it on a virtual mesh.
@@ -72,29 +73,33 @@ def _acf_cuts_fn(mesh, nf, nt):
         acf = jnp.real(ifft2(spec * jnp.conj(spec)))
         norm = acf[:, 0:1, 0:1]
         acf = acf / jnp.where(norm == 0, 1.0, norm)
-        tcut = acf[:, 0, 1:nt]       # time lags > 0
-        fcut = acf[:, 1:nf, 0]       # freq lags > 0
+        tcut = acf[:, 0, 0:nt]       # time lags ≥ 0 (lag 0 = 1)
+        fcut = acf[:, 0:nf, 0]       # freq lags ≥ 0
         return tcut, fcut
 
     return fn
 
 
 def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
-                     lr=0.05, window="hanning", window_frac=0.1):
+                     n_iter=100, bartlett=True, weighted=True,
+                     window="hanning", window_frac=0.1):
     """Build the jitted end-to-end survey step.
 
-    ``fn(dyns[B, nf, nt], params) → (params', loss, power, tcut, fcut)``
-    where ``params = {'tau': [B], 'dnu': [B], 'amp': [B]}`` are
-    per-epoch scintillation parameters advanced by one gradient step on
-    the 1-D ACF model residuals (scint_models.py:62-120 semantics:
-    amp·exp(−(t/τ)^α), amp·exp(−ln2·f/Δν)), and ``power`` is the
-    sharded secondary spectrum of every epoch.
+    ``fn(dyns[B, nf, nt]) → (params, chisq, power, tcut, fcut)``
+    where ``params = {'tau': [B], 'dnu': [B], 'amp': [B], 'tauerr':
+    [B], 'dnuerr': [B], 'amperr': [B], 'redchi': [B]}`` are per-epoch
+    scintillation parameters from a *full vmapped Levenberg–Marquardt
+    fit* of the 1-D ACF models with Bartlett weights — the reference's
+    per-epoch lmfit loop (dynspec.py:2698, scint_models.py:29-46) as
+    one device program — ``chisq[B]`` the per-epoch fit chi-square,
+    and ``power`` the sharded secondary spectrum of every epoch.
 
     B must be divisible by the mesh's 'data' axis size.
     """
     jax = get_jax()
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..fit.batch import make_acf1d_fit_one
 
     k = mesh.shape[SEQ_AXIS]
     if (2 * nf) % k or (2 * nt) % k:
@@ -105,40 +110,20 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
         wins = get_window(nt, nf, window=window, frac=window_frac)
     sspec_fn = make_sspec_power_sharded(mesh, nf, nt, window_arrays=wins)
     acf_fn = _acf_cuts_fn(mesh, nf, nt)
+    fit_one = make_acf1d_fit_one(nt, nf, dt, df, alpha=alpha,
+                                 n_iter=n_iter, bartlett=bartlett,
+                                 weighted=weighted)
 
-    tlags = jnp.asarray(np.arange(1, nt) * dt)
-    flags = jnp.asarray(np.arange(1, nf) * df)
-    tobs = nt * dt
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
 
-    def loss_fn(params, tcut, fcut):
-        tau = jnp.abs(params["tau"])[:, None]
-        dnu = jnp.abs(params["dnu"])[:, None]
-        amp = params["amp"][:, None]
-        # triangle taper from the finite observation (scint_models.py:81)
-        tri = 1.0 - tlags[None, :] / tobs
-        mt = amp * jnp.exp(-(tlags[None, :] / tau) ** alpha) * tri
-        mf = amp * jnp.exp(-jnp.log(2.0) * flags[None, :] / dnu)
-        r = jnp.concatenate([(mt - tcut), (mf - fcut)], axis=1)
-        return jnp.mean(r ** 2)
-
-    def step(dyns, params):
+    def step(dyns):
         power = sspec_fn(dyns)
         tcut, fcut = acf_fn(dyns)
-        loss, grads = jax.value_and_grad(loss_fn)(params, tcut, fcut)
-        params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                        params, grads)
-        return params, loss, power, tcut, fcut
+        tcut = jax.lax.with_sharding_constraint(tcut, batch_sh)
+        fcut = jax.lax.with_sharding_constraint(fcut, batch_sh)
+        out = jax.vmap(fit_one)(tcut, fcut)
+        chisq = out.pop("chisqr")
+        return out, chisq, power, tcut, fcut
 
     dyn_sh = batch_freq_sharding(mesh)
-    param_sh = {k: NamedSharding(mesh, P(DATA_AXIS))
-                for k in ("tau", "dnu", "amp")}
-    return jax.jit(step, in_shardings=(dyn_sh, param_sh))
-
-
-def init_survey_params(batch, tau0=10.0, dnu0=1.0, amp0=1.0):
-    """Per-epoch initial guesses as a pytree matching make_survey_step."""
-    import jax.numpy as jnp
-
-    return {"tau": jnp.full((batch,), float(tau0)),
-            "dnu": jnp.full((batch,), float(dnu0)),
-            "amp": jnp.full((batch,), float(amp0))}
+    return jax.jit(step, in_shardings=(dyn_sh,))
