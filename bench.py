@@ -1,19 +1,28 @@
-"""Benchmark: secondary spectrum + θ-θ curvature search, jax vs numpy.
+"""Benchmark: all five BASELINE.json configs, jax (TPU) vs numpy.
 
-Workload (BASELINE.json configs #1 and #3, scaled to one chip):
-  - calc_sspec on a 1024×512 simulated dynamic spectrum
-    (scint_sim.Simulation equivalent, sim/simulation.py), and
-  - a 200-η θ-θ eigenvalue curvature search over the full 4×2 grid of
-    256×256 chunks — the reference's fit_thetatheta workload
-    (dynspec.py:1681-1719), which it fans over an MPI/multiprocessing
-    pool; here it is one chunk-batched device program with a
-    VMEM-resident warm-start Pallas eigensolver (thth/batch.py).
+Headline metric (continuity with BENCH_r01): configs #1+#3 — a
+1024×512 secondary spectrum plus a 200-η θ-θ eigenvalue curvature
+search over the full 4×2 grid of 256×256 chunks (the reference's
+``fit_thetatheta`` pool workload, dynspec.py:1681-1719), run as one
+chunk-batched device program with the VMEM-resident warm-start Pallas
+eigensolver (thth/batch.py). Also measured: #2 ACF+acf1d fit
+wall-time, #4 batched simulation screens/sec, #5 survey epochs/sec.
 
-Both backends run the identical workload: the numpy path is the
-reference's per-chunk loop (scipy eigsh per η), the jax path the
-batched kernel. Prints ONE JSON line:
-  {"metric": ..., "value": pixels/sec (jax), "unit": ..., "vs_baseline":
-   speedup over the single-process numpy path on this host's CPU}.
+Prints ONE JSON line. Honesty guarantees (VERDICT r1):
+- ``platform`` records the backend that ACTUALLY ran the jax path
+  (``jax.default_backend()`` at measure time) — a CPU fallback can
+  never masquerade as TPU;
+- the TPU probe runs out-of-process (a dead tunnel hangs the whole
+  process otherwise) with bounded retries and a compile-tolerant
+  budget, and its full per-attempt record is embedded under
+  ``probe``;
+- every repeat uses perturbed inputs (the tunneled TPU can serve
+  repeat executions with bit-identical inputs from a cache in ~0 ms).
+
+Env knobs: SCINTOOLS_BENCH_NO_PROBE=1 skips the probe (trust the
+default platform); SCINTOOLS_BENCH_PROBE_ATTEMPTS / _PROBE_TIMEOUT /
+_PROBE_SLEEP tune the bring-up budget; SCINTOOLS_BENCH_TRACE=<dir>
+wraps the headline jax run in a jax.profiler trace.
 """
 
 from __future__ import annotations
@@ -26,105 +35,118 @@ import time
 
 import numpy as np
 
+PROBE_CODE = (
+    "import jax, numpy as np, jax.numpy as jnp;"
+    "x = jnp.asarray(np.ones((64, 64), np.float32));"
+    "f = jax.jit(lambda a: jnp.fft.fft2(a).real.sum());"
+    "print(float(f(x)), float(f(x + 1)))"
+)
 
-def _probe_accelerator(timeout=120):
-    """Check the default jax platform computes + transfers in a
-    subprocess (the tunneled TPU can hang the whole process when the
-    link is down, so the probe must be out-of-process). Falls back to
-    CPU when unhealthy so the benchmark always reports."""
+
+def probe_accelerator():
+    """Out-of-process health check of the default jax platform:
+    devices + compile + compute + fresh-input re-execute. Returns
+    (record, ok). Bounded retries tolerate a flapping tunnel; the
+    timeout tolerates remote first-compile latency."""
+    record = {"requested": os.environ.get("JAX_PLATFORMS", "default"),
+              "attempts": []}
     if os.environ.get("SCINTOOLS_BENCH_NO_PROBE"):
-        return
-    code = ("import jax, numpy as np, jax.numpy as jnp;"
-            "x = jnp.asarray(np.ones((64, 64)));"
-            "y = jax.jit(lambda a: jnp.fft.fft2(a).real.sum())(x);"
-            "print(float(y))")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True)
-        ok = r.returncode == 0
-    except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
-        print("WARNING: accelerator probe failed; benchmarking jax on CPU",
-              file=sys.stderr)
-        # jax may be preloaded at interpreter startup in this image, so
-        # the env var alone is too late — set the config too (works as
-        # long as no backend has been initialised yet)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+        record["skipped"] = True
+        return record, True
+    attempts = int(os.environ.get("SCINTOOLS_BENCH_PROBE_ATTEMPTS", 2))
+    timeout = float(os.environ.get("SCINTOOLS_BENCH_PROBE_TIMEOUT", 120))
+    sleep = float(os.environ.get("SCINTOOLS_BENCH_PROBE_SLEEP", 10))
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            r = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                               timeout=timeout, capture_output=True)
+            ok = r.returncode == 0
+            detail = "" if ok else (r.stderr or b"").decode(
+                errors="replace")[-400:]
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"timeout after {timeout:.0f}s"
+        record["attempts"].append(
+            {"ok": ok, "secs": round(time.time() - t0, 1),
+             "detail": detail})
+        if ok:
+            return record, True
+        if i + 1 < attempts:
+            time.sleep(sleep)
+    return record, False
 
-        jax.config.update("jax_platforms", "cpu")
 
-
-def _t(fn, *args, repeats=3):
-    """Best-of-N wall time of fn(*args) (first call excluded by caller)."""
+def _time_variants(fn, variants, repeats):
+    """Best wall time of fn(variant) over ``repeats`` calls, cycling
+    through pre-built perturbed inputs so no two calls see identical
+    buffers."""
     best = np.inf
-    for _ in range(repeats):
+    for i in range(repeats):
+        args = variants[i % len(variants)]
         t0 = time.perf_counter()
         fn(*args)
         best = min(best, time.perf_counter() - t0)
     return best
 
 
-def main():
-    _probe_accelerator()
-    import jax
-    import jax.numpy as jnp
-
+def bench_sspec_thth(jax, jnp):
+    """Configs #1+#3: sspec + 200-η θ-θ search, 4×2 grid of 256²
+    chunks (the headline; ref kernels dynspec.py:3584, ththmod.py:715)."""
     from scintools_tpu.sim.simulation import Simulation
     from scintools_tpu.ops.sspec import secondary_spectrum_power
     from scintools_tpu.ops.windows import get_window
-    from scintools_tpu.thth.core import (eval_calc_batch, fft_axis,
-                                         cs_to_ri)
+    from scintools_tpu.thth.core import eval_calc_batch, fft_axis, cs_to_ri
     from scintools_tpu.thth.batch import make_multi_eval_fn
     from scintools_tpu.thth.search import fit_eig_peak
 
-    # ---- workload generation (not timed) ----------------------------
     sim = Simulation(ns=512, nf=1024, dlam=0.25, seed=11, dt=2.0,
                      backend="jax")
-    dyn = np.asarray(sim.dyn, dtype=np.float64)      # (1024, 512) f×t
-    nf, nt = dyn.shape
-    dt, df = sim.dt, sim.df
-
-    cf, ct = 256, 256                                 # chunk size
-    ncf, nct = nf // cf, nt // ct                     # 4×2 chunk grid
+    dyn0 = np.asarray(sim.dyn, dtype=np.float64)      # (1024, 512) f×t
+    nf, nt = dyn0.shape
+    cf, ct = 256, 256
+    ncf, nct = nf // cf, nt // ct
     npad = 1
-    times = np.arange(ct) * dt
+    times = np.arange(ct) * sim.dt
     freqs = sim.freqs[:cf]
-    fd = fft_axis(times, pad=npad, scale=1e3)         # mHz
-    tau = fft_axis(freqs, pad=npad, scale=1.0)        # µs
+    fd = fft_axis(times, pad=npad, scale=1e3)
+    tau = fft_axis(freqs, pad=npad, scale=1.0)
     eta_c = tau.max() / (fd.max() / 8) ** 2
     etas = np.linspace(0.5 * eta_c, 2.0 * eta_c, 200)
     th_lim = 0.95 * min(np.sqrt(tau.max() / etas.max()), fd.max() / 2)
     edges = np.linspace(-th_lim, th_lim, 256)
-
-    CS_list = []
-    for icf in range(ncf):
-        for ict in range(nct):
-            chunk = dyn[icf * cf:(icf + 1) * cf,
-                        ict * ct:(ict + 1) * ct]
-            CS_list.append(np.fft.fftshift(np.fft.fft2(
-                np.pad(chunk, ((0, npad * cf), (0, npad * ct)),
-                       constant_values=chunk.mean()))))
-
     wins = get_window(nt, nf, window="hanning", frac=0.1)
 
-    # ---- numpy baseline (single CPU process, reference semantics:
-    # per-chunk loop, scipy eigsh per η — ththmod.py:789-799) ---------
-    def numpy_pipeline():
+    rng = np.random.default_rng(5)
+
+    def make_inputs(dyn):
+        CS_list = []
+        for icf in range(ncf):
+            for ict in range(nct):
+                chunk = dyn[icf * cf:(icf + 1) * cf,
+                            ict * ct:(ict + 1) * ct]
+                CS_list.append(np.fft.fftshift(np.fft.fft2(
+                    np.pad(chunk, ((0, npad * cf), (0, npad * ct)),
+                           constant_values=chunk.mean()))))
+        return CS_list
+
+    # perturbed input variants (see module docstring)
+    dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
+            for i in range(3)]
+    cs_lists = [make_inputs(d) for d in dyns]
+
+    # ---- numpy baseline: reference per-chunk loop, scipy eigsh/η ----
+    def numpy_pipeline(dyn, CS_list):
         sec = secondary_spectrum_power(dyn, window_arrays=wins,
                                        backend="numpy")
         eigs = [eval_calc_batch(CS, tau, fd, etas, edges,
                                 backend="numpy") for CS in CS_list]
         return sec, eigs
 
-    sec_np, eigs_np = numpy_pipeline()
-    t_np = _t(numpy_pipeline, repeats=2)
+    sec_np, eigs_np = numpy_pipeline(dyns[0], cs_lists[0])
+    t_np = _time_variants(numpy_pipeline,
+                          list(zip(dyns, cs_lists)), repeats=2)
 
-    # ---- jax path: one jitted program per kernel; complex stays
-    # internal (the tunneled TPU cannot transfer complex buffers);
-    # 'auto' → chunk-batched gather + VMEM-resident warm-start Pallas
-    # eigensolver on TPU (thth/batch.py), power iteration elsewhere ---
+    # ---- jax path: one jitted program --------------------------------
     eval_fn = make_multi_eval_fn(tau, fd, edges, iters=200,
                                  method="auto")
 
@@ -135,22 +157,30 @@ def main():
         eigs = eval_fn(cs_ri, e)
         return sec, eigs
 
-    d_j = jnp.asarray(dyn)
-    cs_j = jnp.asarray(np.stack([cs_to_ri(CS) for CS in CS_list],
-                                dtype=np.float32))
     e_j = jnp.asarray(etas)
-    sec_j, eigs_j = jax.block_until_ready(jax_pipeline(d_j, cs_j, e_j))
+    jvariants = [
+        (jnp.asarray(d),
+         jnp.asarray(np.stack([cs_to_ri(CS) for CS in cs])
+                     .astype(np.float32)), e_j)
+        for d, cs in zip(dyns, cs_lists)]
+    sec_j, eigs_j = jax.block_until_ready(jax_pipeline(*jvariants[0]))
 
-    def run_jax():
-        jax.block_until_ready(jax_pipeline(d_j, cs_j, e_j))
+    def run_jax(*args):
+        jax.block_until_ready(jax_pipeline(*args))
 
-    t_jax = _t(run_jax, repeats=3)
+    trace_dir = os.environ.get("SCINTOOLS_BENCH_TRACE")
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            run_jax(*jvariants[0])
+    # CPU fallback: one repeat keeps a dead-TPU bench inside the
+    # driver's budget (the jax-on-CPU headline run is ~70 s/call)
+    reps = 3 if jax.default_backend() != "cpu" else 1
+    t_jax = _time_variants(run_jax, jvariants, repeats=reps)
 
-    # ---- cross-backend curvature consistency (north-star Δη):
-    # flag only significant disagreement — flat-peak (arc-free) chunks
-    # have η-fit 1σ errors of tens of percent, so Δη must exceed both
-    # 1% and half the fit's own uncertainty to count ----------------
-    for b in range(len(CS_list)):
+    # ---- cross-backend Δη (north star <1%): compare only significant
+    # fits — flat-peak (arc-free) chunks have η errors of tens of % --
+    mismatches = []
+    for b in range(len(cs_lists[0])):
         eta_np, sig_np = fit_eig_peak(etas, np.asarray(eigs_np[b]),
                                       fw=0.2)
         eta_jx, _ = fit_eig_peak(etas, np.asarray(eigs_j[b]), fw=0.2)
@@ -158,16 +188,202 @@ def main():
             deta = abs(eta_jx - eta_np)
             if deta > 0.01 * abs(eta_np) and not (
                     np.isfinite(sig_np) and deta < 0.5 * sig_np):
-                print(f"WARNING: chunk {b} cross-backend eta mismatch "
-                      f"{deta/abs(eta_np):.3%} (sigma {sig_np:.3g})",
+                mismatches.append(b)
+                print(f"WARNING: chunk {b} cross-backend eta mismatch",
                       file=sys.stderr)
+    return {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+            "speedup": round(t_np / t_jax, 2),
+            "pixels_per_sec": round(nf * nt / t_jax, 1),
+            "eta_mismatch_chunks": mismatches}
 
-    pixels = nf * nt
+
+def bench_acf_fit(jax, jnp):
+    """Config #2: calc_acf + scint_acf_model fit (τ_d, Δν_d) on the
+    same 1024×512 spectrum (ref dynspec.py:3750 + scint_models.py:112)."""
+    from scintools_tpu.sim.simulation import Simulation
+    from scintools_tpu.fit import (Parameters, minimize_leastsq, models,
+                                   acf_cuts_batch, make_acf1d_batch)
+    from scintools_tpu.fit.batch import (bartlett_weights,
+                                         initial_guesses_batch)
+
+    sim = Simulation(ns=512, nf=1024, dlam=0.25, seed=12, dt=2.0,
+                     backend="jax")
+    dyn0 = np.asarray(sim.dyn, dtype=np.float64)
+    nf, nt = dyn0.shape
+    dt, df = sim.dt, sim.df
+    rng = np.random.default_rng(6)
+    dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
+            for i in range(3)]
+
+    # ---- numpy baseline: reference pipeline (host fft ACF + scipy) --
+    def numpy_fit(dyn):
+        tcut, fcut = acf_cuts_batch(dyn[None], backend="numpy")
+        yt, yf = tcut[0], fcut[0]
+        wt = bartlett_weights(yt, nt)
+        wf = bartlett_weights(yf, nf)
+        tau0, dnu0, amp0, _ = initial_guesses_batch(
+            yt, yf, dt, df, nt * dt, nf * df, np)
+        p = Parameters()
+        p.add("tau", value=float(tau0), vary=True, min=0, max=np.inf)
+        p.add("dnu", value=float(dnu0), vary=True, min=0, max=np.inf)
+        p.add("amp", value=float(amp0), vary=True, min=0, max=np.inf)
+        p.add("alpha", value=5 / 3, vary=False)
+        xt, xf = dt * np.arange(nt), df * np.arange(nf)
+        return minimize_leastsq(models.scint_acf_model, p,
+                                args=((xt, xf), (yt, yf), (wt, wf)))
+
+    res_np = numpy_fit(dyns[0])
+    t_np = _time_variants(lambda d: numpy_fit(d),
+                          [(d,) for d in dyns], repeats=2)
+
+    # ---- jax: batched ACF + vmapped LM, one program -----------------
+    from scintools_tpu.ops.acf import autocovariance
+    fit = make_acf1d_batch(nt, nf, dt, df)
+
+    @jax.jit
+    def jax_fit(d):
+        acf = autocovariance(d[None], backend="jax")
+        tcut = acf[:, nf, nt:]
+        fcut = acf[:, nf:, nt]
+        return fit(tcut, fcut)
+
+    out = jax.block_until_ready(jax_fit(jnp.asarray(dyns[0])))
+    jvars = [(jnp.asarray(d),) for d in dyns]
+    t_jax = _time_variants(
+        lambda d: jax.block_until_ready(jax_fit(d)), jvars, repeats=3)
+
+    dtau = abs(float(out["tau"][0]) - res_np.params["tau"].value)
+    ddnu = abs(float(out["dnu"][0]) - res_np.params["dnu"].value)
+    tol_tau = max(res_np.params["tau"].stderr or 0,
+                  0.05 * res_np.params["tau"].value)
+    tol_dnu = max(res_np.params["dnu"].stderr or 0,
+                  0.05 * res_np.params["dnu"].value)
+    return {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+            "speedup": round(t_np / t_jax, 2),
+            "params_agree": bool(dtau <= tol_tau and ddnu <= tol_dnu)}
+
+
+def bench_sim_batch(jax, jnp):
+    """Config #4: 64 Kolmogorov screens → dynspec → sspec, vmapped
+    (ref scint_sim.py:169-236). numpy runs the same 64 screens
+    serially through the reference algorithm."""
+    from scintools_tpu.sim.simulation import (Simulation,
+                                              simulate_dynspec_batch)
+    from scintools_tpu.ops.sspec import secondary_spectrum_power
+
+    nscreens, ns, nf = 64, 256, 64
+
+    # ---- jax: one batched program (screens batch axis, lax.map over
+    # frequency), then vmapped sspec power -----------------------------
+    def jax_run(seed):
+        dyns = simulate_dynspec_batch(nscreens, ns=ns, nf=nf, seed=seed)
+        power = jax.vmap(
+            lambda d: secondary_spectrum_power(d, backend="jax"))(
+                jnp.transpose(dyns, (0, 2, 1)))
+        return jax.block_until_ready(power)
+
+    jax_run(100)                                   # compile
+    t_jax = _time_variants(jax_run, [(101,), (102,), (103,)], repeats=3)
+
+    # ---- numpy: serial reference loop (one repeat — ~20 s) ----------
+    def numpy_run(seed0):
+        for i in range(nscreens):
+            sim = Simulation(ns=ns, nf=nf, seed=seed0 + i,
+                             backend="numpy")
+            secondary_spectrum_power(np.asarray(sim.dyn).T,
+                                     backend="numpy")
+
+    t_np = _time_variants(numpy_run, [(200,)], repeats=1)
+    return {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+            "speedup": round(t_np / t_jax, 2),
+            "screens_per_sec": round(nscreens / t_jax, 2)}
+
+
+def bench_survey(jax, jnp):
+    """Config #5: survey epochs/sec — sspec + full acf1d LM fit per
+    epoch, sharded/batched (ref survey loop dynspec.py:4357 + per-epoch
+    lmfit at :2698)."""
+    from scintools_tpu import parallel as par
+    from scintools_tpu.sim.simulation import simulate_dynspec_batch
+    from scintools_tpu.ops.sspec import secondary_spectrum_power
+    from scintools_tpu.fit import (Parameters, minimize_leastsq, models,
+                                   acf_cuts_batch)
+    from scintools_tpu.fit.batch import (bartlett_weights,
+                                         initial_guesses_batch)
+
+    B, nf, nt = 32, 256, 64
+    dt, df = 2.0, 0.05
+    epochs0 = np.transpose(np.asarray(
+        simulate_dynspec_batch(B + 3, ns=nt, nf=nf, seed=42)),
+        (0, 2, 1)).astype(np.float32)
+    variants = [epochs0[i:i + B] for i in range(3)]
+
+    mesh = par.make_mesh(min(jax.device_count(), B))
+    step = par.make_survey_step(mesh, nf, nt, dt=dt, df=df)
+    jax.block_until_ready(step(jnp.asarray(variants[0]))[1])
+    t_jax = _time_variants(
+        lambda d: jax.block_until_ready(step(d)[1]),
+        [(jnp.asarray(v),) for v in variants], repeats=3)
+
+    # ---- numpy: serial per-epoch reference pipeline -----------------
+    def numpy_survey(epochs):
+        for b in range(B):
+            dyn = epochs[b]
+            secondary_spectrum_power(dyn, backend="numpy")
+            tcut, fcut = acf_cuts_batch(dyn[None], backend="numpy")
+            yt, yf = tcut[0], fcut[0]
+            wt = bartlett_weights(yt, nt)
+            wf = bartlett_weights(yf, nf)
+            tau0, dnu0, amp0, _ = initial_guesses_batch(
+                yt, yf, dt, df, nt * dt, nf * df, np)
+            p = Parameters()
+            p.add("tau", value=float(tau0), vary=True, min=0,
+                  max=np.inf)
+            p.add("dnu", value=float(dnu0), vary=True, min=0,
+                  max=np.inf)
+            p.add("amp", value=float(amp0), vary=True, min=0,
+                  max=np.inf)
+            p.add("alpha", value=5 / 3, vary=False)
+            xt, xf = dt * np.arange(nt), df * np.arange(nf)
+            minimize_leastsq(models.scint_acf_model, p,
+                             args=((xt, xf), (yt, yf), (wt, wf)))
+
+    t_np = _time_variants(numpy_survey, [(v,) for v in variants],
+                          repeats=1)
+    return {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+            "speedup": round(t_np / t_jax, 2),
+            "epochs_per_sec": round(B / t_jax, 2)}
+
+
+def main():
+    probe, ok = probe_accelerator()
+    if not ok:
+        print("WARNING: accelerator probe failed; benchmarking jax on "
+              "CPU (details in JSON 'probe')", file=sys.stderr)
+        from scintools_tpu.backend import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.default_backend()
+    configs = {}
+    t0 = time.time()
+    configs["sspec_thth"] = bench_sspec_thth(jax, jnp)
+    configs["acf_fit"] = bench_acf_fit(jax, jnp)
+    configs["sim_batch"] = bench_sim_batch(jax, jnp)
+    configs["survey"] = bench_survey(jax, jnp)
+
+    head = configs["sspec_thth"]
     print(json.dumps({
         "metric": "sspec+thth curvature search throughput",
-        "value": round(pixels / t_jax, 1),
+        "value": head["pixels_per_sec"],
         "unit": "dynspec pixels/sec",
-        "vs_baseline": round(t_np / t_jax, 2),
+        "vs_baseline": head["speedup"],
+        "platform": platform,
+        "probe": probe,
+        "configs": configs,
+        "total_bench_s": round(time.time() - t0, 1),
     }))
 
 

@@ -1329,6 +1329,28 @@ class Dynspec:
             self.chunks = np.zeros(
                 (self.ncf_ret, self.nct_ret, self.cwf, self.cwt),
                 dtype=complex)
+        if self.backend == "jax":
+            # one jitted program per chunk geometry, batched over the
+            # time-chunks of each frequency row (edges/η are traced, so
+            # every row reuses the same compile); complex wavefields
+            # stay inside the program — never dropped to numpy
+            dt = self.times[1] - self.times[0]
+            df = self.freqs[1] - self.freqs[0]
+            for cf in range(self.ncf_ret):
+                row = []
+                for ct in range(self.nct_ret):
+                    dspec2, freq2, _ = self._chunk(cf, ct, fit=False)
+                    row.append(dspec2)
+                freq = freq2.mean()
+                eta = self.ththeta * (self.fref / freq) ** 2
+                self.chunks[cf] = thth_ret.chunk_retrieval_batch(
+                    np.stack(row), self.edges * (freq / self.fref),
+                    eta, dt, df, npad=self.npad,
+                    tau_mask=self.thth_tau_mask)
+                if verbose:
+                    print(f"retrieved row {cf + 1}/{self.ncf_ret} "
+                          f"({self.nct_ret} chunks, eta={eta:.4g})")
+            return
         for cf in range(self.ncf_ret):
             for ct in range(self.nct_ret):
                 dspec2, freq2, time2 = self._chunk(cf, ct, fit=False)

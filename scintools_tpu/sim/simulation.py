@@ -347,11 +347,19 @@ class Simulation:
         self.dm = np.asarray(self.xyp)[:, int(self.ny / 2)] * self.dlam / np.pi
 
 
-def simulate_dynspec_batch(nscreens, mb2=2, rf=1, ds=0.01, alpha=5 / 3,
-                           ar=1, psi=0, inner=0.001, ns=128, nf=128,
-                           dlam=0.25, seed=0):
-    """Batched screens → dynspecs, fully vmapped on the jax backend
-    (BASELINE config #4): one jit, batch dimension over seeds."""
+_BATCH_SIM_CACHE = {}
+
+
+def make_dynspec_batch_fn(mb2=2, rf=1, ds=0.01, alpha=5 / 3,
+                          ar=1, psi=0, inner=0.001, ns=128, nf=128,
+                          dlam=0.25):
+    """Build (and memoise) the jitted batched simulator
+    ``fn(keys[B]) → dynspecs[B, ns, nf]``. Memoisation matters:
+    re-jitting a fresh closure per call would retrace + recompile the
+    whole Fresnel loop on every invocation."""
+    cache_key = (mb2, rf, ds, alpha, ar, psi, inner, ns, nf, dlam)
+    if cache_key in _BATCH_SIM_CACHE:
+        return _BATCH_SIM_CACHE[cache_key]
     jax = get_jax()
     import jax.numpy as jnp
 
@@ -396,5 +404,20 @@ def simulate_dynspec_batch(nscreens, mb2=2, rf=1, ds=0.01, alpha=5 / 3,
         spe = propagate_batch(screens(keys))
         return jnp.real(spe * jnp.conj(spe))
 
+    fn = jax.jit(run)
+    _BATCH_SIM_CACHE[cache_key] = fn
+    return fn
+
+
+def simulate_dynspec_batch(nscreens, mb2=2, rf=1, ds=0.01, alpha=5 / 3,
+                           ar=1, psi=0, inner=0.001, ns=128, nf=128,
+                           dlam=0.25, seed=0):
+    """Batched screens → dynspecs, fully vmapped on the jax backend
+    (BASELINE config #4): one jit, batch dimension over seeds."""
+    jax = get_jax()
+
+    fn = make_dynspec_batch_fn(mb2=mb2, rf=rf, ds=ds, alpha=alpha,
+                               ar=ar, psi=psi, inner=inner, ns=ns,
+                               nf=nf, dlam=dlam)
     keys = jax.random.split(jax.random.PRNGKey(seed), nscreens)
-    return jax.jit(run)(keys)
+    return fn(keys)

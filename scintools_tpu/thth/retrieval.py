@@ -5,13 +5,22 @@ Re-design of ththmod.py:1223-1554 (chunk retrieval, mosaic) and
 hand-derives gradients and Hessians over ~400 lines; here the same
 objectives are written once as pure JAX functions and differentiated
 with autodiff (SURVEY.md §2.2 'mosaic stitching').
+
+TPU path: ``make_chunk_retrieval_fn`` packages the full retrieval —
+pad → fft2 → θ-θ gather → dominant eigenvector → wavefield-row
+injection → inverse-map scatter → ifft2 — as ONE jitted program over
+a whole chunk batch. Real floats at the program boundary (complex
+buffers cannot cross a program boundary on the tunneled TPU); complex
+math stays internal. Geometry (edges) and η are traced arguments, so
+one compile serves every frequency row of the retrieval grid.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from .core import modeler, rev_map, thth_redmap, unit_checks
+from .core import (modeler, rev_map, thth_redmap, unit_checks,
+                   fft_axis, keyed_jit_cache)
 from .search import chunk_conjugate_spectrum
 from ..backend import resolve_backend, get_jax
 
@@ -105,6 +114,173 @@ def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
         mE *= dspec_list[0].shape[0] * dspec_list[0].shape[1] / 4
         model_E.append(mE)
     return model_E, idx_f, idx_t
+
+
+# --------------------------------------------------------------------------
+# Jitted batched retrieval (TPU path)
+# --------------------------------------------------------------------------
+
+def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
+                            npad=3, method="eigh", iters=1024):
+    """Build the jitted batched retrieval program
+    ``fn(chunks[B, nf, nt], edges[n_edges], eta) → E_ri[B, 2, nf, nt]``
+    — the whole ``single_chunk_retrieval`` pipeline
+    (ththmod.py:1390-1476) as one device program per frequency row of
+    the retrieval grid.
+
+    Reproduces the reduced-map semantics with *masked fixed shapes*
+    (the reference crops the θ-θ to a data-dependent square,
+    ththmod.py:119-173; masking the invalid rows/columns leaves the
+    dominant eigenpair unchanged and keeps shapes static for jit). The
+    wavefield row is injected at the same θ-bin the cropped path would
+    use (index ``n_red//2`` of the valid set, located via a one-hot on
+    the running valid count), and the inverse-map scatter restricts
+    its bin-count normalisation to valid×valid pairs — bit-matching
+    the cropped ``rev_map`` (ththmod.py:176-271).
+
+    ``method='eigh'`` uses dense hermitian eigendecomposition (exact,
+    matches scipy eigsh); ``'power'`` uses the shifted power iteration
+    (``iters`` matvecs, cheaper on large edges grids). Eigenvector
+    global phase is arbitrary in both (as in the reference — the
+    mosaic phase-aligns chunks).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    times = np.arange(nt_chunk) * dt
+    freqs = np.arange(nf_chunk) * df
+    fd = fft_axis(times, pad=npad, scale=1e3)
+    tau = fft_axis(freqs, pad=npad, scale=1.0)
+    ntau, nfd = len(tau), len(fd)
+    dtau = np.diff(tau).mean()
+    dfd = np.diff(fd).mean()
+    n_th = n_edges - 1
+    tril_mask = jnp.asarray(np.tril(np.ones((n_th, n_th))) > 0)
+    anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
+
+    def retrieval(chunks, edges, eta, tau_mask):
+        B = chunks.shape[0]
+        # --- pad (mean fill) → conjugate spectra (ththmod.py:777-786)
+        mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)
+        support = jnp.pad(jnp.ones((nf_chunk, nt_chunk)),
+                          ((0, npad * nf_chunk), (0, npad * nt_chunk)))
+        padded = jnp.where(
+            support[None] > 0,
+            jnp.pad(chunks, ((0, 0), (0, npad * nf_chunk),
+                             (0, npad * nt_chunk))),
+            mu)
+        CS = jnp.fft.fftshift(jnp.fft.fft2(padded), axes=(1, 2))
+        CS = jnp.where(
+            (jnp.abs(jnp.asarray(tau)) >= tau_mask)[None, :, None],
+            CS, 0.0)
+
+        # --- θ-θ build, chunk-minor gather (shared η across the row)
+        cents = (edges[1:] + edges[:-1]) / 2
+        cents = cents - cents[jnp.argmin(jnp.abs(cents))]
+        th1 = cents[None, :] * jnp.ones((n_th, 1))
+        th2 = th1.T
+        CS_c = jnp.transpose(CS, (1, 2, 0))          # (ntau, nfd, B)
+        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau[0]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = jnp.floor(((th1 - th2) - fd[0] + dfd / 2)
+                           / dfd).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < ntau)
+                & (fd_inv < nfd) & (fd_inv >= -nfd))
+        vals = CS_c[jnp.where(pnts, tau_inv, 0), fd_inv % nfd, :]
+        thth = jnp.where(pnts[..., None], vals, 0.0)
+        thth = thth * (jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
+                       [..., None])
+        # hermitian symmetrisation (ththmod.py:109-114)
+        thth = jnp.where(tril_mask[..., None], 0.0, thth)
+        thth = thth + jnp.conj(jnp.transpose(thth, (1, 0, 2)))
+        thth = jnp.where(anti_eye[..., None], 0.0, thth)
+        thth = jnp.nan_to_num(thth)
+        # reduced-map valid square (ththmod.py:151-155), as a mask
+        valid = ((cents ** 2 * eta < jnp.abs(tau).max())
+                 & (jnp.abs(cents) < jnp.abs(fd).max() / 2))
+        thth = thth * valid[None, :, None] * valid[:, None, None]
+
+        # --- dominant eigenpair per chunk (ththmod.py:274-327)
+        A = jnp.transpose(thth, (2, 0, 1))           # (B, n, n)
+        if method == "eigh":
+            lam_all, V_all = jnp.linalg.eigh(A)
+            w = lam_all[:, -1]
+            V = V_all[:, :, -1]
+        else:
+            from .core import dominant_eig_power
+
+            def one(a):
+                lam, v = dominant_eig_power(a, iters=iters,
+                                            backend="jax")
+                return lam, v
+
+            w, V = jax.vmap(one)(A)
+        w = jnp.abs(w)
+        V = V * valid[None, :]
+
+        # --- wavefield row at the cropped path's middle bin ----------
+        n_red = jnp.sum(valid)
+        csum = jnp.cumsum(valid)
+        row_hot = (valid & (csum == n_red // 2 + 1)).astype(CS.dtype)
+        ththE = (row_hot[:, None]
+                 * (jnp.conj(V) * jnp.sqrt(w)[:, None])[:, None, :])
+        # (B, n_row, n_col)
+
+        # --- inverse map: weighted scatter, valid×valid counts only
+        # (ththmod.py:176-271 with hermetian=False)
+        fd_map = cents[None, :] - cents[:, None]
+        tau_map = eta * (cents[None, :] ** 2 - cents[:, None] ** 2)
+        wgt = ththE / jnp.sqrt(jnp.abs(2 * eta * fd_map.T))[None]
+        ix = jnp.floor((fd_map - (fd[0] - dfd / 2)) / dfd).astype(int)
+        iy = jnp.floor((tau_map - (tau[0] - dtau / 2)) / dtau).astype(int)
+        ok = ((ix >= 0) & (ix < nfd) & (iy >= 0) & (iy < ntau)
+              & valid[None, :] & valid[:, None])
+        ix = jnp.where(ok, ix, 0).ravel()
+        iy = jnp.where(ok, iy, 0).ravel()
+        wv = jnp.where(ok[None], wgt, 0.0).reshape(B, -1)
+        cnt = ok.astype(float).ravel()
+        acc = jnp.zeros((B, nfd, ntau), dtype=CS.dtype)
+        acc = acc.at[:, ix, iy].add(wv)
+        norm = jnp.zeros((nfd, ntau)).at[ix, iy].add(cnt)
+        recov = jnp.nan_to_num(acc / norm[None])
+        recov = jnp.transpose(recov, (0, 2, 1))      # (B, ntau, nfd)
+
+        # --- wavefield chunk (ththmod.py:1462-1468) ------------------
+        E = jnp.fft.ifft2(jnp.fft.ifftshift(recov, axes=(1, 2)),
+                          axes=(1, 2))[:, :nf_chunk, :nt_chunk]
+        E = E * (nf_chunk * nt_chunk / 4)
+        E = jnp.nan_to_num(E)
+        return jnp.stack([E.real, E.imag], axis=1)
+
+    return retrieval
+
+
+_RETRIEVAL_JIT_CACHE = {}
+
+
+def chunk_retrieval_batch(chunks, edges, eta, dt, df, npad=3,
+                          tau_mask=0.0, method="eigh", iters=1024):
+    """Jitted batched retrieval of one frequency row of chunks:
+    ``chunks[B, nf, nt]`` → complex wavefield chunks ``[B, nf, nt]``
+    (host numpy). One compile per chunk geometry — edges/η are traced,
+    so every row of the retrieval grid reuses the same program."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    chunks = np.asarray(chunks, dtype=float)
+    B, nf_chunk, nt_chunk = chunks.shape
+    edges = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    key = (nf_chunk, nt_chunk, float(dt), float(df), len(edges),
+           int(npad), method, int(iters))
+    fn = keyed_jit_cache(
+        _RETRIEVAL_JIT_CACHE, key,
+        lambda: make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df,
+                                        len(edges), npad=npad,
+                                        method=method, iters=iters))
+    E_ri = np.asarray(fn(jnp.asarray(chunks), jnp.asarray(edges),
+                         float(unit_checks(eta, "eta")),
+                         float(tau_mask)))
+    return E_ri[:, 0] + 1j * E_ri[:, 1]
 
 
 # --------------------------------------------------------------------------
