@@ -1,11 +1,16 @@
 """Benchmark: all five BASELINE.json configs, jax (TPU) vs numpy.
 
-Headline metric (continuity with BENCH_r01): configs #1+#3 — a
-1024×512 secondary spectrum plus a 200-η θ-θ eigenvalue curvature
-search over the full 4×2 grid of 256×256 chunks (the reference's
-``fit_thetatheta`` pool workload, dynspec.py:1681-1719), run as one
-chunk-batched device program with the VMEM-resident warm-start Pallas
-eigensolver (thth/batch.py). Also measured: #2 ACF+acf1d fit
+Headline metric (BASELINE.md north star): a **4096×4096** dynamic
+spectrum — full 8192²-padded secondary spectrum plus a 200-η θ-θ
+eigenvalue curvature search over the full 8×8 grid of 512×512 chunks
+(the reference's ``fit_thetatheta`` pool workload,
+dynspec.py:1681-1719), run as one jitted device program with the
+chunk batch walked in HBM-sized groups by ``lax.map`` and the
+VMEM-resident warm-start Pallas eigensolver (thth/batch.py). The
+input is synthesised from point images on a parabola of KNOWN
+curvature, so besides the numpy-vs-jax Δη cross-check the recovered
+η is also validated against ground truth. Also measured (continuity
+with BENCH_r01/r02): the former 1024×512 headline, #2 ACF+acf1d fit
 wall-time, #4 batched simulation screens/sec, #5 survey epochs/sec.
 
 Prints ONE JSON line. Honesty guarantees (VERDICT r1):
@@ -170,7 +175,9 @@ def bench_sspec_thth(jax, jnp):
 
     trace_dir = os.environ.get("SCINTOOLS_BENCH_TRACE")
     if trace_dir:
-        with jax.profiler.trace(trace_dir):
+        from scintools_tpu.utils.profiling import trace
+
+        with trace(trace_dir):
             run_jax(*jvariants[0])
     # CPU fallback: one repeat keeps a dead-TPU bench inside the
     # driver's budget (the jax-on-CPU headline run is ~70 s/call)
@@ -195,6 +202,156 @@ def bench_sspec_thth(jax, jnp):
             "speedup": round(t_np / t_jax, 2),
             "pixels_per_sec": round(nf * nt / t_jax, 1),
             "eta_mismatch_chunks": mismatches}
+
+
+def make_arc_dynspec(nt, nf, dt, df, f0, eta_true, n_images, seed,
+                     noise=0.02):
+    """Synthesise an (nf, nt) dynspec whose secondary spectrum carries
+    a scintillation arc of KNOWN curvature ``eta_true`` [us/mHz²]:
+    point images at Doppler fD_k with delay τ_k = η·fD_k² interfere
+    with a dominant central image (the standard thin-screen picture the
+    reference simulates physically, scint_sim.py:23-134 — here built
+    directly in delay-Doppler space as two matmuls so a 16 Mpx input
+    is cheap to generate and its ground truth is exact)."""
+    rng = np.random.default_rng(seed)
+    fd_k = np.concatenate([[0.0], rng.uniform(-80.0, 80.0, n_images)])
+    tau_k = eta_true * fd_k ** 2
+    amp_k = np.concatenate(
+        [[1.0], 0.12 * rng.uniform(0.3, 1.0, n_images)
+         * np.exp(1j * rng.uniform(0, 2 * np.pi, n_images))]
+    ).astype(complex)
+    dfreq = np.arange(nf) * df                  # MHz (offset from f0)
+    times = np.arange(nt) * dt                  # s
+    M1 = amp_k[None, :] * np.exp(2j * np.pi * np.outer(dfreq, tau_k))
+    M2 = np.exp(2j * np.pi * 1e-3 * np.outer(fd_k, times))
+    E = M1 @ M2                                 # (nf, nt) complex field
+    dyn = np.abs(E) ** 2
+    dyn += noise * dyn.std() * rng.standard_normal(dyn.shape)
+    return dyn
+
+
+def bench_north_star(jax, jnp):
+    """North star (BASELINE.md): 4096×4096 sspec + θ-θ curvature
+    search — 8×8 grid of 512² chunks (CS 1024² at npad=1), 200 η,
+    256 θ edges; ref kernels dynspec.py:3584 + ththmod.py:715."""
+    from scintools_tpu.ops.sspec import secondary_spectrum_power
+    from scintools_tpu.ops.windows import get_window
+    from scintools_tpu.thth.core import eval_calc_batch, fft_axis, cs_to_ri
+    from scintools_tpu.thth.batch import make_multi_eval_fn
+    from scintools_tpu.thth.search import fit_eig_peak
+
+    nf = nt = 4096
+    dt, df, f0 = 2.0, 0.05, 1400.0
+    eta_true = 5e-4                             # us/mHz²
+    cf = ct = 512
+    ncf, nct = nf // cf, nt // ct               # 8×8 = 64 chunks
+    npad = 1
+    group = int(os.environ.get("SCINTOOLS_BENCH_NS_GROUP", 8))
+    if (ncf * nct) % group:
+        raise ValueError(f"SCINTOOLS_BENCH_NS_GROUP={group} must "
+                         f"divide the chunk count {ncf * nct}")
+
+    dyn0 = make_arc_dynspec(nt, nf, dt, df, f0, eta_true,
+                            n_images=96, seed=21)
+    times = np.arange(ct) * dt
+    freqs = f0 + np.arange(cf) * df
+    fd = fft_axis(times, pad=npad, scale=1e3)   # mHz
+    tau = fft_axis(freqs, pad=npad, scale=1.0)  # us
+    etas = np.linspace(0.5 * eta_true, 2.0 * eta_true, 200)
+    th_lim = 0.95 * min(np.sqrt(tau.max() / etas.max()), fd.max() / 2)
+    edges = np.linspace(-th_lim, th_lim, 256)
+    wins = get_window(nt, nf, window="hanning", frac=0.1)
+
+    rng = np.random.default_rng(7)
+    dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
+            for i in range(2)]
+    n_chunks = ncf * nct
+
+    # Both pipelines are timed END-TO-END from the dynspec: window +
+    # 8192²-padded sspec FFT, per-chunk mean-pad + fft2 → CS, and the
+    # 200-η eigenvalue search over all 64 chunks. (Keeping the chunk
+    # FFTs inside the timed region also means only the 67 MB dynspec
+    # crosses the host↔TPU tunnel, not 0.5 GB of precomputed CS.)
+
+    # ---- numpy baseline: reference per-chunk loop, scipy eigsh/η ----
+    def numpy_pipeline(dyn):
+        sec = secondary_spectrum_power(dyn, window_arrays=wins,
+                                       backend="numpy")
+        eigs = []
+        for icf in range(ncf):
+            for ict in range(nct):
+                chunk = dyn[icf * cf:(icf + 1) * cf,
+                            ict * ct:(ict + 1) * ct]
+                CS = np.fft.fftshift(np.fft.fft2(
+                    np.pad(chunk, ((0, npad * cf), (0, npad * ct)),
+                           constant_values=chunk.mean())))
+                eigs.append(eval_calc_batch(CS, tau, fd, etas, edges,
+                                            backend="numpy"))
+        return sec, eigs
+
+    t0 = time.perf_counter()
+    sec_np, eigs_np = numpy_pipeline(dyns[0])
+    t_np = time.perf_counter() - t0             # one timed pass (~4 min)
+
+    # ---- jax: one jitted program, chunk groups walked by lax.map ----
+    eval_fn = make_multi_eval_fn(tau, fd, edges, iters=200,
+                                 method="auto")
+    support = np.pad(np.ones((cf, ct), np.float32),
+                     ((0, npad * cf), (0, npad * ct)))
+
+    @jax.jit
+    def jax_pipeline(d, e):
+        sec = secondary_spectrum_power(d, window_arrays=wins,
+                                       backend="jax")
+        chunks = d.reshape(ncf, cf, nct, ct).transpose(0, 2, 1, 3) \
+            .reshape(n_chunks, cf, ct)
+        mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)
+        padded = jnp.where(
+            jnp.asarray(support)[None] > 0,
+            jnp.pad(chunks, ((0, 0), (0, npad * cf), (0, npad * ct))),
+            mu)
+        CS = jnp.fft.fftshift(jnp.fft.fft2(padded), axes=(1, 2))
+        cs_ri = jnp.stack([CS.real, CS.imag], axis=1) \
+            .astype(jnp.float32)
+        grouped = cs_ri.reshape((n_chunks // group, group)
+                                + cs_ri.shape[1:])
+        eigs = jax.lax.map(lambda g: eval_fn(g, e), grouped)
+        return sec, eigs.reshape(n_chunks, -1)
+
+    e_j = jnp.asarray(etas)
+    jvariants = [(jnp.asarray(d, dtype=jnp.float32), e_j)
+                 for d in dyns]
+    sec_j, eigs_j = jax.block_until_ready(jax_pipeline(*jvariants[0]))
+
+    def run_jax(*args):
+        jax.block_until_ready(jax_pipeline(*args))
+
+    reps = 3 if jax.default_backend() != "cpu" else 1
+    t_jax = _time_variants(run_jax, jvariants, repeats=reps)
+
+    # ---- Δη: numpy-vs-jax cross-check AND vs ground truth ----------
+    mismatches, true_errs = [], []
+    for b in range(n_chunks):
+        eta_np, sig_np = fit_eig_peak(etas, np.asarray(eigs_np[b]),
+                                      fw=0.2)
+        eta_jx, _ = fit_eig_peak(etas, np.asarray(eigs_j[b]), fw=0.2)
+        if np.isfinite(eta_np) and np.isfinite(eta_jx) and eta_np != 0:
+            deta = abs(eta_jx - eta_np)
+            if deta > 0.01 * abs(eta_np) and not (
+                    np.isfinite(sig_np) and deta < 0.5 * sig_np):
+                mismatches.append(b)
+                print(f"WARNING: chunk {b} cross-backend eta mismatch",
+                      file=sys.stderr)
+        if np.isfinite(eta_jx):
+            true_errs.append(abs(eta_jx - eta_true) / eta_true)
+    return {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+            "speedup": round(t_np / t_jax, 2),
+            "pixels_per_sec": round(nf * nt / t_jax, 1),
+            "size": f"{nf}x{nt}", "n_chunks": n_chunks,
+            "eta_mismatch_chunks": mismatches,
+            "eta_vs_truth_median_pct":
+                round(100 * float(np.median(true_errs)), 3)
+                if true_errs else None}
 
 
 def bench_acf_fit(jax, jnp):
@@ -369,14 +526,15 @@ def main():
     platform = jax.default_backend()
     configs = {}
     t0 = time.time()
+    configs["north_star"] = bench_north_star(jax, jnp)
     configs["sspec_thth"] = bench_sspec_thth(jax, jnp)
     configs["acf_fit"] = bench_acf_fit(jax, jnp)
     configs["sim_batch"] = bench_sim_batch(jax, jnp)
     configs["survey"] = bench_survey(jax, jnp)
 
-    head = configs["sspec_thth"]
+    head = configs["north_star"]
     print(json.dumps({
-        "metric": "sspec+thth curvature search throughput",
+        "metric": "north-star 4096x4096 sspec+thth curvature search",
         "value": head["pixels_per_sec"],
         "unit": "dynspec pixels/sec",
         "vs_baseline": head["speedup"],

@@ -19,6 +19,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from scintools_tpu.sim import Simulation  # noqa: E402
 from scintools_tpu.dynspec import Dynspec, SimDyn  # noqa: E402
+from scintools_tpu.utils.profiling import Timer  # noqa: E402
 
 
 def main():
@@ -26,11 +27,15 @@ def main():
     ap.add_argument("--backend", default="numpy",
                     choices=["numpy", "jax"])
     ap.add_argument("--plot", action="store_true")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="write a jax.profiler trace to DIR")
     args = ap.parse_args()
+    tm = Timer()
 
     # --- simulate: Kolmogorov screen + Fresnel propagation ----------
-    sim = Simulation(ns=256, nf=256, mb2=2, seed=64, dt=30, freq=1400,
-                     dlam=0.02, backend=args.backend)
+    with tm("simulate"):
+        sim = Simulation(ns=256, nf=256, mb2=2, seed=64, dt=30,
+                         freq=1400, dlam=0.02, backend=args.backend)
     print(f"simulated dynspec {sim.dyn.shape}; "
           f"theoretical eta = {sim.eta:.2f} s^3, "
           f"betaeta = {sim.betaeta:.4g}")
@@ -38,16 +43,29 @@ def main():
     # --- measure through the Dynspec facade -------------------------
     ds = Dynspec(dyn=SimDyn(sim), verbose=False, process=False)
     ds.backend = args.backend
-    ds.calc_sspec(lamsteps=True)
-    ds.fit_arc(lamsteps=True, numsteps=5000)
+    if args.trace:
+        from scintools_tpu.utils.profiling import trace
+
+        ctx = trace(args.trace)
+    else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    with ctx:
+        with tm("sspec"):
+            ds.calc_sspec(lamsteps=True)
+        with tm("fit_arc"):
+            ds.fit_arc(lamsteps=True, numsteps=5000)
     rel = abs(ds.betaeta - sim.betaeta) / sim.betaeta
     print(f"fit_arc:  betaeta = {ds.betaeta:.4g} "
           f"+/- {ds.betaetaerr:.2g}  (rel err vs truth: {rel:.1%})")
 
     # --- scintillation timescale / bandwidth ------------------------
-    ds.get_scint_params(method="acf1d")
+    with tm("get_scint_params"):
+        ds.get_scint_params(method="acf1d")
     print(f"scint params: tau_d = {ds.tau:.1f} +/- {ds.tauerr:.1f} s, "
           f"dnu_d = {ds.dnu:.2f} +/- {ds.dnuerr:.2f} MHz")
+    print(tm.report())
 
     if args.plot:
         ds.plot_dyn(filename="sim_dynspec.png", display=False)

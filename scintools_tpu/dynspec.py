@@ -43,6 +43,13 @@ from .utils.misc import is_valid, svd_model
 SPEED_OF_LIGHT = 299792458.0  # m/s
 
 
+def _run_search_job(fn, args):
+    """Module-level pool worker: picklable trampoline for the
+    per-chunk θ-θ searches fanned over a user-supplied pool
+    (reference worker-function pattern, ththmod.py:518-519)."""
+    return fn(*args)
+
+
 class Dynspec:
     """Dynamic spectrum analysis object (reference: dynspec.py:41)."""
 
@@ -510,17 +517,31 @@ class Dynspec:
         ncfft = int(2 ** (np.ceil(np.log2(tnum)) + 1))
         cutsspec = np.empty((fcuts + 1, tcuts + 1, nrfft, ncfft))
         cutacf = np.empty((fcuts + 1, tcuts + 1, 2 * fnum, 2 * tnum))
+        sspec_x = sspec_y = None
         for ii in range(fcuts + 1):
             for jj in range(tcuts + 1):
                 tile = self.dyn[ii * fnum:(ii + 1) * fnum,
                                 jj * tnum:(jj + 1) * tnum]
                 cutdyn[ii][jj] = tile
-                _, _, cutsspec[ii][jj] = self.calc_sspec(
+                sspec_x, sspec_y, cutsspec[ii][jj] = self.calc_sspec(
                     input_dyn=tile, lamsteps=lamsteps)
                 cutacf[ii][jj] = self.calc_acf(input_dyn=tile)
         self.cutdyn = cutdyn
         self.cutsspec = cutsspec
         self.cutacf = cutacf
+        # tile axes for the plot grid (dynspec.py:3204-3209)
+        self.cut_times = [self.times[jj * tnum:(jj + 1) * tnum]
+                          for jj in range(tcuts + 1)]
+        self.cut_freqs = [self.freqs[ii * fnum:(ii + 1) * fnum]
+                          for ii in range(fcuts + 1)]
+        self.cut_sspec_x = np.asarray(sspec_x)
+        self.cut_sspec_y = np.asarray(sspec_y)
+        if plot:
+            from . import plotting
+            plotting.plot_cut_tiles(self, lamsteps=lamsteps,
+                                    maxfdop=maxfdop, filename=filename,
+                                    display=display, figsize=figsize,
+                                    dpi=dpi)
 
     # ------------------------------------------------------------------
     # Arc curvature
@@ -771,7 +792,8 @@ class Dynspec:
             ((xdata_t, xdata_f), (ydata_t, ydata_f),
              (weights_t, weights_f)), max_nfev=50000,
             nan_policy=nan_policy, mcmc=mcmc, nwalkers=nwalkers,
-            steps=steps, burn=burn, progress=progress)
+            steps=steps, burn=burn, progress=progress,
+            backend=self.backend)
 
         if results.params["dnu"].stderr is not None:
             for k in ("tau", "dnu", "amp"):
@@ -841,7 +863,8 @@ class Dynspec:
                 (tdata, fdata, ydata_2d, weights_2d), mcmc=mcmc,
                 max_nfev=50000, nan_policy=nan_policy, steps=steps,
                 burn=burn, progress=progress, workers=workers,
-                nwalkers=nwalkers, is_weighted=(not lnsigma))
+                nwalkers=nwalkers, is_weighted=(not lnsigma),
+                backend=self.backend)
 
             if method == "acf2d":
                 params2d = results.params.copy()
@@ -850,14 +873,29 @@ class Dynspec:
                 params2d.add("psi", value=60, vary=True)
                 params2d["phasegrad"].value = 0.0
                 chisqr = np.inf
-                for _ in range(nitr):
-                    res = fitter(
-                        mdl.scint_acf_model_2d, params2d,
-                        (ydata_2d, weights_2d), mcmc=mcmc,
-                        nwalkers=nwalkers, steps=steps, burn=burn,
-                        progress=progress, workers=workers,
-                        max_nfev=90000, nan_policy=nan_policy,
-                        is_weighted=(not lnsigma))
+                use_tpu_lm = (self.backend == "jax" and not mcmc
+                              and ydata_2d.shape[0] % 2 == 1
+                              and ydata_2d.shape[1] % 2 == 1)
+                # fit_acf2d_tpu is deterministic from an unchanged
+                # start, so restart iterations would be identical
+                for _ in range(1 if use_tpu_lm else nitr):
+                    if use_tpu_lm:
+                        # whole fit (model + jacobian + LM) is one
+                        # compiled program (fit/acf2d.py); reference
+                        # host loop: dynspec.py:2858-2909
+                        from .fit.acf2d import fit_acf2d_tpu
+
+                        res = fit_acf2d_tpu(params2d, ydata_2d,
+                                            weights_2d)
+                    else:
+                        res = fitter(
+                            mdl.scint_acf_model_2d, params2d,
+                            (ydata_2d, weights_2d), mcmc=mcmc,
+                            nwalkers=nwalkers, steps=steps, burn=burn,
+                            progress=progress, workers=workers,
+                            max_nfev=90000, nan_policy=nan_policy,
+                            is_weighted=(not lnsigma),
+                            backend=self.backend)
                     if res.chisqr < chisqr:
                         chisqr = res.chisqr
                         results = res
@@ -982,6 +1020,15 @@ class Dynspec:
         fse_dnu = self.dnu / (2 * np.sqrt(N))
         self.fse_tilt = self.acf_tilt * np.sqrt(
             (fse_dnu / self.dnu) ** 2 + (fse_tau / self.tau) ** 2)
+
+        if plot:
+            from . import plotting
+            yfit = params[0] * peaks + params[1]
+            plotting.plot_acf_tilt(
+                self, peaks, peakerrs, ys, yfit,
+                nscaleplot=nscaleplot, tmaxplot=tmaxplot,
+                fmaxplot=fmaxplot, filename=filename, display=display,
+                dpi=dpi)
 
     # ------------------------------------------------------------------
     # Scattered image
@@ -1248,18 +1295,29 @@ class Dynspec:
         return res
 
     def fit_thetatheta(self, verbose=False, plot=False, pool=None,
-                       time_avg=False):
+                       time_avg=False, mesh=None):
         """Per-chunk η(f,t) searches → weighted global η∝f⁻² fit
-        (dynspec.py:1657-1763)."""
+        (dynspec.py:1657-1763).
+
+        ``pool`` is accepted for reference API parity
+        (dynspec.py:1669-1671) and used as-is on the numpy backend; on
+        the jax backend chunk fan-out is a batched device program per
+        frequency row, so a process pool would only add overhead and
+        is ignored. ``mesh``: a ``jax.sharding.Mesh`` — the WHOLE
+        chunk grid runs as one SPMD program with chunks sharded
+        across the mesh devices
+        (parallel/survey.py:make_thth_grid_search_sharded).
+        """
         if not hasattr(self, "cwf"):
             self.prep_thetatheta(verbose=verbose)
         self.eta_evo = np.zeros((self.ncf_fit, self.nct_fit))
         self.eta_evo_err = np.zeros((self.ncf_fit, self.nct_fit))
         self.f0s = np.zeros(self.ncf_fit)
         self.t0s = np.zeros(self.nct_fit)
-        if (self.backend != "numpy"
-                and self.thetatheta_proc != "thin"
-                and self.nct_fit > 1):
+        if (mesh is not None and self.backend != "numpy"
+                and self.thetatheta_proc != "thin"):
+            self._fit_thetatheta_sharded(mesh, verbose=verbose)
+        elif self.backend != "numpy" and self.nct_fit > 1:
             # all time-chunks of one frequency row share geometry →
             # one batched device program per row (replaces the
             # reference's pool.map chunk fan-out, dynspec.py:1715-1719)
@@ -1273,16 +1331,68 @@ class Dynspec:
                                    np.log10(self.eta_max), self.neta) \
                     * (self.fref / freq2.mean()) ** 2
                 edges = self.edges * (freq2.mean() / self.fref)
-                results = thth_search.multi_chunk_search(
-                    chunks, freq2, tlist, etas, edges, fw=self.fw,
-                    npad=self.npad,
-                    coher=(self.thetatheta_proc != "incoherent"),
-                    tau_mask=self.thth_tau_mask, backend=self.backend)
+                if self.thetatheta_proc == "thin":
+                    results = thth_search.multi_chunk_search_thin(
+                        chunks, freq2, tlist, etas, edges,
+                        edges[np.abs(edges) < self.arclet_lim],
+                        self.center_cut, fw=self.fw, npad=self.npad,
+                        tau_mask=self.thth_tau_mask,
+                        backend=self.backend)
+                else:
+                    results = thth_search.multi_chunk_search(
+                        chunks, freq2, tlist, etas, edges, fw=self.fw,
+                        npad=self.npad,
+                        coher=(self.thetatheta_proc != "incoherent"),
+                        tau_mask=self.thth_tau_mask,
+                        backend=self.backend)
                 for ct, res in enumerate(results):
                     self.eta_evo[cf, ct] = res.eta
                     self.eta_evo_err[cf, ct] = res.eta_sig
                     self.f0s[cf] = res.freq_mean
                     self.t0s[ct] = res.time_mean
+                if verbose:
+                    ok = np.isfinite(self.eta_evo[cf])
+                    print(f"Chunk row {cf + 1}/{self.ncf_fit} "
+                          f"(f={self.f0s[cf]:.1f} MHz): "
+                          f"{int(ok.sum())}/{self.nct_fit} fits, "
+                          f"median eta="
+                          f"{np.nanmedian(self.eta_evo[cf]):.4g}")
+        elif pool is not None:
+            # reference pool semantics (dynspec.py:1715-1719): fan the
+            # per-chunk searches over the user-supplied worker pool
+            jobs = []
+            for cf in range(self.ncf_fit):
+                for ct in range(self.nct_fit):
+                    dspec2, freq2, time2 = self._chunk(cf, ct, fit=True)
+                    etas = np.logspace(np.log10(self.eta_min),
+                                       np.log10(self.eta_max),
+                                       self.neta) \
+                        * (self.fref / freq2.mean()) ** 2
+                    edges = self.edges * (freq2.mean() / self.fref)
+                    if self.thetatheta_proc == "thin":
+                        jobs.append((thth_search.single_search_thin,
+                                     (dspec2, freq2, time2, etas, edges,
+                                      edges[np.abs(edges)
+                                            < self.arclet_lim],
+                                      self.center_cut, self.fw,
+                                      self.npad, True,
+                                      self.thth_tau_mask, False,
+                                      "numpy")))
+                    else:
+                        jobs.append((thth_search.single_search,
+                                     (dspec2, freq2, time2, etas, edges,
+                                      self.fw, self.npad,
+                                      self.thetatheta_proc
+                                      != "incoherent",
+                                      self.thth_tau_mask, False,
+                                      "numpy")))
+            results = pool.starmap(_run_search_job, jobs)
+            for i, res in enumerate(results):
+                cf, ct = divmod(i, self.nct_fit)
+                self.eta_evo[cf, ct] = res.eta
+                self.eta_evo_err[cf, ct] = res.eta_sig
+                self.f0s[cf] = res.freq_mean
+                self.t0s[ct] = res.time_mean
         else:
             for cf in range(self.ncf_fit):
                 for ct in range(self.nct_fit):
@@ -1316,6 +1426,63 @@ class Dynspec:
                                 * self.eta_evo_err)[tofit] ** 2))
         self.ththeta = A / self.fref ** 2
         self.ththetaerr = A_err / self.fref ** 2
+
+    def _fit_thetatheta_sharded(self, mesh, verbose=False):
+        """SPMD chunk-grid search: every (cf, ct) chunk of the θ-θ fit
+        grid runs in ONE jitted program with the chunk axis sharded
+        over ``mesh`` (reference pool.map: dynspec.py:1715-1719)."""
+        import jax.numpy as jnp
+
+        from . import parallel as par
+        from .thth.core import cs_to_ri
+        from .thth.search import (chunk_conjugate_spectrum,
+                                  fit_eig_peak)
+
+        cs_list, edges_list, etas_list, meta = [], [], [], []
+        tau = fd = None
+        for cf in range(self.ncf_fit):
+            for ct in range(self.nct_fit):
+                dspec2, freq2, time2 = self._chunk(cf, ct, fit=True)
+                CS, tau, fd = chunk_conjugate_spectrum(
+                    dspec2, time2, freq2, npad=self.npad,
+                    tau_mask=self.thth_tau_mask)
+                base = (CS if self.thetatheta_proc != "incoherent"
+                        else np.abs(CS))
+                cs_list.append(cs_to_ri(base).astype(np.float32))
+                etas_list.append(
+                    np.logspace(np.log10(self.eta_min),
+                                np.log10(self.eta_max), self.neta)
+                    * (self.fref / freq2.mean()) ** 2)
+                edges_list.append(self.edges
+                                  * (freq2.mean() / self.fref))
+                meta.append((cf, ct, float(freq2.mean()),
+                             float(time2.mean())))
+
+        B = len(cs_list)
+        ndev = int(np.prod(list(mesh.shape.values())))
+        pad = (-B) % ndev
+        for _ in range(pad):            # dummy chunks keep B | ndev
+            cs_list.append(cs_list[0])
+            etas_list.append(etas_list[0])
+            edges_list.append(edges_list[0])
+
+        fn = par.make_thth_grid_search_sharded(
+            mesh, tau, fd, len(self.edges))
+        eigs = np.asarray(fn(jnp.asarray(np.stack(cs_list)),
+                             jnp.asarray(np.stack(edges_list)),
+                             jnp.asarray(np.stack(etas_list))))[:B]
+
+        for i, (cf, ct, f_m, t_m) in enumerate(meta):
+            eta_fit, eta_sig = fit_eig_peak(etas_list[i], eigs[i],
+                                            fw=self.fw)
+            self.eta_evo[cf, ct] = eta_fit
+            self.eta_evo_err[cf, ct] = eta_sig
+            self.f0s[cf] = f_m
+            self.t0s[ct] = t_m
+        if verbose:
+            ok = np.isfinite(self.eta_evo)
+            print(f"Sharded chunk grid: {int(ok.sum())}/{B} "
+                  f"chunk fits on {ndev} devices")
 
     def thetatheta_chunks(self, verbose=False, pool=None, memmap=False):
         """Half-overlapping retrieval chunk grid (dynspec.py:1765-1826)."""
@@ -1456,14 +1623,17 @@ class Dynspec:
 
     def plot_acf(self, method="acf1d", alpha=5 / 3, contour=False,
                  filename=None, input_acf=None, input_t=None,
-                 input_f=None, fit=True, mcmc=False, display=True,
-                 figsize=(9, 9), dpi=200, crop=False):
+                 input_f=None, nscale=4, mcmc=False, display=True,
+                 crop=False, tlim=None, flim=None, figsize=(9, 9),
+                 verbose=False, dpi=200):
         from . import plotting
-        return plotting.plot_acf(self, contour=contour,
-                                 filename=filename, input_acf=input_acf,
-                                 input_t=input_t, input_f=input_f,
-                                 display=display, figsize=figsize,
-                                 dpi=dpi)
+        return plotting.plot_acf(self, method=method, alpha=alpha,
+                                 contour=contour, filename=filename,
+                                 input_acf=input_acf, input_t=input_t,
+                                 input_f=input_f, nscale=nscale,
+                                 mcmc=mcmc, display=display, crop=crop,
+                                 tlim=tlim, flim=flim, figsize=figsize,
+                                 verbose=verbose, dpi=dpi)
 
     def plot_sspec(self, lamsteps=False, input_sspec=None, filename=None,
                    input_x=None, input_y=None, trap=False,

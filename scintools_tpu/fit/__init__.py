@@ -3,12 +3,15 @@
 
 from .parameters import Parameters
 from .fitter import fitter, minimize_leastsq, sample_emcee
+from .ensemble import (sample_emcee_jax, make_ensemble_sampler,
+                       make_logp)
 from .lm_jax import make_lm_solver, lm_covariance
 from .batch import (make_acf1d_batch, make_acf1d_fit_one,
                     scint_params_batch, acf_cuts_batch)
 from . import models
 
 __all__ = ["Parameters", "fitter", "minimize_leastsq", "sample_emcee",
+           "sample_emcee_jax", "make_ensemble_sampler", "make_logp",
            "make_lm_solver", "lm_covariance", "make_acf1d_batch",
            "make_acf1d_fit_one", "scint_params_batch", "acf_cuts_batch",
            "models"]

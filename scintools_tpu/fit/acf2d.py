@@ -1,0 +1,95 @@
+"""TPU-resident acf2d fit: jitted analytic-ACF model + jitted LM.
+
+The reference's hottest fit (`get_scint_params(method='acf2d')`,
+/root/reference/scintools/dynspec.py:2858-2909) rebuilds the
+theoretical ``ACF`` on the host for every residual evaluation inside
+scipy least-squares (scint_models.py:164-215 → scint_sim.py:417-765).
+Here the model (sim/acf_model.py:make_acf2d_model_fn) and the
+Levenberg–Marquardt loop (fit/lm_jax.py) are ONE compiled program: the
+residual, its forward-mode jacobian over the ~5 varying parameters,
+and the damped normal-equation solve all run on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+from .fitter import MinimizerResult
+from .lm_jax import make_lm_solver, lm_covariance
+
+MODEL_ARGS = ("tau", "dnu", "amp", "phasegrad", "psi", "wn", "alpha")
+
+
+def _spike_zero_weights(weights, shape):
+    """The white-noise spike is not fitted (scint_models.py:125-127)."""
+    w = (np.ones(shape) if weights is None
+         else np.array(weights, dtype=float))
+    w = np.fft.fftshift(w)
+    w[-1, -1] = 0
+    return np.fft.ifftshift(w)
+
+
+def fit_acf2d_tpu(params, ydata, weights, n_iter=60):
+    """Drop-in acf2d fit on the jax backend.
+
+    params must carry the reference parameter set (tau, dnu, amp,
+    phasegrad, psi varying as configured; ar/theta/alpha/nt/nf/tobs/bw
+    fixed — dynspec.py:2858-2871). Returns a MinimizerResult with
+    lmfit-convention stderr from the Gauss-Newton covariance.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    from ..sim.acf_model import make_acf2d_model_fn
+
+    ydata = np.asarray(ydata, dtype=float)
+    nf_crop, nt_crop = ydata.shape
+    p = {k: v.value for k, v in params.items()}
+    dt = 2 * p["tobs"] / p["nt"]
+    df = 2 * p["bw"] / p["nf"]
+    model = make_acf2d_model_fn(
+        nt_crop, nf_crop, dt, df, abs(p["ar"]), p["alpha"], p["theta"],
+        tau0=abs(p["tau"]))    # alpha traced per-eval when it varies
+
+    vary = [n for n in MODEL_ARGS
+            if n in params and params[n].vary]
+    fixed = {n: float(p.get(n, 0.0)) for n in MODEL_ARGS
+             if n not in vary}
+
+    w_j = jnp.asarray(_spike_zero_weights(weights, ydata.shape))
+    y_j = jnp.asarray(ydata)
+    # triangle tapers (scint_models.py:119-121): τmax·τ = nt_crop·dt
+    # regardless of the current τ, so both tapers are static
+    tri_t = 1 - np.abs(np.linspace(-nt_crop * dt, nt_crop * dt,
+                                   nt_crop)) / p["tobs"]
+    tri_f = 1 - np.abs(np.linspace(-nf_crop * df, nf_crop * df,
+                                   nf_crop)) / p["bw"]
+    tri_j = jnp.asarray(np.outer(tri_f, tri_t))
+
+    def residual(x):
+        kw = dict(fixed)
+        for i, n in enumerate(vary):
+            kw[n] = x[i]
+        m = model(kw["tau"], kw["dnu"], kw["amp"], kw["phasegrad"],
+                  kw["psi"], kw["wn"], kw["alpha"]) * tri_j
+        return ((y_j - m) * w_j).ravel()
+
+    lo = np.array([params[n].min for n in vary], dtype=float)
+    hi = np.array([params[n].max for n in vary], dtype=float)
+    x0 = np.array([p[n] for n in vary], dtype=float)
+    solver = jax.jit(make_lm_solver(residual, n_iter=n_iter,
+                                    bounds=(lo, hi)))
+    x, cost = jax.block_until_ready(solver(jnp.asarray(x0)))
+    x = np.asarray(x, dtype=float)
+    cov = np.asarray(lm_covariance(residual, jnp.asarray(x)))
+
+    out = params.copy()
+    for i, n in enumerate(vary):
+        out[n].value = float(abs(x[i]) if n in ("tau", "dnu")
+                             else x[i])
+        out[n].stderr = float(np.sqrt(np.abs(cov[i, i])))
+    res = np.asarray(residual(jnp.asarray(x)))
+    result = MinimizerResult(out, residual=res, nfev=n_iter,
+                             message="jitted LM (fit/acf2d.py)")
+    return result

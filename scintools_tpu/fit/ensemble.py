@@ -1,0 +1,174 @@
+"""TPU-resident affine-invariant ensemble MCMC (vmapped walkers).
+
+The reference runs lmfit's ``Minimizer.emcee`` with process-based
+walker parallelism (``workers=`` — /root/reference/scintools/
+scint_models.py:38-39, dynspec.py:2548-2551). At its defaults
+(50 walkers × 10,000 steps) that is ~10⁶ serial residual calls. Here
+the whole sampler is ONE jitted program: a ``lax.scan`` over steps
+whose body evaluates the log-probability of every proposal with
+``jax.vmap`` — the stretch move (Goodman & Weare 2010, the emcee
+algorithm) updates each half of the ensemble against the other, so
+one scan step = two vmapped half-updates. Walker chains live on
+device; burn/thin slicing happens once on host at the end.
+
+The host/numpy sampler in ``fitter.py`` remains the bit-reproducible
+fallback; cross-backend agreement is statistical (different RNGs) and
+is asserted in tests/test_ensemble.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+from .fitter import MinimizerResult, _residual_vector
+
+
+def make_logp(model, params, args, is_weighted=True, backend="jax"):
+    """Build a scalar jax log-probability ``logp(x) -> float`` over the
+    varying-parameter vector ``x``, with lmfit ``Minimizer.emcee``
+    likelihood semantics (is_weighted / __lnsigma, see fitter._log_prob).
+
+    The model must be xp-generic (every model in fit/models.py is); it
+    is called as ``model(valuesdict, *args, backend='jax')``.
+    """
+    import jax.numpy as jnp
+
+    params = params.copy()
+    names = params.varying_names()
+    lo, hi = params.varying_bounds()
+    fixed = {k: v.value for k, v in params.items() if not v.vary}
+    lo_j, hi_j = jnp.asarray(lo), jnp.asarray(hi)
+    n_model = len(names)
+
+    def logp(x):
+        xv = x[:n_model] if not is_weighted else x
+        pd = dict(fixed)
+        for i, name in enumerate(names):
+            pd[name] = xv[i]
+        r = jnp.ravel(model(pd, *args, backend=backend))
+        if is_weighted:
+            ll = -0.5 * jnp.sum(r * r)
+        else:
+            lnsigma = x[-1]
+            s2 = jnp.exp(2.0 * lnsigma)
+            ll = -0.5 * jnp.sum(r * r / s2 + jnp.log(2 * np.pi * s2))
+        in_bounds = jnp.all(xv >= lo_j) & jnp.all(xv <= hi_j)
+        return jnp.where(jnp.isfinite(ll) & in_bounds, ll, -jnp.inf)
+
+    return logp, names
+
+
+def make_ensemble_sampler(logp, nwalkers, ndim, a=2.0):
+    """Compile ``run(key, pos0, steps) -> (chain, logps)`` where chain
+    is (steps, nwalkers, ndim) and ``steps`` is static.
+
+    One scan step performs the two stretch-move half-updates of the
+    emcee red-black scheme; all walker log-probs evaluate under vmap.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    if nwalkers % 2:
+        raise ValueError("nwalkers must be even for the half-ensemble "
+                         "stretch move")
+    half = nwalkers // 2
+    vlogp = jax.vmap(logp)
+
+    def half_update(active, other, lp_active, key):
+        ku, kp, ka = jax.random.split(key, 3)
+        z = ((a - 1.0) * jax.random.uniform(ku, (half,)) + 1.0) ** 2 / a
+        partners = jax.random.randint(kp, (half,), 0, half)
+        comp = other[partners]
+        prop = comp + z[:, None] * (active - comp)
+        lp_prop = vlogp(prop)
+        log_accept = (ndim - 1) * jnp.log(z) + lp_prop - lp_active
+        accept = jnp.log(jax.random.uniform(ka, (half,))) < log_accept
+        active = jnp.where(accept[:, None], prop, active)
+        lp_active = jnp.where(accept, lp_prop, lp_active)
+        return active, lp_active, accept
+
+    def step(carry, key):
+        pos, lp = carry
+        k1, k2 = jax.random.split(key)
+        first, lp1, acc1 = half_update(pos[:half], pos[half:],
+                                       lp[:half], k1)
+        second, lp2, acc2 = half_update(pos[half:], first,
+                                        lp[half:], k2)
+        pos = jnp.concatenate([first, second])
+        lp = jnp.concatenate([lp1, lp2])
+        n_acc = jnp.sum(acc1) + jnp.sum(acc2)
+        return (pos, lp), (pos, lp, n_acc)
+
+    def run(key, pos0, steps):
+        lp0 = vlogp(pos0)
+        keys = jax.random.split(key, steps)
+        (_, _), (chain, logps, n_acc) = jax.lax.scan(
+            step, (pos0, lp0), keys)
+        return chain, logps, jnp.sum(n_acc) / (steps * nwalkers)
+
+    return jax.jit(run, static_argnames="steps")
+
+
+def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
+                     burn=0.2, thin=10, pos=None, seed=0,
+                     progress=False, is_weighted=True):
+    """Drop-in TPU replacement for :func:`fitter.sample_emcee` — same
+    result contract (MinimizerResult with flatchain / median / std),
+    different RNG stream (jax.random vs numpy Generator), so agreement
+    with the host sampler is statistical, not bitwise.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    params = params.copy()
+    names = params.varying_names()
+    lo, hi = params.varying_bounds()
+    x0 = params.varying_values()
+    logp, _ = make_logp(model, params, args, is_weighted=is_weighted)
+    if not is_weighted:
+        names = names + ["__lnsigma"]
+        lo = np.append(lo, -np.inf)
+        hi = np.append(hi, np.inf)
+        x0 = np.append(x0, np.log(0.1))
+    ndim = len(names)
+
+    rng = np.random.default_rng(None if seed is None else seed)
+    if pos is None:
+        scale = np.where(np.isfinite(hi - lo), (hi - lo) * 1e-2,
+                         1e-4 * np.maximum(np.abs(x0), 1.0))
+        pos = x0 + scale * rng.standard_normal((nwalkers, ndim))
+        pos = np.clip(pos, lo, hi)
+    else:
+        pos = np.array(pos, dtype=float)
+        nwalkers = pos.shape[0]
+        if not is_weighted and pos.shape[1] == ndim - 1:
+            lns = np.log(0.1) + 1e-4 * rng.standard_normal((nwalkers, 1))
+            pos = np.concatenate([pos, lns], axis=1)
+        if pos.shape[1] != ndim:
+            raise ValueError(f"pos has {pos.shape[1]} columns, "
+                             f"expected {ndim} ({names})")
+    if nwalkers % 2:
+        raise ValueError("nwalkers must be even")
+
+    run = make_ensemble_sampler(logp, nwalkers, ndim)
+    key = jax.random.PRNGKey(0 if seed is None else seed)
+    chain, logps, acc_frac = run(key, jnp.asarray(pos), steps)
+    chain = np.asarray(chain)                     # (steps, nw, ndim)
+
+    nburn = int(burn * steps) if burn < 1 else int(burn)
+    kept = chain[nburn::thin] if nburn < steps else chain[-1:]
+    flat = kept.reshape(-1, ndim)
+    for i, name in enumerate(names):
+        if name == "__lnsigma":
+            continue
+        params[name].value = float(np.median(flat[:, i]))
+        params[name].stderr = float(np.std(flat[:, i]))
+    res = _residual_vector(model, params, args)
+    result = MinimizerResult(params, residual=res,
+                             nfev=nwalkers * steps,
+                             nextra_vary=0 if is_weighted else 1)
+    result.flatchain = flat
+    result.var_names = names
+    result.acceptance_fraction = float(acc_frac)
+    return result

@@ -216,11 +216,28 @@ def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
 def fitter(model, params, args, mcmc=False, pos=None, nwalkers=100,
            steps=1000, burn=0.2, progress=True, workers=1,
            nan_policy="raise", max_nfev=None, thin=10, is_weighted=True,
-           seed=0):
+           seed=0, backend=None):
     """Uniform driver matching the reference ``fitter`` signature
     (scint_models.py:29-46). ``workers`` is accepted for API parity;
-    parallelism here is vectorised rather than process-based."""
+    parallelism here is vectorised rather than process-based: on
+    ``backend='jax'`` the MCMC path runs the fully-jitted vmapped
+    ensemble sampler (fit/ensemble.py) — the TPU replacement for the
+    reference's emcee ``workers=`` process pool."""
+    from ..backend import resolve_backend
+
     if mcmc:
+        if resolve_backend(backend) == "jax":
+            from .ensemble import sample_emcee_jax
+
+            try:
+                return sample_emcee_jax(
+                    model, params, args, nwalkers=nwalkers, steps=steps,
+                    burn=burn, thin=thin, pos=pos, progress=progress,
+                    seed=seed, is_weighted=is_weighted)
+            except Exception as exc:  # non-traceable model → host path
+                print(f"Warning: jax ensemble sampler unavailable for "
+                      f"{getattr(model, '__name__', model)} ({exc}); "
+                      f"falling back to the host sampler")
         return sample_emcee(model, params, args, nwalkers=nwalkers,
                             steps=steps, burn=burn, thin=thin, pos=pos,
                             progress=progress, seed=seed,

@@ -33,6 +33,28 @@ from ..ops.windows import get_window
 from ..thth.core import make_eval_fn
 
 
+def make_thth_grid_search_sharded(mesh, tau, fd, n_edges, iters=64):
+    """Whole θ-θ chunk grid sharded over the device mesh:
+    ``fn(CS_ri[B, 2, ntau, nfd], edges[B, n], etas[B, neta]) →
+    eigs[B, neta]`` with the chunk axis B split across every device
+    (per-chunk traced geometry, thth/batch.py:make_grid_eval_fn).
+
+    This is the SPMD replacement for the reference's pool.map over
+    per-chunk `single_search` calls (dynspec.py:1715-1719); used by
+    ``Dynspec.fit_thetatheta(mesh=...)``. B must be divisible by the
+    mesh device count (pad with dummy chunks; their fits are dropped).
+    """
+    jax = get_jax()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..thth.batch import make_grid_eval_fn
+
+    fn = make_grid_eval_fn(tau, fd, n_edges, iters=iters)
+    chunk_sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    return jax.jit(fn, in_shardings=(chunk_sh, chunk_sh, chunk_sh),
+                   out_shardings=chunk_sh)
+
+
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
     """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
     the η grid split over every device of the mesh (CS replicated;

@@ -78,34 +78,191 @@ def plot_dyn(ds, lamsteps=False, input_dyn=None, filename=None,
     return _finish(plt, fig, filename, display, dpi)
 
 
-def plot_acf(ds, contour=False, filename=None, input_acf=None,
-             input_t=None, input_f=None, display=True, figsize=(9, 9),
-             dpi=200):
-    """ACF (dynspec.py:547-691 core)."""
+def plot_acf(ds, method="acf1d", alpha=5 / 3, contour=False,
+             filename=None, input_acf=None, input_t=None, input_f=None,
+             nscale=4, mcmc=False, display=True, crop=False, tlim=None,
+             flim=None, figsize=(9, 9), verbose=False, dpi=200):
+    """ACF with fitted scintillation-scale axes
+    (dynspec.py:547-691): white-noise spike subtracted, optional crop
+    to ``nscale`` scales (or explicit tlim/flim), and — when plotting
+    the object's own ACF — twin axes in units of the fitted τ_d/Δν_d
+    (running ``get_scint_params(method, mcmc=...)`` first if needed)."""
     plt = _mpl()
     if input_acf is None:
         if not hasattr(ds, "acf"):
             ds.calc_acf()
-        acf = ds.acf
-        t_delays = np.linspace(-ds.tobs / 60, ds.tobs / 60,
-                               acf.shape[1] + 1)[:-1]
-        f_shifts = np.linspace(-ds.bw, ds.bw, acf.shape[0] + 1)[:-1]
+        if not hasattr(ds, "tau"):
+            try:
+                ds.get_scint_params(method=method, alpha=alpha,
+                                    mcmc=mcmc, verbose=verbose)
+            except Exception as e:
+                print(e)
+                print("Could not determine scintillation scales "
+                      "for plot")
+        arr = np.array(ds.acf)
+        tspan, fspan = ds.tobs, ds.bw
     else:
-        acf = input_acf
-        t_delays = np.linspace(-max(input_t) / 60, max(input_t) / 60,
-                               acf.shape[1] + 1)[:-1]
-        f_shifts = np.linspace(-np.ptp(input_f), np.ptp(input_f),
-                               acf.shape[0] + 1)[:-1]
+        arr = np.array(input_acf)
+        tspan = max(input_t) - min(input_t)
+        fspan = max(input_f) - min(input_f)
+    # subtract the white-noise spike (dynspec.py:626-630)
+    arr = np.fft.ifftshift(arr)
+    wn = arr[0][0] - max(arr[1][0], arr[0][1])
+    arr[0][0] = arr[0][0] - wn
+    arr = np.fft.fftshift(arr)
+
+    t_delays = np.linspace(-tspan / 60, tspan / 60, arr.shape[1])
+    f_shifts = np.linspace(-fspan, fspan, arr.shape[0])
+
+    has_scales = hasattr(ds, "tau") and hasattr(ds, "dnu")
+    if crop and tlim is None and not has_scales:
+        # the fit failed above; honour the printed warning and plot
+        # the full frame instead of crashing on ds.tau
+        crop = False
+    if crop or (tlim is not None):
+        if tlim is None:
+            tlim = nscale * ds.tau / 60
+        if flim is None:
+            flim = (nscale * ds.dnu if has_scales
+                    else np.abs(f_shifts).max())
+        tlim = min(tlim, ds.tobs / 60) if input_acf is None else tlim
+        flim = min(flim, ds.bw) if input_acf is None else flim
+        t_inds = np.flatnonzero(np.abs(t_delays) <= tlim)
+        f_inds = np.flatnonzero(np.abs(f_shifts) <= flim)
+        t_delays = t_delays[t_inds]
+        f_shifts = f_shifts[f_inds]
+        arr = arr[np.ix_(f_inds, t_inds)]
+
+    fig, ax1 = plt.subplots(figsize=figsize)
+    if contour:
+        ax1.contourf(t_delays, f_shifts, arr)
+    else:
+        ax1.pcolormesh(centres_to_edges(t_delays),
+                       centres_to_edges(f_shifts), arr, linewidth=0,
+                       rasterized=True, shading="auto")
+    if input_acf is None:
+        ax1.set_ylabel(r"Frequency shift, $\Delta\nu$ (MHz)")
+        ax1.set_xlabel(r"Time lag, $\tau$ (mins)")
+        if hasattr(ds, "tau") and hasattr(ds, "dnu"):
+            # twin axes in units of the fitted scales
+            # (dynspec.py:663-673)
+            miny, maxy = ax1.get_ylim()
+            ax2 = ax1.twinx()
+            ax2.set_ylim(miny / ds.dnu, maxy / ds.dnu)
+            ax2.set_ylabel(r"$\Delta\nu$ / ($\Delta\nu_d = "
+                           + f"{round(ds.dnu, 2)}" + r"\,$MHz)")
+            ax3 = ax1.twiny()
+            minx, maxx = ax1.get_xlim()
+            ax3.set_xlim(minx / (ds.tau / 60), maxx / (ds.tau / 60))
+            ax3.set_xlabel(r"$\tau$/($\tau_d="
+                           + f"{round(ds.tau / 60, 2)}" + r"\,$min)")
+    else:
+        ax1.set_ylabel("Frequency lag (MHz)")
+        ax1.set_xlabel("Time lag (mins)")
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def _split_filename(filename, tag):
+    """'x.png' → 'x_<tag>.png' (reference suffix convention,
+    dynspec.py:2417-2419)."""
+    name = "".join(filename.split(".")[:-1])
+    ext = filename.split(".")[-1]
+    return f"{name}_{tag}.{ext}"
+
+
+def plot_acf_tilt(ds, peaks, peakerrs, ys, yfit, nscaleplot=2,
+                  tmaxplot=None, fmaxplot=None, filename=None,
+                  display=True, figsize=(9, 9), dpi=200):
+    """Two tilt diagnostics (dynspec.py:2415-2462): the per-row peak
+    measurements with the weighted line fit, and the ACF with the
+    fitted tilt overlaid."""
+    plt = _mpl()
+    figs = []
+
+    fig = plt.figure(figsize=figsize)
+    plt.errorbar(peaks, ys, xerr=np.asarray(peakerrs).squeeze(),
+                 marker=".")
+    plt.plot(peaks, yfit)
+    plt.ylabel("Frequency lag (MHz)")
+    plt.xlabel("Time lag (mins)")
+    plt.title("Peak measurements, and weighted fit")
+    figs.append(_finish(plt, fig,
+                        filename and _split_filename(filename,
+                                                     "tilt_fit"),
+                        display, dpi))
+
+    acf = np.array(ds.acf)
+    t_delays = np.linspace(-ds.tobs / 60, ds.tobs / 60, acf.shape[1])
+    f_shifts = np.linspace(-ds.bw, ds.bw, acf.shape[0])
     fig = plt.figure(figsize=figsize)
     plt.pcolormesh(centres_to_edges(t_delays),
                    centres_to_edges(f_shifts), acf, linewidth=0,
                    rasterized=True, shading="auto")
-    if contour:
-        plt.contour(t_delays, f_shifts, acf,
-                    levels=np.linspace(0.2, 0.8, 4), colors="k")
-    plt.xlabel("Time lag (mins)")
+    plt.plot(peaks, ys, "r", alpha=0.5)
+    plt.plot(peaks, yfit, "k", alpha=0.5)
+    yl = plt.ylim()
+    if yl[1] > nscaleplot * ds.dnu:
+        plt.ylim(-nscaleplot * ds.dnu, nscaleplot * ds.dnu)
+    if fmaxplot is not None and yl[1] > fmaxplot:
+        plt.ylim(-fmaxplot, fmaxplot)
+    xl = plt.xlim()
+    if xl[1] > nscaleplot * ds.tau / 60:
+        plt.xlim(-nscaleplot * ds.tau / 60, nscaleplot * ds.tau / 60)
+    if tmaxplot is not None and xl[1] > tmaxplot:
+        plt.xlim(-tmaxplot, tmaxplot)
     plt.ylabel("Frequency lag (MHz)")
-    return _finish(plt, fig, filename, display, dpi)
+    plt.xlabel("Time lag (mins)")
+    err = np.sqrt(ds.acf_tilt_err ** 2 + ds.fse_tilt ** 2)
+    plt.title(f"Tilt = {round(ds.acf_tilt, 3)} $\\pm$ "
+              f"{round(err, 3)} (min/MHz)")
+    figs.append(_finish(plt, fig,
+                        filename and _split_filename(filename,
+                                                     "tilt_acf"),
+                        display, dpi))
+    return figs
+
+
+def plot_cut_tiles(ds, lamsteps=False, maxfdop=np.inf, filename=None,
+                   display=True, figsize=(8, 13), dpi=200):
+    """Tiled dynspec / ACF / sspec figures for ``cut_dyn``
+    (dynspec.py:3211-3268): one subplot per tile, three figures saved
+    with the reference's ``_dynspec``/``_acf``/``_sspec`` suffixes."""
+    plt = _mpl()
+    nfc, ntc = ds.cutdyn.shape[:2]
+    figs = []
+    for tag, plot_tile in (
+            ("dynspec", lambda ii, jj: plt.pcolormesh(
+                centres_to_edges(ds.cut_times[jj] / 60),
+                centres_to_edges(ds.cut_freqs[ii]),
+                ds.cutdyn[ii, jj], linewidth=0, rasterized=True,
+                shading="auto")),
+            ("acf", lambda ii, jj: plt.pcolormesh(
+                ds.cutacf[ii, jj], linewidth=0, rasterized=True,
+                shading="auto")),
+            ("sspec", lambda ii, jj: _tile_sspec(
+                plt, ds.cutsspec[ii, jj], ds.cut_sspec_x,
+                ds.cut_sspec_y, maxfdop))):
+        fig = plt.figure(figsize=figsize)
+        plotnum = 1
+        for ii in range(nfc):
+            for jj in range(ntc):
+                plt.subplot(nfc, ntc, plotnum)
+                plot_tile(ii, jj)
+                plotnum += 1
+        figs.append(_finish(plt, fig,
+                            filename and _split_filename(filename, tag),
+                            display, dpi))
+    return figs
+
+
+def _tile_sspec(plt, sspec, x, y, maxfdop):
+    valid = sspec[is_valid(sspec) & (np.abs(sspec) > 0)]
+    vmin = np.median(valid) - 3 if valid.size else None
+    vmax = np.max(valid) - 3 if valid.size else None
+    sel = np.abs(x) <= maxfdop
+    plt.pcolormesh(centres_to_edges(x[sel]), centres_to_edges(y),
+                   sspec[:, sel], vmin=vmin, vmax=vmax, linewidth=0,
+                   rasterized=True, shading="auto")
 
 
 def plot_sspec(ds, lamsteps=False, input_sspec=None, filename=None,

@@ -26,9 +26,15 @@ from ..ops.windows import get_window
 
 
 def _efield_acf(snx, sny, sqrtar, alph2, xp):
-    """ACF of the electric field (scint_sim.py:573-574)."""
-    return xp.exp(-0.5 * ((snx / sqrtar) ** 2
-                          + (sny * sqrtar) ** 2) ** alph2)
+    """ACF of the electric field (scint_sim.py:573-574).
+
+    The double-``where`` guards the α/2 < 1 power at base 0: the value
+    there is exp(0)=1 but d(x^a)/dx → ∞, which poisons autodiff
+    through the acf2d fit (fit/acf2d.py) with NaNs. Value-identical on
+    both backends."""
+    base = (snx / sqrtar) ** 2 + (sny * sqrtar) ** 2
+    safe = xp.where(base == 0, 1.0, base)
+    return xp.where(base == 0, 1.0, xp.exp(-0.5 * safe ** alph2))
 
 
 def _fresnel_row(gammes, snp, snx, sny, dnun, dsp_eff, xp):
@@ -238,3 +244,103 @@ def theoretical_acf(**kwargs):
     """Functional entry used by the 2-D fit model
     (fit/models.py:scint_acf_model_2d)."""
     return ACF(**kwargs)
+
+
+def make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha, theta,
+                        tau0, grid_oversample=1.25):
+    """Build a fully-jitted theoretical-ACF model
+    ``model(tau, dnu, amp, phasegrad, psi, wn) -> (nf_crop, nt_crop)``
+    with STATIC shapes — the TPU-resident core of the ``acf2d`` fit
+    (reference rebuilds the whole ``ACF`` object host-side per residual
+    evaluation, scint_sim.py:417-765 via scint_models.py:164-215).
+
+    Static-shape reformulation (ar/alpha/theta are fixed parameters of
+    the acf2d fit, dynspec.py:2860-2864, so they may bake into the
+    program):
+
+    - the integration grid spans ±6·ar like the reference's
+      auto-sampling (sp_fac·spmax = 6·ar, scint_sim.py:510-513) but
+      with a FIXED point count sized from the initial ``tau0`` (times
+      ``grid_oversample`` margin); as τ drifts during the fit the
+      quadrature step tracks the actual grid (static), a discretisation
+      equally valid as the reference's τ-dependent ``arange`` step;
+    - the general two-quadrant branch (reference phasegrad≠0 path,
+      scint_sim.py:577-607) is used for ALL phasegrad values — at
+      phasegrad=0 it reproduces the mirrored quadrant result exactly,
+      and it keeps ``phasegrad`` traceable;
+    - the white-noise spike lands at the static centre bin (nt odd).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    if nt_crop % 2 == 0 or nf_crop % 2 == 0:
+        raise ValueError("acf2d crop must be odd-sized (reference "
+                         "centres the ACF, dynspec.py:2729-2745)")
+    sqrtar = float(np.sqrt(ar))
+    res_fac = 1 + ar / 3                    # auto-sampling factors
+    core_fac = 4 * res_fac                  # (scint_sim.py:510-513)
+    taumax0 = nt_crop * dt / abs(tau0)
+    dsp0 = 4 * taumax0 / (nt_crop - 1)
+
+    # grids are static (size from tau0, range ±6·ar); alpha enters
+    # only through the exponent of exp(−0.5·BASE^(α/2)), so a varying
+    # alpha (get_scint_params(alpha=None), dynspec.py:745-746) stays
+    # traceable with the same static BASE arrays
+    def _grid(fac):
+        n = int(np.ceil(2 * 6 * ar / (dsp0 / fac) * grid_oversample))
+        snp = np.linspace(-6 * ar, 6 * ar, max(n, 9))
+        SX, SY = np.meshgrid(snp, snp)
+        base = (SX / sqrtar) ** 2 + (SY * sqrtar) ** 2
+        return (jnp.asarray(snp), jnp.asarray(base),
+                float(snp[1] - snp[0]))
+
+    snp_j, base_j, step = _grid(res_fac)
+    snp2_j, base2_j, step2 = _grid(core_fac)
+    ndnun = (nf_crop + 1) // 2
+    spike_index = nt_crop // 2              # tn centre (nt odd)
+    deg = np.pi / 180.0
+
+    def _gammes(base, alph2):
+        safe = jnp.where(base == 0, 1.0, base)   # pow-grad guard
+        return jnp.where(base == 0, 1.0,
+                         jnp.exp(-0.5 * safe ** alph2))
+
+    def model(tau, dnu, amp, phasegrad, psi, wn, alpha=alpha):
+        tau = jnp.abs(tau)
+        dnu = jnp.abs(dnu)
+        alph2 = alpha / 2
+        gammes_j = _gammes(base_j, alph2)
+        gammes2_j = _gammes(base2_j, alph2)
+        taumax = nt_crop * dt / tau
+        dnumax = nf_crop * df / dnu
+        xi = (90.0 - psi) * deg
+        sigxn = phasegrad * jnp.cos(xi - theta * deg)
+        sigyn = phasegrad * jnp.sin(xi - theta * deg)
+        tn = jnp.linspace(-taumax, taumax, nt_crop)
+        snx = jnp.cos(xi) * tn
+        sny = jnp.sin(xi) * tn
+        dnun = jnp.linspace(0.0, dnumax, ndnun)
+
+        col0 = _efield_acf(snx, sny, sqrtar, alph2, jnp)
+        col0 = col0.at[spike_index].add(wn / amp)
+
+        first = _fresnel_row(gammes2_j, snp2_j,
+                             snx - 2 * sigxn * dnun[1],
+                             sny - 2 * sigyn * dnun[1],
+                             dnun[1], step2, jnp)
+
+        def one(d):
+            return _fresnel_row(gammes_j, snp_j, snx - 2 * sigxn * d,
+                                sny - 2 * sigyn * d, d, step, jnp)
+
+        rest = jax.vmap(one, out_axes=1)(dnun[2:])   # (nt, ndnun-2)
+        g = jnp.concatenate([col0[:, None].astype(rest.dtype),
+                             first[:, None], rest], axis=1)
+        g = jnp.real(g * jnp.conj(g))                # |Γ_E|² → Γ_I
+        # mirror in frequency only (two-quadrant branch,
+        # scint_sim.py:601-607), then transpose to (nf, nt)
+        gam3 = jnp.concatenate(
+            [jnp.flip(g[:, 1:], axis=(0, 1)), g], axis=1).T
+        return amp * gam3
+
+    return model

@@ -7,9 +7,10 @@ from .core import (thth_map, thth_redmap, rev_map, modeler, eval_calc,
                    two_curve_map, singularvalue_calc, min_edges,
                    arc_edges, len_arc, ext_find, fft_axis, cs_to_ri,
                    unit_checks)
-from .batch import make_multi_eval_fn
+from .batch import make_multi_eval_fn, make_thin_eval_fn
 from .search import (single_search, single_search_thin,
-                     multi_chunk_search, fit_eig_peak, chi_par)
+                     multi_chunk_search, multi_chunk_search_thin,
+                     fit_eig_peak, chi_par)
 from .retrieval import (single_chunk_retrieval, vlbi_chunk_retrieval,
                         mosaic, refine_mosaic, gerchberg_saxton,
                         calc_asymmetry, mask_func, err_string)
@@ -21,7 +22,8 @@ __all__ = [
     "chisq_calc", "two_curve_map", "singularvalue_calc", "min_edges",
     "arc_edges", "len_arc", "ext_find", "fft_axis", "cs_to_ri",
     "unit_checks", "single_search", "single_search_thin",
-    "multi_chunk_search", "fit_eig_peak", "chi_par",
+    "multi_chunk_search", "multi_chunk_search_thin",
+    "make_thin_eval_fn", "fit_eig_peak", "chi_par",
     "single_chunk_retrieval", "vlbi_chunk_retrieval", "mosaic",
     "refine_mosaic", "gerchberg_saxton", "calc_asymmetry", "mask_func",
     "err_string", "plot_func",

@@ -156,3 +156,161 @@ def make_multi_eval_fn(tau, fd, edges, iters=200, method="auto",
         return jnp.abs(lam)
 
     return fn
+
+
+def make_grid_eval_fn(tau, fd, n_edges, iters=200):
+    """Whole-chunk-grid η search with per-chunk TRACED geometry:
+    ``fn(CS_ri[B, 2, ntau, nfd], edges[B, n_edges], etas[B, neta])
+    → eigs[B, neta]``.
+
+    ``fit_thetatheta`` rescales edges and η per frequency row
+    (dynspec.py:1693-1698), so rows have different geometry; the
+    per-row path (make_multi_eval_fn) bakes edges into the program
+    and compiles once per row. Here edges/etas are traced arguments,
+    so the ENTIRE (ncf × nct) chunk grid is one program whose chunk
+    axis shards over a device mesh (SPMD replacement for the
+    reference's pool.map over chunks, dynspec.py:1715-1719) — see
+    parallel/survey.py:make_thth_grid_search_sharded.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    dtau = np.diff(tau_a).mean()
+    dfd = np.diff(fd_a).mean()
+    n_th = n_edges - 1
+    tril_mask = np.tril(np.ones((n_th, n_th))) > 0
+    anti_eye = np.eye(n_th)[::-1] > 0
+
+    from .core import dominant_eig_power
+
+    def one(CS_ri, edges, etas):
+        CS_c = CS_ri[0] + 1j * CS_ri[1]              # (ntau, nfd)
+        cents = (edges[1:] + edges[:-1]) / 2
+        # re-centre on the bin nearest zero (thth_map semantics,
+        # core.py:th_cents_from_edges)
+        cents = cents - cents[jnp.argmin(jnp.abs(cents))]
+        th1 = cents[None, :] * jnp.ones((n_th, 1))
+        th2 = th1.T
+        e = etas[:, None, None]
+        tau_inv = jnp.floor((e * (th1 ** 2 - th2 ** 2) - tau_a[0]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = jnp.floor(((th1 - th2) - fd_a[0] + dfd / 2)
+                           / dfd).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < len(tau_a))
+                & (fd_inv < len(fd_a))[None]
+                & (fd_inv >= -len(fd_a))[None])
+        vals = CS_c[jnp.where(pnts, tau_inv, 0),
+                    jnp.broadcast_to((fd_inv % len(fd_a))[None],
+                                     pnts.shape)]
+        thth = jnp.where(pnts, vals, 0.0)
+        w = (jnp.sqrt(jnp.abs(etas))[:, None, None]
+             * jnp.sqrt(jnp.abs(2 * (th2 - th1)))[None])
+        thth = thth * w
+        thth = jnp.where(jnp.asarray(tril_mask)[None], 0.0, thth)
+        thth = thth + jnp.conj(jnp.transpose(thth, (0, 2, 1)))
+        thth = jnp.where(jnp.asarray(anti_eye)[None], 0.0, thth)
+        thth = jnp.nan_to_num(thth)
+        # abs-of-max (not max-of-abs): on even-length fftshifted axes
+        # |min| = max + step, and the redmap bound everywhere else
+        # (core.py redmap_mask, make_multi_eval_fn, ref ththmod) is
+        # abs(max)
+        valid = ((cents[None, :] ** 2 * etas[:, None]
+                  < np.abs(tau_a.max()))
+                 & (jnp.abs(cents) < np.abs(fd_a.max()) / 2)[None])
+        thth = thth * valid[:, None, :] * valid[:, :, None]
+
+        def lam(A):
+            v, _ = dominant_eig_power(A, iters=iters, backend="jax")
+            return jnp.abs(v)
+
+        return jax.vmap(lam)(thth)                   # (neta,)
+
+    return jax.vmap(one)
+
+
+def make_thin_eval_fn(tau, fd, edges, edges_arclet, center_cut,
+                      iters=200):
+    """Build ``fn(CS_ri_batch, etas) -> sigmas`` for the two-curvature
+    (thin-screen) search: largest singular value of the two-curve θ-θ
+    per η, batched over a chunk batch and the whole η grid in one
+    program.
+
+    Replaces the host loop of ``single_search_thin`` (reference
+    ththmod.py:516-712, per-η ``two_curve_map`` + numpy SVD at
+    :496-513). Both curvatures are η (the thin-screen search couples
+    main arc and arclets at the same curvature, ththmod.py:560-564).
+
+    TPU formulation: the reference crops the θ-θ to the valid θ range
+    per η (data-dependent shape); here invalid rows/columns are zeroed
+    instead — zero rows/columns leave singular values unchanged, so
+    the fixed-shape batch vmaps. The largest singular value is taken
+    as √λ_max(AᴴA) by power iteration on the (n1×n1) hermitian Gram
+    matrix — one extra GEMM per η instead of a full SVD, which keeps
+    the whole search on the MXU.
+
+    CS_ri_batch: (B, 2, ntau, nfd) float; returns (B, neta).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    tau_a, fd_a, c1 = _geometry(tau, fd, edges)
+    c2 = th_cents_from_edges(
+        np.asarray(unit_checks(edges_arclet, "edges_arclet"),
+                   dtype=float))
+    center_cut = float(unit_checks(center_cut, "center_cut"))
+    n1, n2 = len(c1), len(c2)
+    th1 = np.ones((n2, n1)) * c1[None, :]
+    th2 = np.ones((n2, n1)) * c2[:, None]
+    dtau = np.diff(tau_a).mean()
+    dfd = np.diff(fd_a).mean()
+    # fd_inv is η-independent (two_curve_map, core.py:432)
+    fd_inv = np.floor((th1 - th2 - fd_a[1] + dfd / 2)
+                      / dfd).astype(int)
+    fd_ok = (fd_inv < len(fd_a) - 1) & (fd_inv >= -len(fd_a))
+    cut_mask = np.abs(c1) >= center_cut         # ththmod.py:509-510
+
+    def build(CS_c, etas):
+        """CS_c (ntau, nfd, B) complex, etas (neta,) →
+        two-curve θ-θ batch (neta, n2, n1, B)."""
+        e = etas[:, None, None]
+        tau_inv = jnp.floor((e * (th1 ** 2 - th2 ** 2) - tau_a[1]
+                             + dtau / 2) / dtau).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < len(tau_a) - 1)
+                & jnp.asarray(fd_ok)[None])
+        vals = CS_c[jnp.where(pnts, tau_inv, 0),
+                    jnp.broadcast_to((fd_inv % len(fd_a))[None],
+                                     pnts.shape), :]
+        thth = jnp.where(pnts[..., None], vals, 0.0)
+        w = (jnp.sqrt(2.0 * jnp.abs(etas))[:, None, None]
+             * np.sqrt(np.abs(th1 - th2))[None])
+        thth = jnp.nan_to_num(thth * w[..., None])
+        # per-η valid-θ masks replace the reference's crop
+        lim = jnp.sqrt(jnp.abs(tau_a.max()) / etas)     # (neta,)
+        ok1 = ((jnp.abs(jnp.asarray(c1))[None, :] < lim[:, None])
+               & jnp.asarray(cut_mask)[None, :])
+        ok2 = jnp.abs(jnp.asarray(c2))[None, :] < lim[:, None]
+        return (thth * ok2[:, :, None, None] * ok1[:, None, :, None])
+
+    def fn(CS_ri, etas):
+        CS_c = jnp.transpose(CS_ri[:, 0] + 1j * CS_ri[:, 1], (1, 2, 0))
+        thth = build(CS_c, etas)                # (neta, n2, n1, B)
+        a = jnp.transpose(thth, (0, 3, 1, 2))   # (neta, B, n2, n1)
+        # scale-normalise before the Gram product so f32 squaring
+        # cannot overflow; σ scales linearly back
+        scale = jnp.maximum(jnp.max(jnp.abs(a), axis=(2, 3),
+                                    keepdims=True), 1e-30)
+        an = a / scale
+        gram = jnp.einsum("ebij,ebik->ebjk", jnp.conj(an), an)
+
+        from .core import dominant_eig_power
+
+        def one(G):
+            lam, _ = dominant_eig_power(G, iters=iters, backend="jax")
+            return jnp.sqrt(jnp.abs(lam))
+
+        sig = jax.vmap(jax.vmap(one))(gram)     # (neta, B)
+        return jnp.transpose(sig * scale[:, :, 0, 0])
+
+    return fn

@@ -376,19 +376,34 @@ def rot_init(chunks):
 
 def _jax_stack(chunks_j, masks_j, phases, amps, jnp):
     """Differentiable overlap-add: scatter each phased chunk into the
-    mosaic canvas (jax path shared by both refinement objectives)."""
+    mosaic canvas (jax path shared by both refinement objectives).
+
+    A ``lax.scan`` over the stacked chunk array keeps compile time
+    O(1) in chunk count (survey-scale mosaics reach 10×20+ chunks —
+    an unrolled python double loop would trace one scatter per chunk,
+    reference grids at dynspec.py:1414-1433)."""
+    jax = get_jax()
+
     ncf, nct, cwf, cwt = chunks_j.shape
     shape = mosaic_shape(ncf, nct, cwf, cwt)
-    E = jnp.zeros(shape, dtype=chunks_j.dtype)
-    k = 0
-    for cf in range(ncf):
-        for ct in range(nct):
-            phi = phases[k - 1] if k > 0 else 0.0  # first chunk fixed
-            contrib = (amps[k] * chunks_j[cf, ct] * masks_j[cf, ct]
-                       * jnp.exp(1j * phi))
-            E = E.at[cf * cwf // 2: cf * cwf // 2 + cwf,
-                     ct * cwt // 2: ct * cwt // 2 + cwt].add(contrib)
-            k += 1
+    nchunk = ncf * nct
+    flat = chunks_j.reshape(nchunk, cwf, cwt)
+    mflat = masks_j.reshape(nchunk, cwf, cwt)
+    phi = jnp.concatenate([jnp.zeros(1, phases.dtype),
+                           phases])            # first chunk fixed at 0
+
+    def body(E, xs):
+        k, chunk, mask, ph, am = xs
+        contrib = am * chunk * mask * jnp.exp(1j * ph)
+        r0 = (k // nct) * (cwf // 2)
+        c0 = (k % nct) * (cwt // 2)
+        cur = jax.lax.dynamic_slice(E, (r0, c0), (cwf, cwt))
+        return jax.lax.dynamic_update_slice(E, cur + contrib,
+                                            (r0, c0)), None
+
+    E0 = jnp.zeros(shape, dtype=chunks_j.dtype)
+    E, _ = jax.lax.scan(body, E0, (jnp.arange(nchunk), flat, mflat,
+                                   phi, amps))
     return E
 
 

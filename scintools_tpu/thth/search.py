@@ -216,27 +216,100 @@ def multi_chunk_search(dspecs, freq, times, etas, edges, fw=0.1, npad=3,
     return out
 
 
+def _jitted_thin_eval(tau, fd, edges, edges_arclet, center_cut):
+    from .batch import make_thin_eval_fn
+    from .core import keyed_jit_cache
+
+    key = (tau.tobytes(), fd.tobytes(), edges.tobytes(),
+           edges_arclet.tobytes(), float(center_cut))
+    return keyed_jit_cache(
+        _MULTI_JIT_CACHE, key,
+        lambda: make_thin_eval_fn(tau, fd, edges, edges_arclet,
+                                  center_cut),
+        maxsize=16)
+
+
 def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
                        centerCut, fw=0.1, npad=3, coher=True,
                        tau_mask=0.0, verbose=False, backend=None):
     """Two-curvature (thin-screen) search: largest singular value of
-    the two-curve θ-θ per η (ththmod.py:516-712)."""
+    the two-curve θ-θ per η (ththmod.py:516-712).
+
+    On backend='jax' the whole η grid runs as one batched device
+    program (masked fixed-shape two-curve gather + Gram-matrix power
+    iteration, thth/batch.py:make_thin_eval_fn); the numpy path keeps
+    the reference's per-η SVD loop.
+    """
+    return multi_chunk_search_thin(
+        [dspec], freq, [time], etas, edges, edgesArclet, centerCut,
+        fw=fw, npad=npad, coher=coher, tau_mask=tau_mask,
+        backend=backend)[0]
+
+
+def multi_chunk_search_thin(dspecs, freq, times, etas, edges,
+                            edgesArclet, centerCut, fw=0.1, npad=3,
+                            coher=True, tau_mask=0.0, backend=None):
+    """Thin-screen search on a batch of same-geometry chunks in one
+    device program (the thin counterpart of
+    :func:`multi_chunk_search`; reference pool fan-out
+    dynspec.py:1715-1719 over ththmod.py:516)."""
+    backend = resolve_backend(backend)
     etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
-    CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad,
-                                           tau_mask=tau_mask)
-    base = CS if coher else np.abs(CS) ** 2
-    eigs = np.empty(len(etas))
-    for i, eta in enumerate(etas):
-        try:
-            eigs[i] = singularvalue_calc(base, tau, fd, eta, edges, eta,
-                                         edgesArclet, centerCut)
-        except Exception:
-            eigs[i] = np.nan
-    eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
-        etas, eigs, fw=fw, full=True)
-    freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
-    time = np.asarray(unit_checks(time, "time"), dtype=float)
-    return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
-                             freq_mean=float(freq.mean()),
-                             time_mean=float(time.mean()),
-                             eigs=eigs_c, etas=etas_c, popt=popt)
+
+    if backend == "numpy":
+        out = []
+        for dspec, time in zip(dspecs, times):
+            CS, tau, fd = chunk_conjugate_spectrum(
+                dspec, time, freq, npad=npad, tau_mask=tau_mask)
+            base = CS if coher else np.abs(CS) ** 2
+            eigs = np.empty(len(etas))
+            for i, eta in enumerate(etas):
+                try:
+                    eigs[i] = singularvalue_calc(
+                        base, tau, fd, eta, edges, eta, edgesArclet,
+                        centerCut)
+                except Exception:
+                    eigs[i] = np.nan
+            eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
+                etas, eigs, fw=fw, full=True)
+            freq_a = np.asarray(unit_checks(freq, "freq"), dtype=float)
+            time_a = np.asarray(unit_checks(time, "time"), dtype=float)
+            out.append(ChunkSearchResult(
+                eta=eta_fit, eta_sig=eta_sig,
+                freq_mean=float(freq_a.mean()),
+                time_mean=float(time_a.mean()),
+                eigs=eigs_c, etas=etas_c, popt=popt))
+        return out
+
+    import jax.numpy as jnp
+
+    from .core import cs_to_ri
+
+    cs_ri = []
+    tau = fd = None
+    for d, t in zip(dspecs, times):
+        CS, tau, fd = chunk_conjugate_spectrum(d, t, freq, npad=npad,
+                                               tau_mask=tau_mask)
+        base = CS if coher else np.abs(CS) ** 2
+        cs_ri.append(cs_to_ri(base).astype(np.float32))
+    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    arclet_a = np.asarray(unit_checks(edgesArclet, "edges_arclet"),
+                          dtype=float)
+    fn = _jitted_thin_eval(tau, fd, edges_a, arclet_a,
+                           float(unit_checks(centerCut, "center_cut")))
+    sigs = np.asarray(fn(jnp.asarray(np.stack(cs_ri)),
+                         jnp.asarray(etas)))
+
+    freq_m = float(np.asarray(unit_checks(freq, "freq"),
+                              dtype=float).mean())
+    out = []
+    for b, t in enumerate(times):
+        eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
+            etas, sigs[b], fw=fw, full=True)
+        t_a = np.asarray(unit_checks(t, "time"), dtype=float)
+        out.append(ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
+                                     freq_mean=freq_m,
+                                     time_mean=float(t_a.mean()),
+                                     eigs=eigs_c, etas=etas_c,
+                                     popt=popt))
+    return out

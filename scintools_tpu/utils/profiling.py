@@ -1,0 +1,136 @@
+"""Profiling/tracing harness (SURVEY.md §5 'tracing/profiling' plan).
+
+The reference's only instrumentation is ad-hoc wall-clock prints
+around file loading (/root/reference/scintools/dynspec.py:170-172,
+227-229). Here profiling is a small first-class utility:
+
+- :class:`Timer` — ``block_until_ready``-aware wall-clock sections
+  that accumulate into a table (jax async dispatch makes naive
+  ``time.time()`` spans meaningless; every section exit synchronises
+  the device queue before reading the clock).
+- :func:`trace` — context manager around ``jax.profiler.trace`` for
+  XLA/TensorBoard traces (the hook previously private to bench.py's
+  ``SCINTOOLS_BENCH_TRACE``).
+- :func:`timeit_fn` — best-of-N timing of a jitted callable with a
+  separate (reported) compile/warmup time.
+
+Used by examples/ and bench.py; no dependency outside jax/numpy.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import numpy as np
+
+
+def _block(x):
+    """block_until_ready on any pytree-ish value; numpy passes through."""
+    try:
+        import jax
+
+        return jax.block_until_ready(x)
+    except Exception:
+        return x
+
+
+def _device_fence():
+    """Drain the default device's dispatch queue: devices execute
+    in-order, so blocking on a freshly enqueued trivial op implies
+    every previously dispatched op has completed. No-op without jax."""
+    try:
+        import jax
+
+        jax.block_until_ready(jax.device_put(0.0))
+    except Exception:
+        pass
+
+
+class Timer:
+    """Accumulating section timer.
+
+    >>> tm = Timer()
+    >>> with tm("sspec"):
+    ...     out = jitted_sspec(dyn)      # implicit device sync on exit
+    >>> with tm("search"):
+    ...     eigs = search(cs)
+    >>> print(tm.report())
+
+    jax dispatch is asynchronous, so on entry AND exit the timer
+    fences the default device queue (in-order execution makes a
+    block on a trailing trivial op a full fence); a section may also
+    append its result to the yielded box for an explicit
+    block_until_ready on that value.
+    """
+
+    def __init__(self, sync=True):
+        self.sync = sync
+        self.sections = {}          # name → list of seconds
+
+    @contextmanager
+    def __call__(self, name):
+        if self.sync:
+            _device_fence()
+        t0 = time.perf_counter()
+        box = []
+        try:
+            yield box
+        finally:
+            if self.sync:
+                _block(box[-1]) if box else _device_fence()
+            self.sections.setdefault(name, []).append(
+                time.perf_counter() - t0)
+
+    def add(self, name, seconds):
+        self.sections.setdefault(name, []).append(float(seconds))
+
+    def total(self, name):
+        return float(np.sum(self.sections.get(name, [])))
+
+    def report(self):
+        """Fixed-width table: name, calls, total, mean, best."""
+        rows = [f"{'section':<24}{'calls':>6}{'total_s':>10}"
+                f"{'mean_s':>10}{'best_s':>10}"]
+        for name, vals in self.sections.items():
+            v = np.asarray(vals)
+            rows.append(f"{name:<24}{len(v):>6}{v.sum():>10.4f}"
+                        f"{v.mean():>10.4f}{v.min():>10.4f}")
+        return "\n".join(rows)
+
+
+@contextmanager
+def trace(trace_dir, host_tracer_level=2):
+    """jax.profiler trace context (view with TensorBoard / xprof).
+    No-op (with a warning) when the profiler is unavailable; the
+    traced body's own exceptions propagate untouched."""
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(str(trace_dir))
+        ctx.__enter__()
+    except Exception as e:  # profiler missing on exotic backends
+        print(f"Warning: jax profiler trace unavailable ({e}); "
+              f"running untraced")
+        yield
+        return
+    try:
+        yield
+    finally:
+        ctx.__exit__(None, None, None)
+
+
+def timeit_fn(fn, *args, repeats=3, **kwargs):
+    """Time a (possibly jitted) callable: returns a dict with the
+    first-call (compile+run) time and best-of-``repeats`` steady-state
+    wall time, synchronising the device after every call."""
+    t0 = time.perf_counter()
+    out = _block(fn(*args, **kwargs))
+    compile_s = time.perf_counter() - t0
+    best = np.inf
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = _block(fn(*args, **kwargs))
+        best = min(best, time.perf_counter() - t0)
+    return {"first_call_s": compile_s, "best_s": float(best),
+            "repeats": repeats, "result": out}

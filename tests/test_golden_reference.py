@@ -1,0 +1,100 @@
+"""Cross-check the numpy backend against goldens generated from the
+ACTUAL reference package (tools/make_golden.py ran the unmodified
+reference code offline; fixture committed at
+tests/data/golden_reference.npz).
+
+Covered: Simulation seed-exact dynspec (scint_sim.py:23-414), J0437
+psrflux load + calc_sspec + calc_acf (dynspec.py:144-230, :3584-3814),
+and the θ-θ eigenvalue η-curve (ththmod.py:371-401)."""
+
+import os
+
+import numpy as np
+import pytest
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                      "golden_reference.npz")
+J0437 = ("/root/reference/scintools/examples/data/J0437-4715/"
+         "p111220_074112.rf.pcm.dynspec")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(GOLDEN),
+                                reason="golden fixture not present")
+
+
+@pytest.fixture(scope="module")
+def gold():
+    return np.load(GOLDEN)
+
+
+class TestSimulationGolden:
+    def test_seed_exact_dynspec(self, gold):
+        from scintools_tpu.sim.simulation import Simulation
+
+        sim = Simulation(mb2=2, rf=1, ds=0.01, alpha=5 / 3, ar=1,
+                         psi=0, inner=0.001, ns=128, nf=64, dlam=0.25,
+                         seed=42, backend="numpy")
+        ref = np.asarray(gold["sim_dyn"], dtype=float)
+        ours = np.asarray(sim.spi, dtype=float)
+        assert ours.shape == ref.shape
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(ours / scale, ref / scale,
+                                   atol=2e-6)
+
+
+@pytest.mark.skipif(not os.path.exists(J0437),
+                    reason="J0437 sample data not mounted")
+class TestJ0437Golden:
+    @pytest.fixture(scope="class")
+    def dyn(self):
+        from scintools_tpu.dynspec import Dynspec
+
+        return Dynspec(filename=J0437, process=False, verbose=False,
+                       backend="numpy")
+
+    def test_load_matches(self, gold, dyn):
+        np.testing.assert_allclose(dyn.dyn, gold["j0437_dyn"],
+                                   rtol=2e-6)
+        np.testing.assert_allclose(dyn.freqs, gold["j0437_freqs"])
+        np.testing.assert_allclose(dyn.times, gold["j0437_times"])
+        assert dyn.dt == pytest.approx(float(gold["j0437_dt"]))
+        assert dyn.df == pytest.approx(float(gold["j0437_df"]))
+
+    def test_sspec_matches(self, gold, dyn):
+        dyn.calc_sspec(prewhite=False, lamsteps=False,
+                       window="hanning", window_frac=0.1)
+        np.testing.assert_allclose(dyn.fdop, gold["j0437_fdop"])
+        np.testing.assert_allclose(dyn.tdel, gold["j0437_tdel"])
+        ref = np.asarray(gold["j0437_sspec"], dtype=float)
+        ours = np.asarray(dyn.sspec, dtype=float)
+        # dB scale; ignore −inf zero-power bins
+        m = np.isfinite(ref) & np.isfinite(ours)
+        assert m.mean() > 0.99
+        diff = np.abs(ours[m] - ref[m])
+        # float32 fixture storage: allow isolated rounding outliers
+        # near power cancellations (≤1 in 10⁴ pixels)
+        assert np.mean(diff > 2e-3) < 1e-4
+        assert np.median(diff) < 1e-5
+
+    def test_acf_matches(self, gold, dyn):
+        dyn.calc_acf()
+        np.testing.assert_allclose(np.asarray(dyn.acf),
+                                   gold["j0437_acf"], atol=2e-5)
+
+
+class TestThetaThetaGolden:
+    def test_eval_curve_matches(self, gold):
+        from scintools_tpu.thth.core import eval_calc_batch
+
+        dyn = np.asarray(gold["sim_dyn"], dtype=float)[:64, :64]
+        dyn = dyn - dyn.mean()
+        npad = int(gold["thth_npad"])
+        pad = np.pad(dyn, ((0, npad * 64), (0, npad * 64)),
+                     constant_values=dyn.mean())
+        CS = np.fft.fftshift(np.fft.fft2(pad))
+        eigs = eval_calc_batch(CS, gold["thth_tau"], gold["thth_fd"],
+                               gold["thth_etas"], gold["thth_edges"],
+                               backend="numpy")
+        ref = np.asarray(gold["thth_eigs"], dtype=float)
+        scale = ref.max()
+        np.testing.assert_allclose(eigs / scale, ref / scale,
+                                   rtol=2e-4)

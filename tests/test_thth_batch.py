@@ -117,3 +117,75 @@ class TestMultiEval:
         e_p = np.asarray(fn_p(batch, jnp.asarray(etas)))
         e_r = np.asarray(fn_ref(batch, jnp.asarray(etas)))
         np.testing.assert_allclose(e_p, e_r, rtol=2e-3)
+
+
+class TestThinEval:
+    """Batched two-curvature (thin-screen) search vs the reference-
+    semantics numpy SVD loop (ththmod.py:496-513, :516-712)."""
+
+    def _thin_workload(self, nchunk=2, nf=32, nt=32, neta=10, seed=3):
+        CS_list, tau, fd, etas, edges = _workload(nchunk=nchunk, nf=nf,
+                                                  nt=nt, neta=neta,
+                                                  seed=seed)
+        arclet = edges[np.abs(edges) < 0.7 * edges.max()]
+        center_cut = 0.1 * edges.max()
+        return CS_list, tau, fd, etas, edges, arclet, center_cut
+
+    def test_jax_matches_numpy_svd(self):
+        import jax.numpy as jnp
+
+        from scintools_tpu.thth.batch import make_thin_eval_fn
+        from scintools_tpu.thth.core import singularvalue_calc
+
+        (CS_list, tau, fd, etas, edges, arclet,
+         center_cut) = self._thin_workload()
+        fn = make_thin_eval_fn(tau, fd, edges, arclet, center_cut,
+                               iters=600)
+        batch = jnp.asarray(np.stack(
+            [cs_to_ri(c).astype(np.float32) for c in CS_list]))
+        sigs = np.asarray(fn(batch, jnp.asarray(etas)))
+        assert sigs.shape == (len(CS_list), len(etas))
+        for b, CS in enumerate(CS_list):
+            ref = np.array([singularvalue_calc(CS, tau, fd, eta, edges,
+                                               eta, arclet, center_cut)
+                            for eta in etas])
+            np.testing.assert_allclose(sigs[b], ref, rtol=5e-3)
+
+    def test_search_thin_backends_agree(self):
+        """single_search_thin finds the same η on both backends for a
+        synthetic arc chunk."""
+        from scintools_tpu.thth.search import single_search_thin
+
+        rng = np.random.default_rng(5)
+        nf = nt = 48
+        npad = 1
+        dt, df, f0 = 2.0, 0.05, 1400.0
+        times = np.arange(nt) * dt
+        freqs = f0 + np.arange(nf) * df
+        fd = fft_axis(times, pad=npad, scale=1e3)
+        tau = fft_axis(freqs, pad=npad, scale=1.0)
+        eta_true = tau.max() / (fd.max() / 3) ** 2
+        # point-image field on the η parabola → |E|² dynspec
+        fd_k = np.concatenate([[0.0], rng.uniform(-fd.max() / 3,
+                                                  fd.max() / 3, 12)])
+        tau_k = eta_true * fd_k ** 2
+        amp = np.concatenate([[1.0], 0.3 * rng.uniform(0.3, 1, 12)
+                              * np.exp(1j * rng.uniform(0, 2 * np.pi,
+                                                        12))])
+        E = (amp[None, :] * np.exp(2j * np.pi * (
+            np.outer(np.arange(nf) * df, tau_k)))) @ \
+            np.exp(2j * np.pi * 1e-3 * np.outer(fd_k, times))
+        dyn = np.abs(E) ** 2
+        dyn -= dyn.mean()
+        etas = np.linspace(0.5 * eta_true, 2.0 * eta_true, 40)
+        edges = np.linspace(-fd.max() / 2.2, fd.max() / 2.2, 40)
+        arclet = edges.copy()
+        res_np = single_search_thin(dyn, freqs, times, etas, edges,
+                                    arclet, 0.0, fw=0.3, npad=npad,
+                                    backend="numpy")
+        res_jx = single_search_thin(dyn, freqs, times, etas, edges,
+                                    arclet, 0.0, fw=0.3, npad=npad,
+                                    backend="jax")
+        assert np.isfinite(res_np.eta) and np.isfinite(res_jx.eta)
+        assert res_jx.eta == pytest.approx(res_np.eta, rel=0.02)
+        assert res_np.eta == pytest.approx(eta_true, rel=0.15)

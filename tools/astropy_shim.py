@@ -1,0 +1,260 @@
+"""Minimal dimensional astropy shim — JUST enough to import and run
+the reference scintools' numpy-only compute paths offline for golden
+generation (tools/make_golden.py). NOT a general astropy replacement.
+
+Everything the reference's ththmod/scint_sim/dynspec sspec-ACF paths
+touch dimensionally is a power of seconds (us = 1e-6·s¹,
+mHz = 1e-3·s⁻¹, s³ = s³), so a unit here is (scale_to_SI, power).
+Faithfulness matters only insofar as a WRONG shim would make the
+goldens disagree with our independent implementation — i.e. a shim bug
+shows up as a test failure, never as false confidence.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class Unit:
+    """Dimensional unit: value_SI = value * scale · s^power."""
+
+    # make ndarray binary ops defer to our __r*__ (incl. in-place
+    # `arr *= unit` falling back to `arr = arr * unit`)
+    __array_ufunc__ = None
+
+    def __init__(self, scale, power, name="unit"):
+        self.scale = float(scale)
+        self.power = int(power)
+        self.name = name
+
+    # -- unit algebra ---------------------------------------------------
+    def __mul__(self, other):
+        if isinstance(other, Unit):
+            return Unit(self.scale * other.scale,
+                        self.power + other.power,
+                        f"{self.name}*{other.name}")
+        return Quantity(np.asarray(other), self)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, Unit):
+            return Unit(self.scale / other.scale,
+                        self.power - other.power,
+                        f"{self.name}/{other.name}")
+        return Quantity(1.0 / np.asarray(other), self)
+
+    def __rtruediv__(self, other):
+        return Quantity(np.asarray(other),
+                        Unit(1 / self.scale, -self.power,
+                             f"1/{self.name}"))
+
+    def __pow__(self, n):
+        return Unit(self.scale ** n, self.power * n,
+                    f"{self.name}**{n}")
+
+    def is_equivalent(self, other):
+        if isinstance(other, Quantity):
+            other = other.unit
+        return self.power == other.power
+
+    def to(self, other):
+        if not self.is_equivalent(other):
+            raise UnitConversionError(f"{self.name} vs {other.name}")
+        return self.scale / other.scale
+
+    def __repr__(self):
+        return f"Unit({self.name})"
+
+
+class UnitConversionError(Exception):
+    pass
+
+
+dimensionless_unscaled = Unit(1.0, 0, "")
+
+
+class Quantity(np.ndarray):
+    __array_priority__ = 10000.0
+
+    def __new__(cls, value, unit):
+        obj = np.asarray(value).view(cls)
+        obj.unit = unit
+        return obj
+
+    def __array_finalize__(self, obj):
+        self.unit = getattr(obj, "unit", dimensionless_unscaled)
+
+    def __getitem__(self, key):
+        out = super().__getitem__(key)
+        if not isinstance(out, Quantity):   # int index → bare scalar
+            out = Quantity(out, self.unit)
+        return out
+
+    # -- astropy API surface used by the reference ---------------------
+    @property
+    def value(self):
+        v = self.view(np.ndarray)
+        return v[()] if v.ndim == 0 else v
+
+    def to(self, unit):
+        return Quantity(self.value * self.unit.to(unit), unit)
+
+    def to_value(self, unit):
+        return self.value * self.unit.to(unit)
+
+    def _factor_from(self, other):
+        """Conversion factor bringing ``other`` into self's unit."""
+        if isinstance(other, Quantity):
+            return other.value * other.unit.to(self.unit)
+        if isinstance(other, Unit):
+            raise TypeError("cannot add a bare unit")
+        return np.asarray(other)  # dimensionless numbers
+
+    # -- arithmetic with correct unit algebra --------------------------
+    def __mul__(self, other):
+        if isinstance(other, Quantity):
+            return Quantity(self.value * other.value,
+                            self.unit * other.unit)
+        if isinstance(other, Unit):
+            return Quantity(self.value, self.unit * other)
+        return Quantity(self.value * np.asarray(other), self.unit)
+
+    __rmul__ = __mul__
+    __imul__ = __mul__          # `q *= unit` rebinds (astropy-like)
+
+    def __truediv__(self, other):
+        if isinstance(other, Quantity):
+            return Quantity(self.value / other.value,
+                            self.unit / other.unit)
+        if isinstance(other, Unit):
+            return Quantity(self.value, self.unit / other)
+        return Quantity(self.value / np.asarray(other), self.unit)
+
+    def __rtruediv__(self, other):
+        inv = Unit(1 / self.unit.scale, -self.unit.power)
+        return Quantity(np.asarray(other) / self.value, inv)
+
+    def __pow__(self, n):
+        return Quantity(self.value ** n, self.unit ** n)
+
+    def __add__(self, other):
+        return Quantity(self.value + self._factor_from(other),
+                        self.unit)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return Quantity(self.value - self._factor_from(other),
+                        self.unit)
+
+    def __rsub__(self, other):
+        return Quantity(self._factor_from(other) - self.value,
+                        self.unit)
+
+    def __neg__(self):
+        return Quantity(-self.value, self.unit)
+
+    def __floordiv__(self, other):
+        if isinstance(other, Quantity):
+            if self.unit.is_equivalent(other.unit):
+                return np.asarray(
+                    self.value * self.unit.to(other.unit)
+                    // other.value)
+            return np.asarray(self.value // other.value)
+        return Quantity(self.value // np.asarray(other), self.unit)
+
+    def _cmp(self, other, op):
+        return op(self.value, self._factor_from(other))
+
+    def __lt__(self, other):
+        return self._cmp(other, np.less)
+
+    def __le__(self, other):
+        return self._cmp(other, np.less_equal)
+
+    def __gt__(self, other):
+        return self._cmp(other, np.greater)
+
+    def __ge__(self, other):
+        return self._cmp(other, np.greater_equal)
+
+    def __eq__(self, other):
+        return self._cmp(other, np.equal)
+
+    def __ne__(self, other):
+        return self._cmp(other, np.not_equal)
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+
+def install():
+    """Register shim modules in sys.modules (idempotent)."""
+    if "astropy" in sys.modules:
+        return sys.modules["astropy.units"]
+
+    units = types.ModuleType("astropy.units")
+    units.Unit = Unit
+    units.Quantity = Quantity
+    units.UnitConversionError = UnitConversionError
+    units.dimensionless_unscaled = dimensionless_unscaled
+    units.s = Unit(1.0, 1, "s")
+    units.us = Unit(1e-6, 1, "us")
+    units.ms = Unit(1e-3, 1, "ms")
+    units.Hz = Unit(1.0, -1, "Hz")
+    units.mHz = Unit(1e-3, -1, "mHz")
+    units.MHz = Unit(1e6, -1, "MHz")
+    units.minute = Unit(60.0, 1, "min")
+    units.min = units.minute
+    units.hour = Unit(3600.0, 1, "hour")
+    units.day = Unit(86400.0, 1, "day")
+    units.m = Unit(1.0, 0, "m")          # length: dimensionless slot
+    units.km = Unit(1e3, 0, "km")
+    units.kpc = Unit(3.0857e19, 0, "kpc")
+    units.pc = Unit(3.0857e16, 0, "pc")
+    units.deg = Unit(np.pi / 180, 0, "deg")
+    units.rad = Unit(1.0, 0, "rad")
+    units.mas = Unit(np.pi / 180 / 3.6e6, 0, "mas")
+    units.yr = Unit(3.1557e7, 1, "yr")
+
+    sys.modules["astropy.units"] = units
+
+    def _placeholder(name, **attrs):
+        m = types.ModuleType(name)
+        for k, v in attrs.items():
+            setattr(m, k, v)
+        sys.modules[name] = m
+        return m
+
+    class _Unavailable:
+        def __init__(self, *a, **k):
+            raise RuntimeError("astropy shim: not implemented — the "
+                               "golden generator must not reach this")
+
+    astropy = types.ModuleType("astropy")
+    astropy.units = units
+    sys.modules["astropy"] = astropy
+    _placeholder("astropy.time", Time=_Unavailable)
+    _placeholder("astropy.coordinates", SkyCoord=_Unavailable,
+                 get_body_barycentric=_Unavailable,
+                 get_body_barycentric_posvel=_Unavailable,
+                 BarycentricTrueEcliptic=_Unavailable,
+                 EarthLocation=_Unavailable, ICRS=_Unavailable)
+    consts = _placeholder("astropy.constants")
+    for name, val in (("c", 299792458.0), ("au", 1.495978707e11),
+                      ("pc", 3.0857e16), ("G", 6.674e-11),
+                      ("M_sun", 1.989e30)):
+        setattr(consts, name, type("C", (), {"value": val})())
+    _placeholder("astropy.io", fits=_Unavailable)
+    _placeholder("astropy.io.fits", open=_Unavailable)
+
+    # lmfit: module-level imports only (fits are never run here)
+    _placeholder("lmfit", Parameters=_Unavailable,
+                 Minimizer=_Unavailable, fit_report=_Unavailable,
+                 conf_interval=_Unavailable, minimize=_Unavailable)
+    _placeholder("emcee", EnsembleSampler=_Unavailable)
+    return units
