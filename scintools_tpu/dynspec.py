@@ -43,6 +43,9 @@ from .utils.misc import is_valid, svd_model
 SPEED_OF_LIGHT = 299792458.0  # m/s
 
 
+_SHARDED_GRID_CACHE = {}
+
+
 def _run_search_job(fn, args):
     """Module-level pool worker: picklable trampoline for the
     per-chunk θ-θ searches fanned over a user-supplied pool
@@ -416,7 +419,7 @@ class Dynspec:
         if "trap" in scale or trap:
             self.trapdyn = scale_ops.trapezoid_rescale(
                 self.dyn, self.times, self.freqs, window=window,
-                window_frac=window_frac)
+                window_frac=window_frac, backend=self.backend)
 
     # ------------------------------------------------------------------
     # Spectral products
@@ -1350,13 +1353,19 @@ class Dynspec:
                     self.eta_evo_err[cf, ct] = res.eta_sig
                     self.f0s[cf] = res.freq_mean
                     self.t0s[ct] = res.time_mean
+                ok = np.isfinite(self.eta_evo[cf])
                 if verbose:
-                    ok = np.isfinite(self.eta_evo[cf])
                     print(f"Chunk row {cf + 1}/{self.ncf_fit} "
                           f"(f={self.f0s[cf]:.1f} MHz): "
                           f"{int(ok.sum())}/{self.nct_fit} fits, "
                           f"median eta="
                           f"{np.nanmedian(self.eta_evo[cf]):.4g}")
+                from .utils import slog
+                slog.log_event(
+                    "thetatheta.row", cf=cf, freq=float(self.f0s[cf]),
+                    fits=int(ok.sum()), n=self.nct_fit,
+                    median_eta=float(np.nanmedian(self.eta_evo[cf]))
+                    if ok.any() else None)
         elif pool is not None:
             # reference pool semantics (dynspec.py:1715-1719): fan the
             # per-chunk searches over the user-supplied worker pool
@@ -1466,8 +1475,19 @@ class Dynspec:
             etas_list.append(etas_list[0])
             edges_list.append(edges_list[0])
 
-        fn = par.make_thth_grid_search_sharded(
-            mesh, tau, fd, len(self.edges))
+        # cache the compiled SPMD program per (geometry, mesh); NOTE
+        # make_thth_grid_search_sharded returns an already-jitted fn
+        # with sharding annotations — re-jitting (keyed_jit_cache)
+        # would erase them
+        key = (tau.tobytes(), fd.tobytes(), len(self.edges), id(mesh))
+        fn = _SHARDED_GRID_CACHE.get(key)
+        if fn is None:
+            if len(_SHARDED_GRID_CACHE) >= 8:
+                _SHARDED_GRID_CACHE.pop(
+                    next(iter(_SHARDED_GRID_CACHE)))
+            fn = par.make_thth_grid_search_sharded(
+                mesh, tau, fd, len(self.edges))
+            _SHARDED_GRID_CACHE[key] = fn
         eigs = np.asarray(fn(jnp.asarray(np.stack(cs_list)),
                              jnp.asarray(np.stack(edges_list)),
                              jnp.asarray(np.stack(etas_list))))[:B]
@@ -1796,7 +1816,18 @@ class HoloDyn:
 def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,
              min_tsub=10, min_freq=0, max_freq=5000, verbose=True,
              max_frac_bw=2):
-    """Filter a file list into good/bad sets (dynspec.py:4357-4441)."""
+    """Filter a file list into good/bad sets (dynspec.py:4357-4441).
+
+    Besides the reference's good/bad text files, every decision is
+    emitted as a structured log event (utils/slog.py) when a sink is
+    configured (``SCINTOOLS_LOG=...``)."""
+    from .utils import slog
+
+    def _reject(bad_files, dynfile, msg):
+        bad_files.write(f"{dynfile}\t{msg}\n")
+        slog.log_event("sort_dyn.reject", file=dynfile,
+                       reason=msg.strip())
+
     if outdir is None:
         outdir = os.path.split(dynfiles[0])[0]
     bad_path = os.path.join(outdir, "bad_files.txt")
@@ -1812,10 +1843,10 @@ def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,
             if dyn.freq > max_freq or dyn.freq < min_freq:
                 msg = (f"freq<{min_freq} " if dyn.freq < min_freq
                        else f"freq>{max_freq}")
-                bad_files.write(f"{dynfile}\t{msg}\n")
+                _reject(bad_files, dynfile, msg)
                 continue
             if dyn.bw / dyn.freq > max_frac_bw:
-                bad_files.write(f"{dynfile}\t frac_bw>{max_frac_bw}\n")
+                _reject(bad_files, dynfile, f" frac_bw>{max_frac_bw}")
                 continue
             dyn.trim_edges()
             if dyn.nchan < min_nchan or dyn.nsub < min_nsub:
@@ -1824,16 +1855,17 @@ def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,
                     msg += f"nchan<{min_nchan} "
                 if dyn.nsub < min_nsub:
                     msg += f"nsub<{min_nsub}"
-                bad_files.write(f"{dynfile}\t {msg}\n")
+                _reject(bad_files, dynfile, f" {msg}")
                 continue
             if dyn.tobs < 60 * min_tsub:
-                bad_files.write(f"{dynfile}\t tobs<{min_tsub}\n")
+                _reject(bad_files, dynfile, f" tobs<{min_tsub}")
                 continue
             dyn.refill()
             dyn.correct_dyn()
             dyn.calc_sspec()
             if np.isnan(dyn.sspec).all():
-                bad_files.write(f"{dynfile}\t sspec_isnan\n")
+                _reject(bad_files, dynfile, " sspec_isnan")
                 continue
             good_files.write(f"{dynfile}\n")
+            slog.log_event("sort_dyn.accept", file=dynfile)
     return good_path, bad_path

@@ -7,7 +7,10 @@ scipy least-squares (scint_models.py:164-215 → scint_sim.py:417-765).
 Here the model (sim/acf_model.py:make_acf2d_model_fn) and the
 Levenberg–Marquardt loop (fit/lm_jax.py) are ONE compiled program: the
 residual, its forward-mode jacobian over the ~5 varying parameters,
-and the damped normal-equation solve all run on device.
+and the damped normal-equation solve all run on device. Compiled
+solvers are cached on the static fit configuration (crop shape, grid
+sizes, vary set, bounds), so survey workloads with many epochs pay
+one compile.
 """
 
 from __future__ import annotations
@@ -20,6 +23,8 @@ from .lm_jax import make_lm_solver, lm_covariance
 
 MODEL_ARGS = ("tau", "dnu", "amp", "phasegrad", "psi", "wn", "alpha")
 
+_SOLVER_CACHE = {}
+
 
 def _spike_zero_weights(weights, shape):
     """The white-noise spike is not fitted (scint_models.py:125-127)."""
@@ -30,66 +35,97 @@ def _spike_zero_weights(weights, shape):
     return np.fft.ifftshift(w)
 
 
-def fit_acf2d_tpu(params, ydata, weights, n_iter=60):
-    """Drop-in acf2d fit on the jax backend.
+def _build(nt_crop, nf_crop, dt, df, ar, alpha, theta, tau0, vary,
+           lo, hi, n_iter):
+    """Compile (solver, residual) for one static fit configuration.
 
-    params must carry the reference parameter set (tau, dnu, amp,
-    phasegrad, psi varying as configured; ar/theta/alpha/nt/nf/tobs/bw
-    fixed — dynspec.py:2858-2871). Returns a MinimizerResult with
-    lmfit-convention stderr from the Gauss-Newton covariance.
+    All per-call data (ydata, weights, triangle taper, fixed model
+    values) flow in as solver ARGUMENTS, so the compiled program is
+    reusable across epochs; only the statics live in the closure.
     """
     jax = get_jax()
     import jax.numpy as jnp
 
     from ..sim.acf_model import make_acf2d_model_fn
 
+    model = make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha,
+                                theta, tau0=tau0)
+    vary_idx = {n: i for i, n in enumerate(vary)}
+
+    def residual(x, y, w, tri, fixed_vec):
+        vals = [x[vary_idx[n]] if n in vary_idx else fixed_vec[j]
+                for j, n in enumerate(MODEL_ARGS)]
+        m = model(*vals) * tri
+        return ((y - m) * w).ravel()
+
+    solver = jax.jit(make_lm_solver(residual, n_iter=n_iter,
+                                    bounds=(lo, hi)))
+    return solver, residual
+
+
+def fit_acf2d_tpu(params, ydata, weights, n_iter=60):
+    """Drop-in acf2d fit on the jax backend.
+
+    params must carry the reference parameter set (tau, dnu, amp,
+    phasegrad, psi varying as configured; ar/theta/nt/nf/tobs/bw
+    fixed, alpha fixed or varying — dynspec.py:2858-2871). Returns a
+    MinimizerResult with lmfit-convention stderr from the Gauss-Newton
+    covariance.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    from ..sim.acf_model import acf2d_grid_sizes
+
     ydata = np.asarray(ydata, dtype=float)
     nf_crop, nt_crop = ydata.shape
     p = {k: v.value for k, v in params.items()}
     dt = 2 * p["tobs"] / p["nt"]
     df = 2 * p["bw"] / p["nf"]
-    model = make_acf2d_model_fn(
-        nt_crop, nf_crop, dt, df, abs(p["ar"]), p["alpha"], p["theta"],
-        tau0=abs(p["tau"]))    # alpha traced per-eval when it varies
-
-    vary = [n for n in MODEL_ARGS
-            if n in params and params[n].vary]
-    fixed = {n: float(p.get(n, 0.0)) for n in MODEL_ARGS
-             if n not in vary}
+    ar = abs(p["ar"])
+    vary = tuple(n for n in MODEL_ARGS
+                 if n in params and params[n].vary)
+    lo = np.array([params[n].min for n in vary], dtype=float)
+    hi = np.array([params[n].max for n in vary], dtype=float)
+    # the initial tau fixes only the (static) integration-grid sizes
+    grid_key = acf2d_grid_sizes(nt_crop, dt, ar, abs(p["tau"]))
+    key = (nt_crop, nf_crop, round(dt, 9), round(df, 9), ar,
+           p["alpha"], p["theta"], grid_key, vary, lo.tobytes(),
+           hi.tobytes(), n_iter)
+    if key not in _SOLVER_CACHE:
+        if len(_SOLVER_CACHE) >= 16:
+            _SOLVER_CACHE.pop(next(iter(_SOLVER_CACHE)))
+        _SOLVER_CACHE[key] = _build(nt_crop, nf_crop, dt, df, ar,
+                                    p["alpha"], p["theta"],
+                                    abs(p["tau"]), vary, lo, hi,
+                                    n_iter)
+    solver, residual = _SOLVER_CACHE[key]
 
     w_j = jnp.asarray(_spike_zero_weights(weights, ydata.shape))
     y_j = jnp.asarray(ydata)
     # triangle tapers (scint_models.py:119-121): τmax·τ = nt_crop·dt
-    # regardless of the current τ, so both tapers are static
+    # regardless of the current τ, so both tapers are per-call static
     tri_t = 1 - np.abs(np.linspace(-nt_crop * dt, nt_crop * dt,
                                    nt_crop)) / p["tobs"]
     tri_f = 1 - np.abs(np.linspace(-nf_crop * df, nf_crop * df,
                                    nf_crop)) / p["bw"]
     tri_j = jnp.asarray(np.outer(tri_f, tri_t))
-
-    def residual(x):
-        kw = dict(fixed)
-        for i, n in enumerate(vary):
-            kw[n] = x[i]
-        m = model(kw["tau"], kw["dnu"], kw["amp"], kw["phasegrad"],
-                  kw["psi"], kw["wn"], kw["alpha"]) * tri_j
-        return ((y_j - m) * w_j).ravel()
-
-    lo = np.array([params[n].min for n in vary], dtype=float)
-    hi = np.array([params[n].max for n in vary], dtype=float)
+    fixed_vec = jnp.asarray([float(p.get(n, 0.0))
+                             for n in MODEL_ARGS])
     x0 = np.array([p[n] for n in vary], dtype=float)
-    solver = jax.jit(make_lm_solver(residual, n_iter=n_iter,
-                                    bounds=(lo, hi)))
-    x, cost = jax.block_until_ready(solver(jnp.asarray(x0)))
+
+    args = (y_j, w_j, tri_j, fixed_vec)
+    x, cost = jax.block_until_ready(solver(jnp.asarray(x0), *args))
     x = np.asarray(x, dtype=float)
-    cov = np.asarray(lm_covariance(residual, jnp.asarray(x)))
+    cov = np.asarray(lm_covariance(residual, jnp.asarray(x),
+                                   args=args))
 
     out = params.copy()
     for i, n in enumerate(vary):
         out[n].value = float(abs(x[i]) if n in ("tau", "dnu")
                              else x[i])
         out[n].stderr = float(np.sqrt(np.abs(cov[i, i])))
-    res = np.asarray(residual(jnp.asarray(x)))
+    res = np.asarray(residual(jnp.asarray(x), *args))
     result = MinimizerResult(out, residual=res, nfev=n_iter,
                              message="jitted LM (fit/acf2d.py)")
     return result

@@ -61,9 +61,18 @@ def velocity_rescale(dyn, veff):
 
 
 def trapezoid_rescale(dyn, times, freqs, window="hanning",
-                      window_frac=0.1):
+                      window_frac=0.1, backend=None):
     """Trapezoid scaling: per-frequency-row time resampling with
-    trailing zeros (dynspec.py:4081-4128)."""
+    trailing zeros (dynspec.py:4081-4128).
+
+    The per-row sample counts depend only on the (concrete) time and
+    frequency axes, so on the jax backend the whole rescale is one
+    fixed-shape program: a vmapped ``jnp.interp`` over rows with a
+    per-row validity mask instead of the reference's python row loop.
+    """
+    from ..backend import resolve_backend
+
+    backend = resolve_backend(backend)
     dyn = np.asarray(dyn, dtype=float)
     dyn = dyn - np.mean(dyn)
     nf, nt = dyn.shape
@@ -71,14 +80,35 @@ def trapezoid_rescale(dyn, times, freqs, window="hanning",
         cw, sw = get_window(nt, nf, window=window, frac=window_frac)
         dyn = cw * dyn
         dyn = (sw * dyn.T).T
+    times = np.asarray(times, dtype=float)
     scalefrac = 1 / (np.max(freqs) / np.min(freqs))
     timestep = np.max(times) * (1 - scalefrac) / (nf + 1)
-    out = np.empty_like(dyn)
-    for ii in range(nf):
-        maxtime = np.max(times) - (nf - (ii + 1)) * timestep
-        n_in = int(np.sum(times <= maxtime))
-        newline = np.interp(
-            np.linspace(np.min(times), np.max(times), n_in), times,
-            dyn[ii, :])
-        out[ii, :] = np.concatenate([newline, np.zeros(nt - n_in)])
-    return out
+    maxtimes = np.max(times) - (nf - (np.arange(nf) + 1)) * timestep
+    n_in = (times[None, :] <= maxtimes[:, None]).sum(axis=1)
+
+    if backend == "numpy":
+        out = np.empty_like(dyn)
+        for ii in range(nf):
+            newline = np.interp(
+                np.linspace(np.min(times), np.max(times), n_in[ii]),
+                times, dyn[ii, :])
+            out[ii, :] = np.concatenate(
+                [newline, np.zeros(nt - n_in[ii])])
+        return out
+
+    import jax
+    import jax.numpy as jnp
+
+    j = np.arange(nt)
+    # row-wise resample positions (linspace(min, max, n_in) padded)
+    denom = np.maximum(n_in - 1, 1)[:, None]
+    X = np.min(times) + j[None, :] * (np.max(times)
+                                      - np.min(times)) / denom
+    valid = j[None, :] < n_in[:, None]
+    t_j = jnp.asarray(times)
+
+    def row(x, d, v):
+        return jnp.where(v, jnp.interp(x, t_j, d), 0.0)
+
+    return np.asarray(jax.jit(jax.vmap(row))(
+        jnp.asarray(X), jnp.asarray(dyn), jnp.asarray(valid)))

@@ -85,6 +85,8 @@ def run_survey_with_checkpoints(step_fn, init_state, n_steps, directory,
     """Resumable driver: applies ``state = step_fn(state, i)`` for i in
     [0, n_steps), checkpointing every ``every`` steps and resuming from
     the latest checkpoint when one exists. Returns the final state."""
+    from ..utils import slog
+
     ckpt = SurveyCheckpointer(directory, every=every, keep=keep)
     latest = ckpt.latest_step()
     if latest is None:
@@ -92,10 +94,13 @@ def run_survey_with_checkpoints(step_fn, init_state, n_steps, directory,
     else:
         state = ckpt.restore(latest, template=init_state)
         start = int(latest) + 1
+        slog.log_event("survey.resume", step=start)
     try:
-        for i in range(start, int(n_steps)):
-            state = step_fn(state, i)
-            ckpt.maybe_save(i, state)
+        with slog.span("survey.run", start=start, n_steps=int(n_steps)):
+            for i in range(start, int(n_steps)):
+                state = step_fn(state, i)
+                if ckpt.maybe_save(i, state):
+                    slog.log_event("survey.checkpoint", step=i)
         if int(n_steps) > 0 and ckpt.latest_step() != int(n_steps) - 1:
             ckpt.save(int(n_steps) - 1, state)
     finally:

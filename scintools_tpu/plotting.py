@@ -192,8 +192,11 @@ def plot_acf_tilt(ds, peaks, peakerrs, ys, yfit, nscaleplot=2,
                         display, dpi))
 
     acf = np.array(ds.acf)
-    t_delays = np.linspace(-ds.tobs / 60, ds.tobs / 60, acf.shape[1])
-    f_shifts = np.linspace(-ds.bw, ds.bw, acf.shape[0])
+    # same lag-axis convention as the peak measurements in
+    # get_acf_tilt (dynspec.py) so the overlay aligns with the pixels
+    t_delays = np.linspace(-ds.tobs / 60, ds.tobs / 60,
+                           acf.shape[1] + 1)[:-1]
+    f_shifts = np.linspace(-ds.bw, ds.bw, acf.shape[0] + 1)[:-1]
     fig = plt.figure(figsize=figsize)
     plt.pcolormesh(centres_to_edges(t_delays),
                    centres_to_edges(f_shifts), acf, linewidth=0,

@@ -246,6 +246,22 @@ def theoretical_acf(**kwargs):
     return ACF(**kwargs)
 
 
+def acf2d_grid_sizes(nt_crop, dt, ar, tau0, grid_oversample=1.25):
+    """(n_normal, n_core) integration-grid point counts used by
+    :func:`make_acf2d_model_fn` — the only way ``tau0`` enters the
+    compiled program, hence the cache key in fit/acf2d.py."""
+    res_fac = 1 + ar / 3
+    core_fac = 4 * res_fac
+    taumax0 = nt_crop * dt / abs(tau0)
+    dsp0 = 4 * taumax0 / (nt_crop - 1)
+
+    def n(fac):
+        return max(int(np.ceil(2 * 6 * ar / (dsp0 / fac)
+                               * grid_oversample)), 9)
+
+    return n(res_fac), n(core_fac)
+
+
 def make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha, theta,
                         tau0, grid_oversample=1.25):
     """Build a fully-jitted theoretical-ACF model
@@ -277,25 +293,22 @@ def make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha, theta,
         raise ValueError("acf2d crop must be odd-sized (reference "
                          "centres the ACF, dynspec.py:2729-2745)")
     sqrtar = float(np.sqrt(ar))
-    res_fac = 1 + ar / 3                    # auto-sampling factors
-    core_fac = 4 * res_fac                  # (scint_sim.py:510-513)
-    taumax0 = nt_crop * dt / abs(tau0)
-    dsp0 = 4 * taumax0 / (nt_crop - 1)
-
     # grids are static (size from tau0, range ±6·ar); alpha enters
     # only through the exponent of exp(−0.5·BASE^(α/2)), so a varying
     # alpha (get_scint_params(alpha=None), dynspec.py:745-746) stays
     # traceable with the same static BASE arrays
-    def _grid(fac):
-        n = int(np.ceil(2 * 6 * ar / (dsp0 / fac) * grid_oversample))
-        snp = np.linspace(-6 * ar, 6 * ar, max(n, 9))
+    n_normal, n_core = acf2d_grid_sizes(nt_crop, dt, ar, tau0,
+                                        grid_oversample)
+
+    def _grid(n):
+        snp = np.linspace(-6 * ar, 6 * ar, n)
         SX, SY = np.meshgrid(snp, snp)
         base = (SX / sqrtar) ** 2 + (SY * sqrtar) ** 2
         return (jnp.asarray(snp), jnp.asarray(base),
                 float(snp[1] - snp[0]))
 
-    snp_j, base_j, step = _grid(res_fac)
-    snp2_j, base2_j, step2 = _grid(core_fac)
+    snp_j, base_j, step = _grid(n_normal)
+    snp2_j, base2_j, step2 = _grid(n_core)
     ndnun = (nf_crop + 1) // 2
     spike_index = nt_crop // 2              # tn centre (nt odd)
     deg = np.pi / 180.0

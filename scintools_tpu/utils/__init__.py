@@ -1,8 +1,8 @@
 """Utilities: ephemerides, orbits, velocities, archive hook, misc
 (scint_utils.py re-design)."""
 
-from . import ephemeris, orbit, velocity, misc, archive, profiling
+from . import ephemeris, orbit, velocity, misc, archive, profiling, slog
 from .profiling import Timer, timeit_fn
 
 __all__ = ["ephemeris", "orbit", "velocity", "misc", "archive",
-           "profiling", "Timer", "timeit_fn"]
+           "profiling", "slog", "Timer", "timeit_fn"]

@@ -1,0 +1,80 @@
+"""Structured (JSON-lines) logging for pipelines and surveys.
+
+The reference's observability is print-based ``verbose`` flags and an
+``info()`` summary (/root/reference/scintools/dynspec.py:1521-1537,
+:4130-4143); results accumulate only into the CSV schema. For
+survey-scale runs (thousands of epochs, sharded over a mesh) that is
+not greppable or machine-readable, so this module adds a minimal
+structured logger:
+
+- ``log_event(event, **fields)`` — one JSON object per line with a
+  wall-clock timestamp, to stderr and/or a file;
+- ``configure(path=None, echo=True, enabled=None)`` — process-wide
+  sink; ``SCINTOOLS_LOG=<path>`` enables file logging from the
+  environment;
+- ``span(event, **fields)`` — context manager that logs start/end
+  with duration and error status.
+
+No dependencies; safe to call from pool workers (line-buffered append
+writes are atomic enough for JSONL at this scale).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from contextlib import contextmanager
+
+_STATE = {
+    "path": os.environ.get("SCINTOOLS_LOG") or None,
+    "echo": bool(int(os.environ.get("SCINTOOLS_LOG_ECHO", "0"))),
+}
+
+
+def configure(path=None, echo=None):
+    """Set the process-wide log sink. ``path=None`` keeps the current
+    file (env ``SCINTOOLS_LOG`` by default); ``echo`` mirrors events
+    to stderr."""
+    if path is not None:
+        _STATE["path"] = path
+    if echo is not None:
+        _STATE["echo"] = bool(echo)
+
+
+def enabled():
+    return bool(_STATE["path"] or _STATE["echo"])
+
+
+def log_event(event, **fields):
+    """Emit one structured event. No-op unless a sink is configured."""
+    if not enabled():
+        return
+    rec = {"t": round(time.time(), 3), "event": event, **fields}
+    line = json.dumps(rec, default=str)
+    if _STATE["echo"]:
+        print(line, file=sys.stderr)
+    if _STATE["path"]:
+        try:
+            with open(_STATE["path"], "a") as fh:
+                fh.write(line + "\n")
+        except OSError as e:  # never let logging kill a survey
+            print(f"Warning: structured log write failed ({e})",
+                  file=sys.stderr)
+
+
+@contextmanager
+def span(event, **fields):
+    """Log ``<event>.start`` / ``<event>.end`` around a block, with
+    wall-clock duration and error capture (the error propagates)."""
+    log_event(event + ".start", **fields)
+    t0 = time.perf_counter()
+    try:
+        yield
+    except Exception as e:
+        log_event(event + ".end", ok=False, error=repr(e),
+                  secs=round(time.perf_counter() - t0, 4), **fields)
+        raise
+    log_event(event + ".end", ok=True,
+              secs=round(time.perf_counter() - t0, 4), **fields)

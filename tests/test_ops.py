@@ -169,3 +169,16 @@ class TestScale:
         assert out.shape == dyn.shape
         # lowest-frequency rows are compressed: trailing zeros present
         assert out[0, -1] == 0.0
+
+
+class TestTrapezoidBackends:
+    def test_jax_matches_numpy(self):
+        from scintools_tpu.ops.scale import trapezoid_rescale
+
+        rng = np.random.default_rng(3)
+        dyn = rng.normal(size=(24, 32)) ** 2
+        times = np.arange(32) * 10.0
+        freqs = 1300.0 + np.arange(24) * 2.0
+        a = trapezoid_rescale(dyn, times, freqs, backend="numpy")
+        b = trapezoid_rescale(dyn, times, freqs, backend="jax")
+        np.testing.assert_allclose(b, a, atol=1e-10)

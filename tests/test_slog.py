@@ -1,0 +1,55 @@
+"""Structured logging (utils/slog.py) and its pipeline wiring."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scintools_tpu.utils import slog
+
+
+@pytest.fixture(autouse=True)
+def _reset_sink():
+    old = dict(slog._STATE)
+    yield
+    slog._STATE.update(old)
+
+
+class TestSlog:
+    def test_disabled_by_default_noop(self, tmp_path):
+        slog.configure(echo=False)
+        slog._STATE["path"] = None
+        slog.log_event("x", a=1)          # must not raise or write
+        assert not slog.enabled()
+
+    def test_jsonl_events_and_span(self, tmp_path):
+        path = tmp_path / "log.jsonl"
+        slog.configure(path=str(path), echo=False)
+        slog.log_event("hello", n=3)
+        with slog.span("work", tag="t"):
+            pass
+        with pytest.raises(ValueError):
+            with slog.span("boom"):
+                raise ValueError("nope")
+        lines = [json.loads(x) for x in path.read_text().splitlines()]
+        events = [r["event"] for r in lines]
+        assert events == ["hello", "work.start", "work.end",
+                          "boom.start", "boom.end"]
+        assert lines[2]["ok"] is True and "secs" in lines[2]
+        assert lines[4]["ok"] is False and "ValueError" in lines[4]["error"]
+
+    def test_sort_dyn_emits_decisions(self, tmp_path):
+        from scintools_tpu.dynspec import sort_dyn
+
+        data = ("/root/reference/scintools/examples/data/J0437-4715/"
+                "p111220_074112.rf.pcm.dynspec")
+        if not os.path.exists(data):
+            pytest.skip("sample data not mounted")
+        path = tmp_path / "survey.jsonl"
+        slog.configure(path=str(path), echo=False)
+        good, bad = sort_dyn([data], outdir=str(tmp_path),
+                             verbose=False, min_freq=2000)  # reject
+        recs = [json.loads(x) for x in path.read_text().splitlines()]
+        assert recs and recs[0]["event"] == "sort_dyn.reject"
+        assert "freq" in recs[0]["reason"]
