@@ -53,6 +53,20 @@ def _run_search_job(fn, args):
     return fn(*args)
 
 
+def _asymmetry_job(dspec2, time2, freq2, eta, edges, npad):
+    """Pool worker for :meth:`Dynspec.calc_asymmetry` (reference
+    dynspec.py:1916-1918): one chunk → rank-1 θ-θ eigenvector → L/R
+    power asymmetry; failures map to NaN."""
+    CS, tau, fd = thth_search.chunk_conjugate_spectrum(
+        dspec2, time2, freq2, npad=npad)
+    try:
+        out = thth_core.modeler(CS, tau, fd, eta, edges,
+                                backend="numpy")
+        return thth_ret.calc_asymmetry(out[6], out[4])
+    except Exception:
+        return np.nan
+
+
 class Dynspec:
     """Dynamic spectrum analysis object (reference: dynspec.py:41)."""
 
@@ -177,7 +191,10 @@ class Dynspec:
         self.tobs = round(float(max(self.times) + self.dt), 3)
 
     def trim_edges(self, bandwagon_frac=0.5, remove_short_sub=True):
-        """Trim zero band/time edges (dynspec.py:259-328)."""
+        """Trim zero band/time edges (dynspec.py:259-328).
+
+        ``remove_short_sub`` is accepted for API parity; the reference
+        accepts it and never uses it either (dynspec.py:259)."""
         self.dyn = np.nan_to_num(self.dyn)
 
         def zap_edge_rows(dyn, idx, frac, axis):
@@ -483,6 +500,13 @@ class Dynspec:
             if plot:
                 self.plot_sspec(lamsteps=lamsteps, trap=trap)
         else:
+            if plot:
+                self.plot_sspec(input_sspec=sec, lamsteps=lamsteps,
+                                input_x=(input_x if input_x is not None
+                                         else fdop),
+                                input_y=(input_y if input_y is not None
+                                         else (beta if lamsteps
+                                               else tdel)))
             return fdop, (beta if lamsteps else tdel), sec
 
     def calc_acf(self, method="direct", input_dyn=None, normalise=True,
@@ -579,7 +603,12 @@ class Dynspec:
                 fit_spectrum=False, subtract_artefacts=False,
                 velocity=False, weighted=False, figsize=(9, 9), dpi=200,
                 figN=None):
-        """Arc-curvature measurement (dynspec.py:970-1346)."""
+        """Arc-curvature measurement (dynspec.py:970-1346).
+
+        Explicit ``etamin``/``etamax``/``constraint`` follow the
+        reference convention: in the non-lamsteps path they are given
+        as β values at ``ref_freq`` and converted to η(s³) at this
+        spectrum's frequency (dynspec.py:1139-1148)."""
         if not hasattr(self, "tdel"):
             self.calc_sspec()
         sspec, yaxis = self._select_sspec(lamsteps=lamsteps,
@@ -588,6 +617,19 @@ class Dynspec:
         # crop index defined on the tdel axis; translate to yaxis
         ind = int(np.argmin(np.abs(self.tdel - delmax_t)))
         ymax_cut = yaxis[min(ind, len(yaxis) - 1)]
+
+        if not lamsteps:
+            beta_to_eta = (SPEED_OF_LIGHT * 1e6
+                           / (ref_freq * 1e6) ** 2)
+            fcorr = (self.freq / ref_freq) ** 2
+
+            def b2e(x):
+                return None if x is None else \
+                    np.asarray(x) / fcorr * beta_to_eta
+
+            etamax = b2e(etamax)
+            etamin = b2e(etamin)
+            constraint = np.asarray(constraint) / fcorr * beta_to_eta
 
         fits = fitarc_ops.fit_arc(
             sspec, yaxis, self.fdop, asymm=asymm, delmax=ymax_cut,
@@ -623,11 +665,26 @@ class Dynspec:
                 self.norm_sspec_avg = fit.profile
                 self.prob_eta_peak = fit.prob_eta_peak
         self.eta_array = fits[0].eta_array
+        if plot_spec:
+            # reference forwards plot_spec into the norm_sspec step
+            # (dynspec.py:1159-1161): render the normalised-sspec
+            # diagnostic panels at the fitted curvature. norm_sspec's
+            # explicit-eta convention in the non-lamsteps path is a β
+            # value at ref_freq (dynspec.py:2031-2036), so convert the
+            # fitted η back to that form before handing it over.
+            eta_plot = fits[0].eta
+            if not lamsteps:
+                eta_plot = (eta_plot * (self.freq / ref_freq) ** 2
+                            / (SPEED_OF_LIGHT * 1e6
+                               / (ref_freq * 1e6) ** 2))
+            self.norm_sspec(eta=eta_plot, delmax=delmax_t, plot=True,
+                            lamsteps=lamsteps, ref_freq=ref_freq,
+                            display=display)
         if plot:
             from . import plotting
             plotting.plot_arc_fit(fits[0], lamsteps=lamsteps,
                                   filename=filename, display=display,
-                                  figsize=figsize, dpi=dpi)
+                                  figsize=figsize, dpi=dpi, figN=figN)
         return fits
 
     def norm_sspec(self, eta=None, delmax=None, plot=False, startbin=1,
@@ -654,6 +711,12 @@ class Dynspec:
                     self.fit_arc(delmax=delmax, startbin=startbin,
                                  velocity=velocity)
                 eta = self.eta
+        elif not lamsteps:
+            # explicit η in the non-lamsteps path is a β value at
+            # ref_freq (dynspec.py:2031-2036)
+            beta_to_eta = (SPEED_OF_LIGHT * 1e6
+                           / (ref_freq * 1e6) ** 2)
+            eta = eta / (self.freq / ref_freq) ** 2 * beta_to_eta
 
         delmax_t = np.max(self.tdel) if delmax is None else delmax
         ind = int(np.argmin(np.abs(self.tdel - delmax_t)))
@@ -921,6 +984,18 @@ class Dynspec:
             if verbose:
                 print(self.report)
 
+        if plot:
+            from . import plotting
+            if method == "acf1d":
+                plotting.plot_scint_fit_1d(
+                    self, results, xdata_t, ydata_t, t_errors,
+                    xdata_f, ydata_f, f_errors, filename=filename,
+                    display=display, dpi=dpi)
+            elif method.startswith("acf2d"):
+                plotting.plot_scint_fit_2d(
+                    self, results, method, tdata, fdata, ydata_2d,
+                    filename=filename, display=display, dpi=dpi)
+
         # store results + finite-scintle errors (dynspec.py:2963-3028)
         self.tau = results.params["tau"].value
         self.dnu = results.params["dnu"].value
@@ -1116,6 +1191,11 @@ class Dynspec:
         scat_im[0:ny - 1, :] = image[ny - 1:0:-1, :]
         self.scattered_image = scat_im
         self.scattered_image_ax = fdop_x
+        if plot:
+            self.plot_scattered_image(plot_log=plot_log,
+                                      use_angle=use_angle,
+                                      use_spatial=use_spatial, s=s,
+                                      veff=veff, d=d)
         return scat_im
 
     # ------------------------------------------------------------------
@@ -1436,6 +1516,10 @@ class Dynspec:
         self.ththeta = A / self.fref ** 2
         self.ththetaerr = A_err / self.fref ** 2
 
+        if plot:
+            from . import plotting
+            plotting.plot_eta_evolution(self, time_avg=time_avg)
+
     def _fit_thetatheta_sharded(self, mesh, verbose=False):
         """SPMD chunk-grid search: every (cf, ct) chunk of the θ-θ fit
         grid runs in ONE jitted program with the chunk axis sharded
@@ -1478,8 +1562,12 @@ class Dynspec:
         # cache the compiled SPMD program per (geometry, mesh); NOTE
         # make_thth_grid_search_sharded returns an already-jitted fn
         # with sharding annotations — re-jitting (keyed_jit_cache)
-        # would erase them
-        key = (tau.tobytes(), fd.tobytes(), len(self.edges), id(mesh))
+        # would erase them. The mesh keys by its device ids + axis
+        # layout (id(mesh) could alias a new mesh after gc).
+        mesh_key = (tuple(d.id for d in np.ravel(mesh.devices)),
+                    tuple(mesh.axis_names),
+                    tuple(mesh.shape.values()))
+        key = (tau.tobytes(), fd.tobytes(), len(self.edges), mesh_key)
         fn = _SHARDED_GRID_CACHE.get(key)
         if fn is None:
             if len(_SHARDED_GRID_CACHE) >= 8:
@@ -1505,7 +1593,11 @@ class Dynspec:
                   f"chunk fits on {ndev} devices")
 
     def thetatheta_chunks(self, verbose=False, pool=None, memmap=False):
-        """Half-overlapping retrieval chunk grid (dynspec.py:1765-1826)."""
+        """Half-overlapping retrieval chunk grid (dynspec.py:1765-1826).
+
+        ``pool``: used for the per-chunk retrieval fan-out on the
+        numpy backend (reference pool dispatch, dynspec.py:1812-1826);
+        on jax the batched jitted retrieval replaces it."""
         if not hasattr(self, "ththeta"):
             self.fit_thetatheta(verbose=verbose)
         if memmap:
@@ -1538,6 +1630,23 @@ class Dynspec:
                     print(f"retrieved row {cf + 1}/{self.ncf_ret} "
                           f"({self.nct_ret} chunks, eta={eta:.4g})")
             return
+        if pool is not None:
+            jobs = []
+            for cf in range(self.ncf_ret):
+                for ct in range(self.nct_ret):
+                    dspec2, freq2, time2 = self._chunk(cf, ct,
+                                                       fit=False)
+                    freq = freq2.mean()
+                    eta = self.ththeta * (self.fref / freq) ** 2
+                    jobs.append(
+                        (thth_ret.single_chunk_retrieval,
+                         (dspec2, self.edges * (freq / self.fref),
+                          time2, freq2, eta, ct, cf, self.npad,
+                          self.thth_tau_mask, False, "numpy")))
+            for model_E, cf, ct in pool.starmap(_run_search_job,
+                                                jobs):
+                self.chunks[cf, ct, :, :] = model_E
+            return
         for cf in range(self.ncf_ret):
             for ct in range(self.nct_ret):
                 dspec2, freq2, time2 = self._chunk(cf, ct, fit=False)
@@ -1553,9 +1662,11 @@ class Dynspec:
     def calc_wavefield(self, verbose=False, pool=None, gs=False,
                        memmap=False, niter=1):
         """Mosaic the retrieval chunks into the wavefield
-        (dynspec.py:1828-1852)."""
+        (dynspec.py:1828-1852). ``pool`` forwards to the retrieval
+        fan-out (numpy backend)."""
         if not hasattr(self, "chunks"):
-            self.thetatheta_chunks(verbose=verbose, memmap=memmap)
+            self.thetatheta_chunks(verbose=verbose, memmap=memmap,
+                                   pool=pool)
         self.wavefield = thth_ret.mosaic(self.chunks)
         if gs:
             self.gerchberg_saxton(verbose=verbose, niter=niter)
@@ -1563,7 +1674,9 @@ class Dynspec:
 
     def gerchberg_saxton(self, niter=1, verbose=False, pool=None):
         """GS amplitude/causality iterations on the wavefield
-        (dynspec.py:1854-1890); delegates to the shared kernel."""
+        (dynspec.py:1854-1890); delegates to the shared kernel.
+        ``pool`` is accepted for API parity — the iteration is one
+        whole-array FFT loop with nothing to fan out."""
         if not hasattr(self, "wavefield"):
             self.calc_wavefield(verbose=verbose)
         self.wavefield = thth_ret.gerchberg_saxton(
@@ -1573,10 +1686,26 @@ class Dynspec:
 
     def calc_asymmetry(self, verbose=False, pool=None):
         """Per-chunk L/R eigenvector power asymmetry
-        (dynspec.py:1892-1918)."""
+        (dynspec.py:1892-1918). ``pool`` fans the per-chunk modeler
+        over worker processes (reference dynspec.py:1916-1918)."""
         if not hasattr(self, "ththeta"):
             self.fit_thetatheta(verbose=verbose)
         self.asymmetry = np.zeros((self.ncf_fit, self.nct_fit))
+        if pool is not None:
+            jobs = []
+            for cf in range(self.ncf_fit):
+                for ct in range(self.nct_fit):
+                    dspec2, freq2, time2 = self._chunk(cf, ct,
+                                                       fit=True)
+                    freq = freq2.mean()
+                    jobs.append((dspec2, time2, freq2,
+                                 self.ththeta * (self.fref / freq) ** 2,
+                                 self.edges * (freq / self.fref),
+                                 self.npad))
+            out = pool.starmap(_asymmetry_job, jobs)
+            self.asymmetry = np.reshape(out, (self.ncf_fit,
+                                              self.nct_fit))
+            return self.asymmetry
         for cf in range(self.ncf_fit):
             for ct in range(self.nct_fit):
                 dspec2, freq2, time2 = self._chunk(cf, ct, fit=True)
@@ -1658,37 +1787,43 @@ class Dynspec:
     def plot_sspec(self, lamsteps=False, input_sspec=None, filename=None,
                    input_x=None, input_y=None, trap=False,
                    prewhite=False, plotarc=False, maxfdop=np.inf,
-                   delmax=None, ref_freq=1400, cutmid=0, startbin=0,
-                   display=True, colorbar=True, title=None,
-                   figsize=(9, 9), dpi=200, velocity=False):
+                   delmax=None, cutmid=0, startbin=0, display=True,
+                   colorbar=True, title=None, figsize=(9, 9),
+                   subtract_artefacts=False, overplot_curvature=None,
+                   dpi=200, velocity=False, vmin=None, vmax=None):
         from . import plotting
-        return plotting.plot_sspec(self, lamsteps=lamsteps,
-                                   input_sspec=input_sspec,
-                                   filename=filename, input_x=input_x,
-                                   input_y=input_y, trap=trap,
-                                   plotarc=plotarc, maxfdop=maxfdop,
-                                   delmax=delmax, cutmid=cutmid,
-                                   startbin=startbin, display=display,
-                                   colorbar=colorbar, title=title,
-                                   figsize=figsize, dpi=dpi,
-                                   velocity=velocity)
+        return plotting.plot_sspec(
+            self, lamsteps=lamsteps, input_sspec=input_sspec,
+            filename=filename, input_x=input_x, input_y=input_y,
+            trap=trap, prewhite=prewhite, plotarc=plotarc,
+            maxfdop=maxfdop, delmax=delmax, cutmid=cutmid,
+            startbin=startbin, display=display, colorbar=colorbar,
+            title=title, figsize=figsize,
+            subtract_artefacts=subtract_artefacts,
+            overplot_curvature=overplot_curvature, dpi=dpi,
+            velocity=velocity, vmin=vmin, vmax=vmax)
 
     def plot_scattered_image(self, input_scattered_image=None,
                              input_fdop=None, display=True, s=None,
                              veff=None, d=None, use_angle=False,
                              use_spatial=False, plot_log=True,
+                             colorbar=True, title=None,
                              filename=None, figsize=(9, 9), dpi=200):
         from . import plotting
         return plotting.plot_scattered_image(
             self, input_scattered_image=input_scattered_image,
             input_fdop=input_fdop, display=display, plot_log=plot_log,
+            colorbar=colorbar, title=title, use_angle=use_angle,
+            use_spatial=use_spatial, s=s, veff=veff, d=d,
             filename=filename, figsize=figsize, dpi=dpi)
 
     def plot_all(self, dyn=1, sspec=3, acf=2, norm_sspec=4, colorbar=True,
                  lamsteps=False, filename=None, display=True,
                  figsize=(9, 9), dpi=200):
         from . import plotting
-        return plotting.plot_all(self, lamsteps=lamsteps,
+        return plotting.plot_all(self, dyn=dyn, sspec=sspec, acf=acf,
+                                 norm_sspec=norm_sspec,
+                                 colorbar=colorbar, lamsteps=lamsteps,
                                  filename=filename, display=display,
                                  figsize=figsize, dpi=dpi)
 

@@ -153,7 +153,14 @@ def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
 
     run = make_ensemble_sampler(logp, nwalkers, ndim)
     key = jax.random.PRNGKey(0 if seed is None else seed)
+    if progress:
+        # the whole chain is ONE device program — no per-step python
+        # callbacks exist to hook a live progress bar into
+        print(f"ensemble: {nwalkers} walkers x {steps} steps "
+              f"(single jitted scan)...")
     chain, logps, acc_frac = run(key, jnp.asarray(pos), steps)
+    if progress:
+        print("ensemble: done")
     chain = np.asarray(chain)                     # (steps, nw, ndim)
 
     nburn = int(burn * steps) if burn < 1 else int(burn)

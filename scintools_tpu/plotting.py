@@ -244,7 +244,7 @@ def plot_cut_tiles(ds, lamsteps=False, maxfdop=np.inf, filename=None,
                 shading="auto")),
             ("sspec", lambda ii, jj: _tile_sspec(
                 plt, ds.cutsspec[ii, jj], ds.cut_sspec_x,
-                ds.cut_sspec_y, maxfdop))):
+                ds.cut_sspec_y, maxfdop, lamsteps))):
         fig = plt.figure(figsize=figsize)
         plotnum = 1
         for ii in range(nfc):
@@ -258,7 +258,7 @@ def plot_cut_tiles(ds, lamsteps=False, maxfdop=np.inf, filename=None,
     return figs
 
 
-def _tile_sspec(plt, sspec, x, y, maxfdop):
+def _tile_sspec(plt, sspec, x, y, maxfdop, lamsteps=False):
     valid = sspec[is_valid(sspec) & (np.abs(sspec) > 0)]
     vmin = np.median(valid) - 3 if valid.size else None
     vmax = np.max(valid) - 3 if valid.size else None
@@ -266,39 +266,87 @@ def _tile_sspec(plt, sspec, x, y, maxfdop):
     plt.pcolormesh(centres_to_edges(x[sel]), centres_to_edges(y),
                    sspec[:, sel], vmin=vmin, vmax=vmax, linewidth=0,
                    rasterized=True, shading="auto")
+    plt.ylabel(r"$f_\lambda$ (m$^{-1}$)" if lamsteps
+               else r"$f_\nu$ ($\mu$s)")
 
 
 def plot_sspec(ds, lamsteps=False, input_sspec=None, filename=None,
-               input_x=None, input_y=None, trap=False, plotarc=False,
-               maxfdop=np.inf, delmax=None, cutmid=0, startbin=0,
-               display=True, colorbar=True, title=None, figsize=(9, 9),
-               dpi=200, velocity=False):
-    """Secondary spectrum (dynspec.py:693-853 core)."""
+               input_x=None, input_y=None, trap=False, prewhite=False,
+               plotarc=False, maxfdop=np.inf, delmax=None, cutmid=0,
+               startbin=0, display=True, colorbar=True, title=None,
+               figsize=(9, 9), subtract_artefacts=False,
+               overplot_curvature=None, dpi=200, velocity=False,
+               vmin=None, vmax=None):
+    """Secondary spectrum (dynspec.py:693-853): every reference kwarg
+    is honoured — prewhitened recompute, constant-delay artefact
+    subtraction, central-Doppler ``cutmid`` / low-delay ``startbin``
+    masking, ``delmax`` crop, explicit colour limits, and arc
+    overlays (fitted via ``plotarc`` or explicit curvature via
+    ``overplot_curvature``)."""
     plt = _mpl()
     if input_sspec is None:
+        if prewhite:
+            # reference semantics (dynspec.py:756-772): prewhite only
+            # affects a FRESH computation — an existing stored sspec
+            # is plotted as-is, never overwritten
+            attr = ("vlamsspec" if lamsteps and velocity else
+                    "lamsspec" if lamsteps else
+                    "vsspec" if velocity else
+                    "trapsspec" if trap else "sspec")
+            if not hasattr(ds, attr):
+                ds.calc_sspec(lamsteps=lamsteps, trap=trap,
+                              velocity=velocity, prewhite=True)
         sspec, yaxis = ds._select_sspec(lamsteps=lamsteps, trap=trap,
                                         velocity=velocity)
-        xaxis = ds.fdop
+        xaxis = np.asarray(ds.fdop)
     else:
         sspec = input_sspec
-        xaxis = input_x
-        yaxis = input_y
-    sspec = np.asarray(sspec)
-    fig = plt.figure(figsize=figsize)
+        xaxis = np.asarray(input_x)
+        yaxis = np.asarray(input_y)
+    sspec = np.array(sspec, dtype=float)
+
+    if subtract_artefacts:
+        # constant-in-Doppler delay response from the outer 10%
+        # (dynspec.py:780-787)
+        outer = np.abs(xaxis) > 0.9 * np.max(np.abs(xaxis))
+        delay_response = np.nanmean(sspec[:, outer], axis=1)
+        delay_response = delay_response - np.median(delay_response)
+        sspec = sspec - delay_response[:, None]
+
     valid = sspec[is_valid(sspec) & (np.abs(sspec) > 0)]
-    vmin = np.median(valid) - 3 if valid.size else None
-    vmax = np.max(valid) - 3 if valid.size else None
+    if valid.size:
+        vmin = np.median(valid) - 3 if vmin is None else vmin
+        vmax = np.max(valid) - 3 if vmax is None else vmax
+
     sel = np.abs(xaxis) <= maxfdop
-    plt.pcolormesh(centres_to_edges(xaxis[sel]),
-                   centres_to_edges(yaxis[startbin:]),
-                   sspec[startbin:, sel], vmin=vmin, vmax=vmax,
-                   linewidth=0, rasterized=True, shading="auto")
+    xplot = xaxis[sel]
+    sspec = sspec[:, sel]
+    nc = sspec.shape[1]
+    if cutmid:
+        sspec[:, int(nc / 2 - np.floor(cutmid / 2)):
+              int(nc / 2 + np.ceil(cutmid / 2))] = np.nan
+    if startbin:
+        sspec[:startbin, :] = np.nan
+    if delmax is None:
+        ind = len(yaxis)
+    else:
+        # delmax is defined on the tdel axis (µs) like the reference
+        tdel = np.asarray(getattr(ds, "tdel", yaxis))
+        ind = max(int(np.argmin(np.abs(tdel[:len(yaxis)] - delmax))),
+                  1)
+
+    fig = plt.figure(figsize=figsize)
+    plt.pcolormesh(centres_to_edges(xplot),
+                   centres_to_edges(yaxis[:ind]), sspec[:ind, :],
+                   vmin=vmin, vmax=vmax, linewidth=0, rasterized=True,
+                   shading="auto")
+    bottom, top = plt.ylim()
+    if overplot_curvature is not None:
+        plt.plot(xplot, overplot_curvature * xplot ** 2, "r--")
     if plotarc:
         eta = ds.betaeta if lamsteps else ds.eta
-        x = np.linspace(max(-maxfdop, np.min(xaxis)),
-                        min(maxfdop, np.max(xaxis)), 200)
-        plt.plot(x, eta * x ** 2, "r--", alpha=0.7)
-        plt.ylim(yaxis[startbin], np.max(yaxis))
+        plt.plot(xplot, eta * xplot ** 2, "r--", alpha=0.5)
+    plt.ylim(bottom, top)
     plt.xlabel(r"$f_t$ (mHz)")
     plt.ylabel(r"$f_\lambda$ (m$^{-1}$)" if lamsteps
                else r"$f_\nu$ ($\mu$s)")
@@ -310,10 +358,12 @@ def plot_sspec(ds, lamsteps=False, input_sspec=None, filename=None,
 
 
 def plot_arc_fit(fit, lamsteps=False, filename=None, display=True,
-                 figsize=(9, 9), dpi=200):
-    """Curvature-fit diagnostic (dynspec.py:1315-1346)."""
+                 figsize=(9, 9), dpi=200, figN=None):
+    """Curvature-fit diagnostic (dynspec.py:1315-1346). ``figN``
+    selects an existing figure number (dynspec.py:1316-1319)."""
     plt = _mpl()
-    fig = plt.figure(figsize=figsize)
+    fig = (plt.figure(figsize=figsize) if figN is None
+           else plt.figure(figN, figsize=figsize))
     plt.plot(fit.eta_array[10:], fit.profile[10:])
     if fit.xdata is not None:
         plt.plot(fit.xdata, fit.yfit, "k")
@@ -376,44 +426,217 @@ def plot_norm_sspec(ds, scrunched=True, unscrunched=True, powerspec=True,
 
 
 def plot_scattered_image(ds, input_scattered_image=None, input_fdop=None,
-                         display=True, plot_log=True, filename=None,
+                         display=True, plot_log=True, colorbar=True,
+                         title=None, use_angle=False, use_spatial=False,
+                         s=None, veff=None, d=None, filename=None,
                          figsize=(9, 9), dpi=200):
-    """Scattered image (dynspec.py:855-968 core)."""
+    """Scattered image (dynspec.py:855-968): optional on-sky angle
+    (arcsec, needs fractional screen distance ``s`` and effective
+    velocity ``veff`` km/s) or spatial (AU, additionally distance
+    ``d`` kpc) axes — dynspec.py:916-928."""
     plt = _mpl()
-    im = (input_scattered_image if input_scattered_image is not None
-          else ds.scattered_image)
-    ax = input_fdop if input_fdop is not None else ds.scattered_image_ax
+    c = 299792458.0
+    im = np.array(input_scattered_image
+                  if input_scattered_image is not None
+                  else ds.scattered_image, dtype=float)
+    xyaxes = np.asarray(input_fdop if input_fdop is not None
+                        else ds.scattered_image_ax, dtype=float)
+    if use_angle or use_spatial:
+        if s is None or veff is None:
+            raise ValueError("use_angle/use_spatial need s and veff")
+        thetarad = (xyaxes / (1e9 * ds.freq)) * (c * s / (veff * 1000))
+        thetaas = (thetarad * 180 / np.pi) * 3600
+        if use_angle:
+            xyaxes = thetaas
+        else:
+            if d is None:
+                raise ValueError("use_spatial needs the distance d")
+            xyaxes = thetaas * (1 - s) * d * 1000
+
+    if plot_log:
+        im = im - np.min(im)
+        im = im + 1e-10
+        im = 10 * np.log10(im)
+    valid = im[is_valid(im) & (np.abs(im) > 0)]
+    vmin = np.median(valid) - 3 if valid.size else None
+    vmax = np.max(valid) - 3 if valid.size else None
+
     fig = plt.figure(figsize=figsize)
-    data = 10 * np.log10(np.abs(im) + 1e-30) if plot_log else im
-    plt.pcolormesh(centres_to_edges(ax), centres_to_edges(ax), data,
-                   linewidth=0, rasterized=True, shading="auto")
-    plt.xlabel(r"$f_t$ (mHz)")
-    plt.ylabel(r"$f_t$ (mHz)")
-    plt.colorbar()
+    plt.pcolormesh(centres_to_edges(xyaxes), centres_to_edges(xyaxes),
+                   im, vmin=vmin, vmax=vmax, linewidth=0,
+                   rasterized=True, shading="auto")
+    if use_angle:
+        plt.xlabel("Angle parallel to velocity (as)")
+        plt.ylabel("Angle perpendicular to velocity (as)")
+    elif use_spatial:
+        plt.xlabel("Distance parallel to velocity (AU)")
+        plt.ylabel("Distance perpendicular to velocity (AU)")
+    else:
+        plt.xlabel("Angle parallel to velocity")
+        plt.ylabel("Angle perpendicular to velocity")
+    plt.title(title if title else "Scattered image")
+    if colorbar:
+        plt.colorbar()
     return _finish(plt, fig, filename, display, dpi)
 
 
-def plot_all(ds, lamsteps=False, filename=None, display=True,
-             figsize=(9, 9), dpi=200):
-    """Composite 2×2 summary (dynspec.py role of plot_all)."""
+def plot_eta_evolution(ds, time_avg=False, filename=None, display=True,
+                       figsize=(9, 9), dpi=200):
+    """η(f) per-chunk datapoints + the fitted η ∝ f⁻² curve after
+    ``fit_thetatheta`` (dynspec.py:1746-1764)."""
+    from .thth.retrieval import err_string
+
     plt = _mpl()
-    fig, axes = plt.subplots(2, 2, figsize=figsize)
-    plt.sca(axes[0, 0])
-    plt.pcolormesh(centres_to_edges(ds.times / 60),
-                   centres_to_edges(ds.freqs), ds.dyn, shading="auto")
-    plt.title("Dynamic spectrum")
+    fig = plt.figure(figsize=figsize)
+    label = err_string(ds.ththeta * ds.fref ** 2,
+                       ds.ththetaerr * ds.fref ** 2)
+    if time_avg:
+        eta_avg = np.nanmean(ds.eta_evo, 1)
+        avg_err = (np.nanstd(ds.eta_evo, 1)
+                   / np.sqrt(max(ds.eta_evo.shape[1] - 1, 1)))
+        plt.errorbar(ds.f0s, eta_avg, yerr=avg_err, fmt=".")
+    else:
+        plt.errorbar(
+            np.ravel(ds.f0s[:, None] * np.ones(ds.eta_evo.shape)),
+            np.ravel(ds.eta_evo), yerr=np.ravel(ds.eta_evo_err),
+            fmt=".")
+    A = ds.ththeta * ds.fref ** 2
+    plt.plot(ds.f0s, A / ds.f0s ** 2,
+             label=rf"$\eta$ = {label} $s^3$")
+    plt.xlabel(r"$\rm{Freq}~\left(\rm{MHz}\right)$")
+    plt.ylabel(r"$\eta~\left(\rm{s}^3\right)$")
+    plt.legend()
+    return _finish(plt, fig, filename, display, dpi)
+
+
+def plot_scint_fit_1d(ds, results, xdata_t, ydata_t, t_errors,
+                      xdata_f, ydata_f, f_errors, filename=None,
+                      display=True, dpi=200):
+    """acf1d fit diagnostic: data ± error with the fitted model and
+    the ±1/√n white-noise bands (dynspec.py:3051-3109)."""
+    from .fit import models as mdl
+
+    plt = _mpl()
+    fig, axes = plt.subplots(2, 1, figsize=(8, 6))
+    panels = [
+        (xdata_t, ydata_t, t_errors, mdl.tau_acf_model,
+         ds.nsub, r"$\tau$ (s)", r"$\pm 1/\sqrt{n_\mathrm{sub}}$"),
+        (xdata_f, ydata_f, f_errors, mdl.dnu_acf_model,
+         ds.nchan, r"$\Delta\nu$ (MHz)",
+         r"$\pm 1/\sqrt{n_\mathrm{chan}}$"),
+    ]
+    for ax, (x, y, err, model, n, xlabel, wnlabel) in zip(axes,
+                                                          panels):
+        xm = np.linspace(min(x), max(x), 1000)
+        ym = -np.asarray(model(results.params, xm, np.zeros(len(xm)),
+                               None))
+        ax.plot(x, y, label="data")
+        ax.fill_between(x, y + err, y - err, color="C0", alpha=0.4,
+                        label="error")
+        ax.plot(xm, ym, label="model")
+        xl = ax.get_xlim()
+        ax.plot([0, xl[1]], [0, 0], "k--")
+        wn = 1 / np.sqrt(n)
+        ax.plot([0, xl[1]], [wn, wn], ":", color="crimson",
+                label=wnlabel)
+        ax.plot([0, xl[1]], [-wn, -wn], ":", color="crimson")
+        ax.set_xlabel(xlabel)
+        ax.legend()
+    fig.tight_layout()
+    return _finish(plt, fig,
+                   filename and _split_filename(filename, "1Dfit"),
+                   display, dpi)
+
+
+def plot_scint_fit_2d(ds, results, method, tdata, fdata, ydata_2d,
+                      filename=None, display=True, dpi=200):
+    """acf2d fit diagnostic: data / model / residual panels with the
+    white-noise spike subtracted (dynspec.py:3111-3155)."""
+    from .fit import models as mdl
+
+    plt = _mpl()
+    zeros = np.zeros(np.shape(ydata_2d))
+    if method == "acf2d_approx":
+        model = -np.asarray(mdl.scint_acf_model_2d_approx(
+            results.params, tdata, fdata, zeros, None))
+    else:
+        model = -np.asarray(mdl.scint_acf_model_2d(results.params,
+                                                   zeros, None))
+    residuals = ydata_2d - model
+    fig, axes = plt.subplots(1, 3, sharey=True, figsize=(15, 5))
+    for i, (arr, name) in enumerate([(ydata_2d, "data"),
+                                     (model, "model"),
+                                     (residuals, "residuals")]):
+        arr = np.array(arr, dtype=float)
+        if name != "residuals":
+            arr = np.fft.ifftshift(arr)
+            arr[0][0] -= ds.wn
+            arr = np.fft.fftshift(arr)
+        mesh = axes[i].pcolormesh(centres_to_edges(tdata / 60),
+                                  centres_to_edges(fdata), arr,
+                                  linewidth=0, rasterized=True,
+                                  shading="auto")
+        if name == "residuals":
+            mesh.set_clim(vmin=-1, vmax=1)
+        axes[i].set_title(name)
+        axes[i].set_xlabel(r"$\tau$ (mins)")
+        if i == 0:
+            axes[i].set_ylabel(r"$\Delta\nu$ (MHz)")
+    fig.tight_layout()
+    return _finish(plt, fig,
+                   filename and _split_filename(filename, "2Dfit"),
+                   display, dpi)
+
+
+def plot_all(ds, dyn=1, sspec=3, acf=2, norm_sspec=4, colorbar=True,
+             lamsteps=False, filename=None, display=True,
+             figsize=(9, 9), dpi=200):
+    """Composite summary (dynspec.py plot_all role). The reference
+    renders four NUMBERED figures (``dyn``/``sspec``/``acf``/
+    ``norm_sspec`` are figure numbers); here the same integers pick
+    the subplot ordering of one composite figure — pass 0 to omit a
+    panel."""
+    plt = _mpl()
     if not hasattr(ds, "acf"):
         ds.calc_acf()
-    plt.sca(axes[0, 1])
-    plt.pcolormesh(ds.acf, shading="auto")
-    plt.title("ACF")
-    sspec, yaxis = ds._select_sspec(lamsteps=lamsteps)
-    plt.sca(axes[1, 0])
-    valid = sspec[is_valid(sspec) & (np.abs(sspec) > 0)]
-    plt.pcolormesh(centres_to_edges(ds.fdop), centres_to_edges(yaxis),
-                   sspec, vmin=np.median(valid) - 3,
-                   vmax=np.max(valid) - 3, shading="auto")
-    plt.title("Secondary spectrum")
-    axes[1, 1].axis("off")
+    sec, yaxis = ds._select_sspec(lamsteps=lamsteps)
+    valid = sec[is_valid(sec) & (np.abs(sec) > 0)]
+
+    def draw_dyn():
+        plt.pcolormesh(centres_to_edges(ds.times / 60),
+                       centres_to_edges(ds.freqs), ds.dyn,
+                       shading="auto")
+        plt.title("Dynamic spectrum")
+
+    def draw_acf():
+        plt.pcolormesh(ds.acf, shading="auto")
+        plt.title("ACF")
+
+    def draw_sspec():
+        plt.pcolormesh(centres_to_edges(ds.fdop),
+                       centres_to_edges(yaxis), sec,
+                       vmin=np.median(valid) - 3,
+                       vmax=np.max(valid) - 3, shading="auto")
+        if colorbar:
+            plt.colorbar()
+        plt.title("Secondary spectrum")
+
+    def draw_norm():
+        if hasattr(ds, "normsspecavg"):
+            plt.plot(ds.normsspec_fdop, ds.normsspecavg)
+            plt.title("Normalised sspec")
+        else:
+            plt.gca().axis("off")
+
+    panels = sorted([(dyn, draw_dyn), (acf, draw_acf),
+                     (sspec, draw_sspec), (norm_sspec, draw_norm)],
+                    key=lambda p: p[0])
+    panels = [p for p in panels if p[0]]
+    fig, axes = plt.subplots(2, 2, figsize=figsize)
+    for ax, (_, draw) in zip(axes.ravel(), panels):
+        plt.sca(ax)
+        draw()
+    for ax in axes.ravel()[len(panels):]:
+        ax.axis("off")
     plt.tight_layout()
     return _finish(plt, fig, filename, display, dpi)

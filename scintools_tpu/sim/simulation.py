@@ -196,13 +196,22 @@ class Simulation:
         self.dlam = dlam
         self.lamsteps = lamsteps
         self.seed = seed
+        self.noise = noise  # accepted-and-unused upstream too
         self.backend = resolve_backend(backend)
 
         self.set_constants()
+        if verbose:
+            print("Computing screen phase")
         self.get_screen()
+        if verbose:
+            print("Getting intensity...")
         self.get_intensity()
         if nf > 1:
+            if verbose:
+                print("Computing dynamic spectrum")
             self.get_dynspec()
+        if verbose:
+            print("Getting impulse response...")
         self.get_pulse()
 
         # physical-units packaging (scint_sim.py:81-134)

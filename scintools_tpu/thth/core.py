@@ -413,7 +413,9 @@ def chisq_calc(dspec, CS, tau, fd, eta, edges, N, mask=None,
 
 def two_curve_map(CS, tau, fd, eta1, edges1, eta2, edges2, backend=None):
     """θ-θ with distinct main-arc and arclet curvatures
-    (ththmod.py:1557-1636)."""
+    (ththmod.py:1557-1636). Host/numpy implementation (uniform
+    ``backend`` signature; the batched jax path is
+    thth/batch.py:make_thin_eval_fn)."""
     tau = np.asarray(unit_checks(tau, "tau"), dtype=float)
     fd = np.asarray(unit_checks(fd, "fd"), dtype=float)
     eta1 = float(unit_checks(eta1, "eta1"))

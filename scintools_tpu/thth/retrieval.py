@@ -65,6 +65,9 @@ def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
     time = np.asarray(unit_checks(time, "time"), dtype=float)
     freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
     eta = float(unit_checks(eta, "eta"))
+    if verbose:
+        print(f"vlbi_chunk_retrieval: chunk ({idx_f},{idx_t}) "
+              f"n_dish={n_dish} eta={eta:.4g}")
 
     from .core import fft_axis
     fd = fft_axis(time, pad=npad, scale=1e3)
@@ -414,6 +417,8 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
     mode='rot': maximise Σ|E|² over per-chunk phases (rotFit,
     ththmod.py:1773-1788). mode='full': fit phases+amplitudes against
     the observed dynamic spectrum (fullMosFit, ththmod.py:1990-2016).
+    ``backend`` is accepted for the uniform kernel signature; the
+    objective always runs through jax (autodiff is the point).
     The reference's 400 lines of hand-derived gradient/Hessian
     (rotDer/fullMosGrad/fullMosHess) are replaced by jax.grad.
     ``x0`` overrides the greedy initial per-chunk phases

@@ -142,6 +142,10 @@ def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
         etas, eigs, fw=fw, full=True)
     freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
     time = np.asarray(unit_checks(time, "time"), dtype=float)
+    if verbose:  # per-chunk result print (ththmod.py:705-711 role)
+        print(f"single_search: f={freq.mean():.1f} MHz "
+              f"t={time.mean():.0f} s → eta={eta_fit:.4g} "
+              f"+/- {eta_sig:.2g}")
     return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
                              freq_mean=float(freq.mean()),
                              time_mean=float(time.mean()),
@@ -240,10 +244,14 @@ def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
     iteration, thth/batch.py:make_thin_eval_fn); the numpy path keeps
     the reference's per-η SVD loop.
     """
-    return multi_chunk_search_thin(
+    res = multi_chunk_search_thin(
         [dspec], freq, [time], etas, edges, edgesArclet, centerCut,
         fw=fw, npad=npad, coher=coher, tau_mask=tau_mask,
         backend=backend)[0]
+    if verbose:
+        print(f"single_search_thin: f={res.freq_mean:.1f} MHz → "
+              f"eta={res.eta:.4g} +/- {res.eta_sig:.2g}")
+    return res
 
 
 def multi_chunk_search_thin(dspecs, freq, times, etas, edges,

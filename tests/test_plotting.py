@@ -73,3 +73,73 @@ class TestCutDynPlot:
             f = tmp_path / f"cuts_{tag}.png"
             assert f.exists() and f.stat().st_size > 0, tag
         assert dyn.cutdyn.shape[:2] == (2, 2)
+
+
+class TestPlotSspecKwargs:
+    def test_all_kwargs_do_something(self, dyn, tmp_path):
+        """cutmid / startbin / delmax / vmin / vmax /
+        subtract_artefacts / overplot_curvature are all honoured
+        (dynspec.py:693-853)."""
+        dyn.calc_sspec()
+        out = tmp_path / "ss.png"
+        fig = dyn.plot_sspec(cutmid=4, startbin=2,
+                             delmax=float(dyn.tdel[len(dyn.tdel) // 2]),
+                             vmin=-5.0, vmax=40.0,
+                             subtract_artefacts=True,
+                             overplot_curvature=0.1,
+                             filename=str(out), display=False)
+        assert out.exists() and out.stat().st_size > 0
+        # delmax crops the delay axis: the top plotted y must sit at
+        # ~half the full tdel range
+        ymax = fig.axes[0].get_ylim()[1]
+        assert ymax < 0.7 * float(dyn.tdel.max())
+
+
+class TestScintFitPlots:
+    def test_acf1d_fit_plot(self, dyn, tmp_path):
+        out = tmp_path / "fit.png"
+        dyn.get_scint_params(method="acf1d", plot=True,
+                             filename=str(out), display=False)
+        assert (tmp_path / "fit_1Dfit.png").exists()
+
+    def test_acf2d_approx_fit_plot(self, dyn, tmp_path):
+        out = tmp_path / "fit2.png"
+        dyn.get_scint_params(method="acf2d_approx", plot=True,
+                             filename=str(out), display=False)
+        assert (tmp_path / "fit2_2Dfit.png").exists()
+
+
+class TestScatteredImageAxes:
+    def test_use_angle_and_spatial(self, dyn, tmp_path):
+        dyn.calc_scattered_image(sampling=16)
+        f1 = tmp_path / "ang.png"
+        dyn.plot_scattered_image(use_angle=True, s=0.7, veff=30.0,
+                                 filename=str(f1), display=False)
+        f2 = tmp_path / "spat.png"
+        dyn.plot_scattered_image(use_spatial=True, s=0.7, veff=30.0,
+                                 d=1.0, filename=str(f2),
+                                 display=False)
+        assert f1.exists() and f2.exists()
+        with pytest.raises(ValueError):
+            dyn.plot_scattered_image(use_angle=True, display=False)
+
+
+class TestPoolParity:
+    def test_fit_thetatheta_and_asymmetry_pool(self, dyn, tmp_path):
+        """The numpy backend honours a user-supplied pool for the
+        chunk fan-outs (reference dynspec.py:1715-1826)."""
+        from multiprocessing.dummy import Pool  # threads: cheap, picklable-free
+
+        dyn.prep_thetatheta(cwf=32, cwt=32, npad=1, eta_min=1e-3,
+                            eta_max=1.0, neta=6, nedge=12)
+        with Pool(2) as pool:
+            dyn.fit_thetatheta(pool=pool)
+            eta_evo_pool = np.array(dyn.eta_evo)
+            asym = dyn.calc_asymmetry(pool=pool)
+        assert eta_evo_pool.shape == (2, 2)
+        assert asym is not None and np.shape(asym) == (2, 2)
+        fig_out = tmp_path / "eta_evo.png"
+        from scintools_tpu import plotting
+        plotting.plot_eta_evolution(dyn, filename=str(fig_out),
+                                    display=False)
+        assert fig_out.exists()
