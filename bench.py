@@ -240,13 +240,19 @@ def bench_north_star(jax, jnp):
     from scintools_tpu.thth.batch import make_multi_eval_fn
     from scintools_tpu.thth.search import fit_eig_peak
 
-    nf = nt = 4096
+    # full north-star size on an accelerator; the CPU fallback (dead
+    # tunnel) measures a quarter-scale version of the SAME pipeline so
+    # the run still finishes inside the watchdog — the measured size
+    # is recorded in the output
+    full = jax.default_backend() != "cpu"
+    nf = nt = 4096 if full else 2048
     dt, df, f0 = 2.0, 0.05, 1400.0
     eta_true = 5e-4                             # us/mHz²
     cf = ct = 512
-    ncf, nct = nf // cf, nt // ct               # 8×8 = 64 chunks
+    ncf, nct = nf // cf, nt // ct               # 8×8 = 64 chunks full
     npad = 1
-    group = int(os.environ.get("SCINTOOLS_BENCH_NS_GROUP", 8))
+    group = int(os.environ.get("SCINTOOLS_BENCH_NS_GROUP",
+                               8 if full else 4))
     if (ncf * nct) % group:
         raise ValueError(f"SCINTOOLS_BENCH_NS_GROUP={group} must "
                          f"divide the chunk count {ncf * nct}")
@@ -590,23 +596,51 @@ def main():
     platform = jax.default_backend()
     configs = {}
     t0 = time.time()
+
+    # Watchdog: a tunneled TPU can hang mid-transfer AFTER a healthy
+    # probe (observed: a device_put stalled >8 min with zero CPU). A
+    # partial-result JSON line beats an eternal hang for the driver.
+    # It must be a THREAD: a SIGALRM python handler never runs while
+    # the main thread is blocked inside a native XLA call — which is
+    # precisely the hang being guarded against.
+    def _emit(head_key="north_star"):
+        head = configs.get(head_key) or {}
+        size = head.get("size", "4096x4096")
+        print(json.dumps({
+            "metric": f"north-star {size} sspec+thth curvature "
+                      "search",
+            "value": head.get("pixels_per_sec", 0),
+            "unit": "dynspec pixels/sec",
+            "vs_baseline": head.get("speedup", 0),
+            "platform": platform,
+            "probe": probe,
+            "configs": configs,
+            "total_bench_s": round(time.time() - t0, 1),
+        }))
+        sys.stdout.flush()
+
+    import threading
+
+    def _watchdog():
+        configs["error"] = ("watchdog timeout — accelerator hung "
+                            "mid-benchmark; results are partial")
+        print("WARNING: bench watchdog fired", file=sys.stderr)
+        _emit()
+        os._exit(3)
+
+    timer = threading.Timer(
+        int(os.environ.get("SCINTOOLS_BENCH_WATCHDOG", "1800")),
+        _watchdog)
+    timer.daemon = True
+    timer.start()
+
     configs["north_star"] = bench_north_star(jax, jnp)
     configs["sspec_thth"] = bench_sspec_thth(jax, jnp)
     configs["acf_fit"] = bench_acf_fit(jax, jnp)
     configs["sim_batch"] = bench_sim_batch(jax, jnp)
     configs["survey"] = bench_survey(jax, jnp)
-
-    head = configs["north_star"]
-    print(json.dumps({
-        "metric": "north-star 4096x4096 sspec+thth curvature search",
-        "value": head["pixels_per_sec"],
-        "unit": "dynspec pixels/sec",
-        "vs_baseline": head["speedup"],
-        "platform": platform,
-        "probe": probe,
-        "configs": configs,
-        "total_bench_s": round(time.time() - t0, 1),
-    }))
+    timer.cancel()
+    _emit()
 
 
 if __name__ == "__main__":

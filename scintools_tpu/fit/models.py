@@ -22,16 +22,23 @@ def _vals(params):
 # 1-D / 2-D ACF models (scint_models.py:62-215)
 # --------------------------------------------------------------------------
 
+def tau_acf_model_values(params, xdata, backend=None):
+    """Raw amp·exp(−(t/τ)^α) × triangle model curve (no weighting —
+    used by the fit-diagnostic plots)."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    model = p["amp"] * xp.exp(-(xdata / p["tau"]) ** p["alpha"])
+    return model * (1 - xdata / xp.max(xdata))
+
+
 def tau_acf_model(params, xdata, ydata, weights, backend=None):
     """amp·exp(−(t/τ)^α) × triangle taper; lag-0 weight zeroed
     (scint_models.py:62-85)."""
     xp = get_xp(resolve_backend(backend))
-    p = _vals(params)
     if weights is None:
         weights = xp.ones(xp.shape(ydata))
     weights = xp.asarray(weights)
-    model = p["amp"] * xp.exp(-(xdata / p["tau"]) ** p["alpha"])
-    model = model * (1 - xdata / xp.max(xdata))
+    model = tau_acf_model_values(params, xdata, backend)
     weights = weights.at[0].set(0) if hasattr(weights, "at") else _set0(weights)
     return (ydata - model) * weights
 
@@ -42,15 +49,21 @@ def _set0(w):
     return w
 
 
+def dnu_acf_model_values(params, xdata, backend=None):
+    """Raw amp·exp(−f/(Δν/ln2)) × triangle model curve."""
+    xp = get_xp(resolve_backend(backend))
+    p = _vals(params)
+    model = p["amp"] * xp.exp(-xdata / (p["dnu"] / np.log(2)))
+    return model * (1 - xdata / xp.max(xdata))
+
+
 def dnu_acf_model(params, xdata, ydata, weights, backend=None):
     """amp·exp(−f/(Δν/ln2)) × triangle taper (scint_models.py:88-109)."""
     xp = get_xp(resolve_backend(backend))
-    p = _vals(params)
     if weights is None:
         weights = xp.ones(xp.shape(ydata))
     weights = xp.asarray(weights)
-    model = p["amp"] * xp.exp(-xdata / (p["dnu"] / np.log(2)))
-    model = model * (1 - xdata / xp.max(xdata))
+    model = dnu_acf_model_values(params, xdata, backend)
     weights = weights.at[0].set(0) if hasattr(weights, "at") else _set0(weights)
     return (ydata - model) * weights
 
@@ -66,10 +79,9 @@ def scint_acf_model(params, xdata, ydata, weights, backend=None):
     return xp.concatenate((rt, rf))
 
 
-def scint_acf_model_2d_approx(params, tdata, fdata, ydata, weights,
-                              backend=None):
-    """Approximate analytic 2-D ACF with phase-gradient shear
-    (scint_models.py:123-161)."""
+def scint_acf_model_2d_approx_values(params, tdata, fdata,
+                                     backend=None):
+    """Raw approximate 2-D ACF model surface (nf, nt) — no weighting."""
     xp = get_xp(resolve_backend(backend))
     p = _vals(params)
     amp, dnu, tau, alpha = p["amp"], p["dnu"], p["tau"], p["alpha"]
@@ -78,18 +90,26 @@ def scint_acf_model_2d_approx(params, tdata, fdata, ydata, weights,
     nt, nf = len(tdata), len(fdata)
     tdata = xp.reshape(xp.asarray(tdata), (nt, 1))
     fdata = xp.reshape(xp.asarray(fdata), (1, nf))
-    if weights is None:
-        weights = np.ones(np.shape(ydata))
-
     model = amp * xp.exp(
         -(xp.abs((tdata - mu * fdata) / tau) ** (3 * alpha / 2)
           + xp.abs(fdata / (dnu / np.log(2))) ** (3 / 2)) ** (2 / 3))
     model = model * (1 - xp.abs(tdata) / tobs)
     model = model * (1 - xp.abs(fdata) / bw)
+    return xp.transpose(model)
+
+
+def scint_acf_model_2d_approx(params, tdata, fdata, ydata, weights,
+                              backend=None):
+    """Approximate analytic 2-D ACF with phase-gradient shear
+    (scint_models.py:123-161)."""
+    xp = get_xp(resolve_backend(backend))
+    if weights is None:
+        weights = np.ones(np.shape(ydata))
+    model = scint_acf_model_2d_approx_values(params, tdata, fdata,
+                                             backend)
     weights = np.fft.fftshift(np.asarray(weights))
     weights[-1, -1] = 0  # white-noise spike not fitted
     weights = np.fft.ifftshift(weights)
-    model = xp.transpose(model)
     return (ydata - model) * xp.asarray(weights)
 
 
@@ -97,6 +117,20 @@ def scint_acf_model_2d(params, ydata, weights, backend=None):
     """Analytic Rickett+14 2-D ACF fit (scint_models.py:164-215): the
     expensive model — each evaluation builds the theoretical ACF via the
     jitted kernel in sim/acf_model.py."""
+    xp = get_xp(resolve_backend(backend))
+    model = scint_acf_model_2d_values(params, np.shape(ydata),
+                                      backend)
+    if weights is None:
+        weights = np.ones(np.shape(ydata))
+    weights = np.fft.fftshift(np.asarray(weights))
+    weights[-1, -1] = 0
+    weights = np.fft.ifftshift(weights)
+    return (ydata - model) * xp.asarray(weights)
+
+
+def scint_acf_model_2d_values(params, shape, backend=None):
+    """Raw analytic 2-D ACF model surface for a (nf_crop, nt_crop)
+    crop — no weighting (used by the fit-diagnostic plots)."""
     from ..sim.acf_model import theoretical_acf
 
     xp = get_xp(resolve_backend(backend))
@@ -104,7 +138,7 @@ def scint_acf_model_2d(params, ydata, weights, backend=None):
     tau, dnu = abs(p["tau"]), abs(p["dnu"])
     tobs, bw = p["tobs"], p["bw"]
     nt, nf = p["nt"], p["nf"]
-    nf_crop, nt_crop = np.shape(ydata)
+    nf_crop, nt_crop = shape
     dt, df = 2 * tobs / nt, 2 * bw / nf
     taumax = nt_crop * dt / tau
     dnumax = nf_crop * df / dnu
@@ -118,14 +152,7 @@ def scint_acf_model_2d(params, ydata, weights, backend=None):
 
     tri_t = 1 - np.abs(np.linspace(-taumax * tau, taumax * tau, nt_crop)) / tobs
     tri_f = 1 - np.abs(np.linspace(-dnumax * dnu, dnumax * dnu, nf_crop)) / bw
-    model = model * xp.asarray(np.outer(tri_f, tri_t))
-
-    if weights is None:
-        weights = np.ones(np.shape(ydata))
-    weights = np.fft.fftshift(np.asarray(weights))
-    weights[-1, -1] = 0
-    weights = np.fft.ifftshift(weights)
-    return (ydata - model) * xp.asarray(weights)
+    return model * xp.asarray(np.outer(tri_f, tri_t))
 
 
 # --------------------------------------------------------------------------

@@ -519,17 +519,16 @@ def plot_scint_fit_1d(ds, results, xdata_t, ydata_t, t_errors,
     plt = _mpl()
     fig, axes = plt.subplots(2, 1, figsize=(8, 6))
     panels = [
-        (xdata_t, ydata_t, t_errors, mdl.tau_acf_model,
+        (xdata_t, ydata_t, t_errors, mdl.tau_acf_model_values,
          ds.nsub, r"$\tau$ (s)", r"$\pm 1/\sqrt{n_\mathrm{sub}}$"),
-        (xdata_f, ydata_f, f_errors, mdl.dnu_acf_model,
+        (xdata_f, ydata_f, f_errors, mdl.dnu_acf_model_values,
          ds.nchan, r"$\Delta\nu$ (MHz)",
          r"$\pm 1/\sqrt{n_\mathrm{chan}}$"),
     ]
     for ax, (x, y, err, model, n, xlabel, wnlabel) in zip(axes,
                                                           panels):
         xm = np.linspace(min(x), max(x), 1000)
-        ym = -np.asarray(model(results.params, xm, np.zeros(len(xm)),
-                               None))
+        ym = np.asarray(model(results.params, xm))
         ax.plot(x, y, label="data")
         ax.fill_between(x, y + err, y - err, color="C0", alpha=0.4,
                         label="error")
@@ -555,13 +554,12 @@ def plot_scint_fit_2d(ds, results, method, tdata, fdata, ydata_2d,
     from .fit import models as mdl
 
     plt = _mpl()
-    zeros = np.zeros(np.shape(ydata_2d))
     if method == "acf2d_approx":
-        model = -np.asarray(mdl.scint_acf_model_2d_approx(
-            results.params, tdata, fdata, zeros, None))
+        model = np.asarray(mdl.scint_acf_model_2d_approx_values(
+            results.params, tdata, fdata))
     else:
-        model = -np.asarray(mdl.scint_acf_model_2d(results.params,
-                                                   zeros, None))
+        model = np.asarray(mdl.scint_acf_model_2d_values(
+            results.params, np.shape(ydata_2d)))
     residuals = ydata_2d - model
     fig, axes = plt.subplots(1, 3, sharey=True, figsize=(15, 5))
     for i, (arr, name) in enumerate([(ydata_2d, "data"),

@@ -1,0 +1,58 @@
+"""utils/profiling.py: Timer sections, timeit_fn, trace context."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.utils.profiling import Timer, timeit_fn, trace
+
+
+class TestTimer:
+    def test_sections_accumulate_and_report(self):
+        tm = Timer(sync=False)
+        with tm("a"):
+            pass
+        with tm("a"):
+            pass
+        with tm("b"):
+            pass
+        assert len(tm.sections["a"]) == 2
+        rep = tm.report()
+        assert "a" in rep and "b" in rep and "calls" in rep
+        assert tm.total("a") >= 0
+
+    def test_sync_blocks_on_boxed_result(self):
+        import jax.numpy as jnp
+
+        tm = Timer()
+        with tm("jit") as box:
+            box.append(jnp.ones((8, 8)).sum())
+        assert tm.total("jit") > 0
+
+    def test_exception_still_records(self):
+        tm = Timer(sync=False)
+        with pytest.raises(ValueError):
+            with tm("boom"):
+                raise ValueError("x")
+        assert "boom" in tm.sections
+
+
+class TestTimeitFn:
+    def test_reports_compile_and_steady(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x * 2).sum())
+        out = timeit_fn(f, jnp.arange(16.0), repeats=2)
+        assert out["best_s"] >= 0 and out["first_call_s"] > 0
+        assert float(out["result"]) == pytest.approx(240.0)
+
+
+class TestTrace:
+    def test_trace_writes_and_propagates_errors(self, tmp_path):
+        import jax.numpy as jnp
+
+        with trace(tmp_path / "t"):
+            jnp.ones(4).sum()
+        with pytest.raises(RuntimeError):
+            with trace(tmp_path / "t2"):
+                raise RuntimeError("inner error must propagate")

@@ -189,3 +189,34 @@ class TestThinEval:
         assert np.isfinite(res_np.eta) and np.isfinite(res_jx.eta)
         assert res_jx.eta == pytest.approx(res_np.eta, rel=0.02)
         assert res_np.eta == pytest.approx(eta_true, rel=0.15)
+
+
+class TestGridEval:
+    def test_matches_per_row_eval(self):
+        """make_grid_eval_fn (traced geometry, mesh-shardable) agrees
+        with make_multi_eval_fn (baked geometry) on a mixed-geometry
+        chunk stack — the fit_thetatheta per-row rescale scenario
+        (dynspec.py:1693-1698)."""
+        import jax.numpy as jnp
+
+        from scintools_tpu.thth.batch import (make_grid_eval_fn,
+                                              make_multi_eval_fn)
+
+        CS_list, tau, fd, etas, edges = _workload(nchunk=4)
+        # two frequency rows with different edge/eta scalings
+        scales = [1.0, 1.0, 1.05, 1.05]
+        edges_b = np.stack([edges * s for s in scales])
+        etas_b = np.stack([etas / s ** 2 for s in scales])
+        cs_b = jnp.asarray(np.stack(
+            [cs_to_ri(c).astype(np.float32) for c in CS_list]))
+
+        grid_fn = make_grid_eval_fn(tau, fd, len(edges), iters=400)
+        out = np.asarray(grid_fn(cs_b, jnp.asarray(edges_b),
+                                 jnp.asarray(etas_b)))
+
+        for b in range(4):
+            row_fn = make_multi_eval_fn(tau, fd, edges_b[b],
+                                        iters=400, method="power")
+            ref = np.asarray(row_fn(cs_b[b:b + 1],
+                                    jnp.asarray(etas_b[b])))[0]
+            np.testing.assert_allclose(out[b], ref, rtol=2e-3)
