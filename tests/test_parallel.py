@@ -152,7 +152,7 @@ def test_dryrun_multichip(n):
 
 
 class TestShardedThthGrid:
-    def test_grid_matches_unsharded(self):
+    def test_grid_matches_unsharded(self, mesh):
         """make_thth_grid_search_sharded over the 8-device mesh equals
         the unsharded grid evaluator (SPMD correctness of the chunk
         fan-out, reference pool.map dynspec.py:1715-1719)."""
@@ -185,7 +185,6 @@ class TestShardedThthGrid:
         edges_b = jnp.asarray(np.tile(edges, (B, 1)))
         etas_b = jnp.asarray(np.tile(etas, (B, 1)))
 
-        mesh = par.make_mesh(jax.device_count())
         sharded = par.make_thth_grid_search_sharded(mesh, tau, fd,
                                                     len(edges),
                                                     iters=300)
