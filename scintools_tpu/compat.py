@@ -42,6 +42,7 @@ from .thth.retrieval import (
     refine_mosaic)
 from .thth.plots import plot_func
 from .utils.misc import svd_model
+from .ops.acf import autocorr_direct as autocorr  # scint_utils.py:67-84
 
 __all__ = [
     "Eval_calc", "VLBI_chunk_retrieval", "errString", "errCalc",
@@ -51,7 +52,7 @@ __all__ = [
     "ext_find", "fft_axis", "unit_checks", "single_search",
     "single_search_thin", "chi_par", "single_chunk_retrieval",
     "mosaic", "mask_func", "gerchberg_saxton", "calc_asymmetry",
-    "plot_func", "svd_model",
+    "plot_func", "svd_model", "autocorr",
 ]
 
 

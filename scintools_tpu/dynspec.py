@@ -1493,26 +1493,32 @@ class Dynspec:
                     self.t0s[ct] = res.time_mean
 
         f0s = self.f0s[:, None]
-        if time_avg:
-            eta_avg = np.nanmean(self.eta_evo, 1)
-            eta_count = np.nansum(self.eta_evo, 1) / eta_avg
-            avg_err = np.nanstd(self.eta_evo, 1) / np.sqrt(eta_count - 1)
-            tofit = np.isfinite(eta_avg) & np.isfinite(avg_err)
-            A = (np.sum(eta_avg[tofit]
-                        / (self.f0s * avg_err)[tofit] ** 2)
-                 / np.sum(1 / (self.f0s ** 2 * avg_err)[tofit] ** 2))
-            A_err = np.sqrt(
-                1 / np.sum(2 / ((self.f0s ** 2) * avg_err)[tofit] ** 2))
-        else:
-            tofit = (np.isfinite(self.eta_evo)
-                     & np.isfinite(self.eta_evo_err))
-            A = (np.sum(self.eta_evo[tofit]
-                        / (f0s * self.eta_evo_err)[tofit] ** 2)
-                 / np.sum(1 / ((f0s ** 2)
-                               * self.eta_evo_err)[tofit] ** 2))
-            A_err = np.sqrt(
-                1 / np.sum(2 / ((f0s ** 2)
-                                * self.eta_evo_err)[tofit] ** 2))
+        # zero per-chunk errors (degenerate parabola fits on noise
+        # chunks) get infinite weight exactly as in the reference
+        # (dynspec.py:1734-1743) — suppress just the warning
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if time_avg:
+                eta_avg = np.nanmean(self.eta_evo, 1)
+                eta_count = np.nansum(self.eta_evo, 1) / eta_avg
+                avg_err = (np.nanstd(self.eta_evo, 1)
+                           / np.sqrt(eta_count - 1))
+                tofit = np.isfinite(eta_avg) & np.isfinite(avg_err)
+                A = (np.sum(eta_avg[tofit]
+                            / (self.f0s * avg_err)[tofit] ** 2)
+                     / np.sum(1 / (self.f0s ** 2 * avg_err)[tofit] ** 2))
+                A_err = np.sqrt(
+                    1 / np.sum(2
+                               / ((self.f0s ** 2) * avg_err)[tofit] ** 2))
+            else:
+                tofit = (np.isfinite(self.eta_evo)
+                         & np.isfinite(self.eta_evo_err))
+                A = (np.sum(self.eta_evo[tofit]
+                            / (f0s * self.eta_evo_err)[tofit] ** 2)
+                     / np.sum(1 / ((f0s ** 2)
+                                   * self.eta_evo_err)[tofit] ** 2))
+                A_err = np.sqrt(
+                    1 / np.sum(2 / ((f0s ** 2)
+                                    * self.eta_evo_err)[tofit] ** 2))
         self.ththeta = A / self.fref ** 2
         self.ththetaerr = A_err / self.fref ** 2
 

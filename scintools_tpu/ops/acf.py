@@ -58,8 +58,13 @@ def acf_from_sspec(sspec_db, normalise=True, backend=None):
 
 def autocorr_direct(arr, mask=None):
     """Slow masked O(N^4) 2-D autocorrelation — test oracle
-    (scint_utils.py:67-84 semantics, numpy only)."""
+    (scint_utils.py:67-84 semantics, numpy only). A masked-array
+    input keeps its mask (the reference's documented input type)."""
+    in_mask = np.ma.getmaskarray(arr) if np.ma.isMaskedArray(arr) \
+        else None
     arr = np.ma.masked_invalid(np.asarray(arr, dtype=float))
+    if in_mask is not None:
+        arr = np.ma.masked_array(arr, mask=arr.mask | in_mask)
     if mask is not None:
         arr = np.ma.masked_array(arr, mask=mask)
     mean = np.ma.mean(arr)

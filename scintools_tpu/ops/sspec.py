@@ -36,7 +36,7 @@ def sspec_axes(nf, nt, dt, df, halve=True, dlam=None):
     return fdop, tdel, beta
 
 
-def _prewhite_diff(dyn, xp):
+def _prewhite_diff(dyn):
     """2-D first-difference prewhitening: 'valid' convolution with
     [[1,-1],[-1,1]] (dynspec.py:3680-3682)."""
     return (dyn[1:, 1:] - dyn[1:, :-1] - dyn[:-1, 1:] + dyn[:-1, :-1])
@@ -65,7 +65,7 @@ def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
     if prewhite:
         if not halve:
             raise RuntimeError("Cannot apply prewhite to full frame")
-        dyn = _prewhite_diff(dyn, xp)
+        dyn = _prewhite_diff(dyn)
 
     simf = xp.fft.fft2(dyn, s=(nrfft, ncfft))
     simf = (simf * xp.conj(simf)).real

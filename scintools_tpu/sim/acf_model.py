@@ -140,6 +140,8 @@ class ACF:
         self.backend = resolve_backend(backend)
 
         self.calc_acf()
+        if plot:
+            self.plot_acf(display=display)
 
     def calc_acf(self):
         """Build the full ACF (scint_sim.py:494-678 semantics)."""
@@ -238,6 +240,22 @@ class ACF:
         arr = np.sqrt(np.real(arr * np.conj(arr)))
         self.sspec = 10 * np.log10(arr)
         return self.sspec
+
+    # -- plotting (scint_sim.py:680-765) -------------------------------
+    def plot_acf(self, display=True, contour=True, filled=False,
+                 **kwargs):
+        from .plots import plot_acf_model
+        return plot_acf_model(self, display=display, contour=contour,
+                              filled=filled, **kwargs)
+
+    def plot_acf_efield(self, display=True, **kwargs):
+        from .plots import plot_acf_efield_model
+        return plot_acf_efield_model(self, display=display, **kwargs)
+
+    def plot_sspec(self, display=True, vmin=None, vmax=None, **kwargs):
+        from .plots import plot_acf_sspec
+        return plot_acf_sspec(self, display=display, vmin=vmin,
+                              vmax=vmax, **kwargs)
 
 
 def theoretical_acf(**kwargs):

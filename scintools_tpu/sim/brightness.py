@@ -59,10 +59,18 @@ class Brightness:
         self.backend = resolve_backend(backend)
 
         self.calc_brightness()
+        if plot:
+            self.plot_acf_efield(figsize=figsize)
+            self.plot_brightness(figsize=figsize)
         if calc_sspec:
             self.calc_SS()
+            if plot:
+                self.plot_sspec(figsize=figsize)
+                self.plot_cuts(figsize=figsize)
         if calc_acf:
             self.calc_acf()
+            if plot:
+                self.plot_acf(figsize=figsize, contour=contour)
 
     def calc_brightness(self):
         """E-field ACF → fft2 → brightness B(θx, θy)
@@ -128,3 +136,25 @@ class Brightness:
         acf = np.real(acf)
         acf /= np.max(acf)
         self.acf = acf
+
+    # -- plotting (scint_sim.py:960-1065) ------------------------------
+    def plot_acf_efield(self, figsize=(6, 6), **kwargs):
+        from .plots import plot_brightness_efield
+        return plot_brightness_efield(self, figsize=figsize, **kwargs)
+
+    def plot_brightness(self, figsize=(6, 6), **kwargs):
+        from .plots import plot_brightness_dist
+        return plot_brightness_dist(self, figsize=figsize, **kwargs)
+
+    def plot_sspec(self, figsize=(6, 6), **kwargs):
+        from .plots import plot_brightness_sspec
+        return plot_brightness_sspec(self, figsize=figsize, **kwargs)
+
+    def plot_acf(self, figsize=(6, 6), contour=True, **kwargs):
+        from .plots import plot_brightness_acf
+        return plot_brightness_acf(self, figsize=figsize,
+                                   contour=contour, **kwargs)
+
+    def plot_cuts(self, figsize=(6, 6), **kwargs):
+        from .plots import plot_brightness_cuts
+        return plot_brightness_cuts(self, figsize=figsize, **kwargs)

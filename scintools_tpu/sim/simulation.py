@@ -179,8 +179,8 @@ class Simulation:
     def __init__(self, mb2=2, rf=1, ds=0.01, alpha=5 / 3, ar=1, psi=0,
                  inner=0.001, ns=256, nf=256, dlam=0.25, lamsteps=False,
                  seed=None, nx=None, ny=None, dx=None, dy=None,
-                 verbose=False, freq=1400, dt=30, mjd=60000, nsub=None,
-                 efield=False, noise=None, backend=None):
+                 plot=False, verbose=False, freq=1400, dt=30, mjd=60000,
+                 nsub=None, efield=False, noise=None, backend=None):
         self.mb2 = mb2
         self.rf = rf
         self.ds = ds
@@ -213,6 +213,8 @@ class Simulation:
         if verbose:
             print("Getting impulse response...")
         self.get_pulse()
+        if plot:
+            self.plot_all()  # scint_sim.py:78-79
 
         # physical-units packaging (scint_sim.py:81-134)
         self.name = "sim:mb2={0},ar={1},psi={2},dlam={3}".format(
@@ -354,6 +356,35 @@ class Simulation:
         p = np.real(p * np.conj(p))
         self.pulsewin = np.transpose(np.roll(p, self.nf, axis=-1))
         self.dm = np.asarray(self.xyp)[:, int(self.ny / 2)] * self.dlam / np.pi
+
+    # -- plotting (scint_sim.py:313-415) -------------------------------
+    def plot_screen(self, subplot=False, **kwargs):
+        from .plots import plot_screen
+        return plot_screen(self, subplot=subplot, **kwargs)
+
+    def plot_intensity(self, subplot=False, **kwargs):
+        from .plots import plot_intensity
+        return plot_intensity(self, subplot=subplot, **kwargs)
+
+    def plot_dynspec(self, subplot=False, **kwargs):
+        from .plots import plot_sim_dynspec
+        return plot_sim_dynspec(self, subplot=subplot, **kwargs)
+
+    def plot_efield(self, subplot=False, **kwargs):
+        from .plots import plot_efield
+        return plot_efield(self, subplot=subplot, **kwargs)
+
+    def plot_delay(self, **kwargs):
+        from .plots import plot_delay
+        return plot_delay(self, **kwargs)
+
+    def plot_pulse(self, **kwargs):
+        from .plots import plot_pulse
+        return plot_pulse(self, **kwargs)
+
+    def plot_all(self, **kwargs):
+        from .plots import plot_sim_all
+        return plot_sim_all(self, **kwargs)
 
 
 _BATCH_SIM_CACHE = {}

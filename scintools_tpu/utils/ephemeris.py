@@ -179,7 +179,13 @@ def icrs_to_galactic(ra, dec):
 
 def make_lsr(d, raj, decj, pmra, pmdec, vr=0):
     """Proper motion corrected to the LSR frame
-    (scint_utils.py:314-346 role): μ_LSR = μ + (v☉·ê)/(4.74·d)."""
+    (scint_utils.py:314-346 role): μ_LSR = μ + (v☉·ê)/(4.74·d).
+
+    ``vr`` is accepted for signature parity; a pure frame-velocity
+    offset changes the returned proper motion only through its
+    tangential projection, so the source radial velocity drops out
+    (it would only matter for the returned RV, which the reference
+    also discards — it returns ``proper_motion`` alone)."""
     _, ra, dec = _psr_unit_equatorial(raj, decj)
     e_ra = np.array([-np.sin(ra), np.cos(ra), 0.0])
     e_dec = np.array([-np.sin(dec) * np.cos(ra),

@@ -100,7 +100,7 @@ class Timer:
 
 
 @contextmanager
-def trace(trace_dir, host_tracer_level=2):
+def trace(trace_dir):
     """jax.profiler trace context (view with TensorBoard / xprof).
     No-op (with a warning) when the profiler is unavailable; the
     traced body's own exceptions propagate untouched."""

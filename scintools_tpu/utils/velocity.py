@@ -39,7 +39,9 @@ def calculate_curvature_peak_probability(power_data, noise_level,
                                          smooth=True, curvatures=None,
                                          log=False):
     """Gaussian probability of the Doppler-profile peak
-    (scint_utils.py:835-854)."""
+    (scint_utils.py:835-854). ``curvatures`` is accepted for API
+    parity and unused — the reference notes it "currently doesn't
+    normalise using curvatures" (scint_utils.py:853)."""
     power_data = np.asarray(power_data, dtype=float)
     if smooth:
         power_data = gaussian_filter1d(power_data, noise_level)

@@ -54,6 +54,18 @@ class TestACF:
             fast[8:16, 12:22], slow[spk[0] - 4:spk[0] + 4,
                                     spk[1] - 5:spk[1] + 5], atol=5e-2)
 
+    def test_autocorr_honors_masked_array_input(self, rng):
+        # the reference's documented input type is a masked array
+        # (scint_utils.py:67-84); a MaskedArray's own mask must count
+        dyn = rng.standard_normal((5, 6))
+        mask = rng.random((5, 6)) < 0.3
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            a_ma = autocorr_direct(np.ma.masked_array(dyn, mask))
+            a_kw = autocorr_direct(dyn, mask=mask)
+        np.testing.assert_allclose(a_ma, a_kw, equal_nan=True)
+
     def test_acf_jax_matches_numpy(self, rng):
         dyn = rng.standard_normal((16, 16))
         a_np = autocovariance(dyn, backend="numpy")

@@ -1,0 +1,114 @@
+"""Smoke tests for the simulation-class plotting methods
+(reference scint_sim.py:313-415, :680-765, :960-1065)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from scintools_tpu.sim import ACF, Brightness, Simulation  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return Simulation(ns=64, nf=16, seed=3, backend="numpy")
+
+
+class TestSimulationPlots:
+    @pytest.mark.parametrize("method", [
+        "plot_screen", "plot_intensity", "plot_dynspec", "plot_efield",
+        "plot_delay", "plot_pulse", "plot_all"])
+    def test_method_draws(self, sim, method):
+        fig = getattr(sim, method)(display=False)
+        assert fig is not None
+        plt.close("all")
+
+    def test_file_output(self, sim, tmp_path):
+        out = tmp_path / "screen.png"
+        sim.plot_screen(filename=str(out), display=False)
+        assert out.exists() and out.stat().st_size > 0
+        plt.close("all")
+
+    def test_lamsteps_axis(self):
+        s = Simulation(ns=32, nf=8, seed=1, lamsteps=True,
+                       backend="numpy")
+        fig = s.plot_dynspec(display=False)
+        assert fig.axes[0].get_ylabel().startswith("Wavelength")
+        plt.close("all")
+
+
+class TestACFPlots:
+    @pytest.fixture(scope="class")
+    def acf(self):
+        return ACF(nf=17, nt=17, backend="numpy")
+
+    def test_plot_acf_variants(self, acf):
+        acf.plot_acf(display=False, contour=True)
+        acf.plot_acf(display=False, filled=True)
+        plt.close("all")
+
+    def test_plot_acf_efield(self, acf):
+        acf.plot_acf_efield(display=False)
+        plt.close("all")
+
+    def test_plot_sspec_lazy_calc(self, acf):
+        # plot computes the sspec on demand (scint_sim.py:748-749)
+        if hasattr(acf, "sspec"):
+            del acf.sspec
+        acf.plot_sspec(display=False)
+        assert hasattr(acf, "sspec")
+        plt.close("all")
+
+    def test_constructor_plot_kwarg(self):
+        # plot=True in __init__ draws (scint_sim.py:489-490); with the
+        # Agg backend show() is a no-op, so just assert no crash
+        ACF(nf=9, nt=9, plot=True, display=False, backend="numpy")
+        plt.close("all")
+
+
+class TestBrightnessPlots:
+    @pytest.fixture(scope="class")
+    def br(self):
+        return Brightness(nx=10, nt=24, ncuts=3, backend="numpy")
+
+    @pytest.mark.parametrize("method", [
+        "plot_acf_efield", "plot_brightness", "plot_sspec", "plot_acf",
+        "plot_cuts"])
+    def test_method_draws(self, br, method):
+        getattr(br, method)(display=False)
+        plt.close("all")
+
+    def test_constructor_plot_kwarg(self):
+        Brightness(nx=6, nt=16, ncuts=2, plot=True, backend="numpy")
+        plt.close("all")
+
+    def test_cuts_two_figures(self, br):
+        f1, f2 = br.plot_cuts(display=False)
+        assert f1 is not None and f2 is not None
+        plt.close("all")
+
+    def test_cuts_non_dividing_ncuts(self):
+        # the reference's index walk steps past the end of LSS when
+        # ncuts doesn't divide len(td)/2 (scint_sim.py:1035); ours
+        # clamps instead of crashing
+        Brightness(nx=8, nt=20, ncuts=7, plot=True, backend="numpy")
+        plt.close("all")
+
+
+class TestLazyGuards:
+    def test_plot_dynspec_recomputes(self):
+        s = Simulation(ns=32, nf=8, seed=1, backend="numpy")
+        del s.spi, s.x, s.lams, s.freqs
+        s.plot_dynspec(display=False)
+        assert hasattr(s, "spi")
+        plt.close("all")
+
+    def test_plot_efield_recomputes_axes(self):
+        s = Simulation(ns=32, nf=8, seed=1, backend="numpy")
+        del s.x, s.lams, s.freqs, s.spi
+        s.plot_efield(display=False)
+        assert hasattr(s, "x")
+        plt.close("all")
