@@ -1,0 +1,92 @@
+"""Working with the theoretical ACF in strong scintillation.
+
+Mirrors the reference's ``examples/acf_strong_scintillation.ipynb``:
+the Lambert & Rickett (1999) / Rickett et al. (2014) analytic 2-D
+intensity ACF (scint_sim.py:417-765), here computed by the
+GEMM-factorised kernel (sim/acf_model.py) — the same model the
+``acf2d`` fit method evaluates inside the jitted TPU fit
+(fit/acf2d.py).
+
+Run:  python examples/05_acf_strong_scintillation.py [--backend jax]
+      [--plot out/]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from scintools_tpu.sim import ACF  # noqa: E402
+from scintools_tpu.utils.profiling import Timer  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="numpy",
+                    choices=["numpy", "jax"])
+    ap.add_argument("--plot", default=None, metavar="DIR",
+                    help="write figures into DIR")
+    args = ap.parse_args()
+
+    # sync fences the jax device queue — skip it on the numpy path
+    # (first touch of a tunneled TPU can take a minute)
+    tm = Timer(sync=(args.backend == "jax"))
+    # default isotropic model, like the notebook's first cell
+    with tm("ACF (defaults)"):
+        acf0 = ACF(backend=args.backend)
+    print(f"default ACF grid: {acf0.acf.shape}, "
+          f"peak={acf0.acf.max():.3f}")
+
+    # anisotropic + phase-gradient model (the notebook's key knobs)
+    with tm("ACF (ar=2, psi=30, phasegrad=0.2)"):
+        my_acf = ACF(ar=2, psi=30, phasegrad=0.2, theta=0,
+                     taumax=4, dnumax=4, nt=51, nf=51,
+                     backend=args.backend)
+    print(f"anisotropic ACF grid: {my_acf.acf.shape}")
+
+    # a phase gradient tilts the ACF: rows at nonzero frequency lag
+    # are no longer even in time lag (the zero-lag cut stays
+    # symmetric — see Brightness.plot_cuts notes, scint_sim.py:1024)
+    q_f = my_acf.acf.shape[0] // 4
+    row = my_acf.acf[q_f]
+    asym = np.max(np.abs(row - row[::-1])) / my_acf.acf.max()
+    print(f"time-lag asymmetry at quarter frequency lag: {asym:.3f}")
+    assert asym > 0.01, "phase gradient should skew the ACF"
+
+    # secondary spectrum of the model (notebook: plot_sspec with
+    # hanning, then blackman)
+    my_acf.calc_sspec(window="hanning")
+    s_han = my_acf.sspec.copy()
+    my_acf.calc_sspec(window="blackman", window_frac=1.0)
+    print(f"sspec grids hanning/blackman: {s_han.shape} / "
+          f"{my_acf.sspec.shape}")
+
+    # raw arrays, as the notebook's final cells show
+    acf, t, f = my_acf.acf, my_acf.tn, my_acf.fn
+    print(f"lag axes: t [{t[0]:.1f}, {t[-1]:.1f}] tau_d, "
+          f"f [{f[0]:.1f}, {f[-1]:.1f}] dnu_d")
+
+    print(tm.report())
+
+    if args.plot:
+        import matplotlib
+        matplotlib.use("Agg")
+        os.makedirs(args.plot, exist_ok=True)
+        acf0.plot_acf(display=False,
+                      filename=os.path.join(args.plot, "acf_iso.png"))
+        my_acf.plot_acf(display=False,
+                        filename=os.path.join(args.plot, "acf_aniso.png"))
+        my_acf.plot_acf_efield(
+            display=False,
+            filename=os.path.join(args.plot, "acf_efield.png"))
+        my_acf.plot_sspec(
+            display=False,
+            filename=os.path.join(args.plot, "acf_sspec.png"))
+        print(f"figures written to {args.plot}/")
+
+
+if __name__ == "__main__":
+    main()

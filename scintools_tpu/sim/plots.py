@@ -300,9 +300,10 @@ def plot_brightness_cuts(br, figsize=(6, 6), filename=None,
     plt = _mpl()
     fig1 = plt.figure(figsize=figsize)
     nt = len(br.td)
-    step = int((nt / 2) / br.ncuts)
     # clamp: for ncuts values that don't divide nt/2 the reference's
-    # index walk steps past the end of LSS (scint_sim.py:1035)
+    # index walk steps past the end of LSS (scint_sim.py:1035), and
+    # ncuts > nt/2 would make the step zero
+    step = max(int((nt / 2) / br.ncuts), 1)
     for itdp in range(int(nt / 2) + step - 1, nt + step - 1, step):
         plt.plot(br.fd, br.LSS[min(itdp, nt - 1), :])
     mn = np.min(br.LSS[nt - 1, round(len(br.fd) / 2 - 1)])

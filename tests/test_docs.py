@@ -1,0 +1,103 @@
+"""Execute the code blocks in docs/tutorials/ so the documentation
+cannot drift from the API.
+
+Blocks are run in one shared namespace per tutorial (like a notebook).
+A light preamble redirects the sample-data path to the mounted
+reference copy and scales down the most expensive knobs so the whole
+tutorial runs in CI time; every API call in the docs still executes
+for real.
+"""
+
+import os
+import re
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import pytest  # noqa: E402
+
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
+                    "tutorials")
+SAMPLE = ("/root/reference/scintools/examples/data/ththsims/"
+          "Sample_Data.npz")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(SAMPLE),
+                                reason="tutorial sample not mounted")
+
+
+def _blocks(name):
+    text = open(os.path.join(DOCS, name)).read()
+    return re.findall(r"```python\n(.*?)```", text, re.S)
+
+
+def _run(name, scale_down):
+    ns = {}
+    applied = set()
+    code_all = _blocks(name)
+    assert code_all, f"no python blocks found in {name}"
+    for i, block in enumerate(code_all):
+        block = block.replace(
+            'np.load("scintools/examples/data/ththsims/Sample_Data.npz")',
+            f'np.load("{SAMPLE}")')
+        for old, new in scale_down:
+            if old in block:
+                applied.add(old)
+                block = block.replace(old, new)
+        try:
+            exec(compile(block, f"{name}[block {i}]", "exec"), ns)
+        finally:
+            plt.close("all")
+    missed = [old for old, _ in scale_down if old not in applied]
+    assert not missed, (
+        f"scale-down patterns no longer match {name} (a doc reformat "
+        f"would silently run full-size): {missed}")
+    return ns
+
+
+def test_thth_intro_blocks_run():
+    ns = _run("thth_intro.md", scale_down=[
+        # full-size grid: 100 eta x 512 edges on a 256x600-padded CS is
+        # minutes on the CPU test runner; 1/4 resolution exercises the
+        # same calls
+        ("np.linspace(12.5, 100.0, 100)", "np.linspace(12.5, 100.0, 48)"),
+        ("np.linspace(-0.4, 0.4, 512)", "np.linspace(-0.4, 0.4, 128)"),
+        ("iters=200", "iters=64"),
+    ])
+    # the tutorial's own claim: recovered curvature ~44 us/mHz^2
+    assert abs(ns["eta_fit"] - 44.0) < 5.0
+    assert ns["eta_sig"] < 5.0
+    assert len(ns["results"]) == 2
+
+
+def test_dynspec_thth_blocks_run():
+    ns = _run("dynspec_thth.md", scale_down=[
+        # CI scale: fewer eta samples / edges, skip the interactive
+        # diagnostic re-runs and the process-pool block
+        ("dyn.prep_thetatheta(verbose=True, cwf=128, edges_lim=0.3)\n"
+         "dyn.thetatheta_single()        # one-chunk diagnostic figure",
+         "dyn.prep_thetatheta(verbose=False, cwf=128, edges_lim=0.3)"),
+        ("dyn.prep_thetatheta(verbose=True, cwf=64, edges_lim=0.3,\n"
+         "                    eta_min=30.0, eta_max=50.0)   # s^3 at fref\n"
+         "dyn.thetatheta_single()",
+         "dyn.prep_thetatheta(verbose=False, cwf=64, edges_lim=0.3,\n"
+         "                    eta_min=30.0, eta_max=50.0, neta=24,\n"
+         "                    nedge=64)\n"
+         "dyn.thetatheta_single(plot=False)"),
+        ("dyn.fit_thetatheta(verbose=False, plot=True)",
+         "dyn.fit_thetatheta(verbose=False, plot=False)"),
+        ("from multiprocessing import Pool\n"
+         "with Pool(4) as pool:\n"
+         "    dyn.fit_thetatheta(pool=pool)",
+         "pass  # pool fan-out covered by tests/test_plotting.py"),
+        ("mesh = par.make_mesh(8)          # e.g. 8 devices",
+         "mesh = par.make_mesh(1)"),
+        ("dyn.calc_wavefield(gs=True, niter=5)",
+         "dyn.calc_wavefield(gs=True, niter=1)"),
+        ('dyn = Dynspec(dyn=bdyn, process=False, backend="jax")  '
+         '# or "numpy"',
+         'dyn = Dynspec(dyn=bdyn, process=False, backend="numpy")'),
+    ])
+    assert 30.0 < ns["dyn"].ththeta < 60.0
+    assert ns["W"].shape[0] > 0
