@@ -30,7 +30,9 @@ def main():
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write a jax.profiler trace to DIR")
     args = ap.parse_args()
-    tm = Timer()
+    # sync fences the jax device queue; skip on the numpy path so a
+    # down TPU tunnel can't stall a host-only run
+    tm = Timer(sync=(args.backend == "jax"))
 
     # --- simulate: Kolmogorov screen + Fresnel propagation ----------
     with tm("simulate"):

@@ -28,7 +28,9 @@ def main():
     ap.add_argument("--steps", type=int, default=2000,
                     help="MCMC steps (reference default is 10000)")
     args = ap.parse_args()
-    tm = Timer()
+    # sync fences the jax device queue; skip on the numpy path so a
+    # down TPU tunnel can't stall a host-only run
+    tm = Timer(sync=(args.backend == "jax"))
 
     sim = Simulation(ns=256, nf=256, mb2=8, seed=64, dt=30, freq=1400,
                      dlam=0.05, backend=args.backend)

@@ -1885,7 +1885,7 @@ class MatlabDyn:
         if "dlam" not in self.matfile:
             raise NameError('No variable named "dlam" found in mat file')
         self.dyn = self.matfile["spi"]
-        dlam = float(self.matfile["dlam"])
+        dlam = float(np.asarray(self.matfile["dlam"]).squeeze())
         self.name = matfilename.split()[0]
         self.header = [str(self.matfile.get("__header__", "")),
                        f"Dynspec loaded from Matfile {matfilename}"]

@@ -302,6 +302,17 @@ class Simulation:
                      + 1j * nprandom.randn(self.nx, self.ny))))
         self.xyp = xyp
 
+    def frfilt3(self, xye, scale):
+        """Apply the Fresnel quadratic-phase filter in place — parity
+        entry for the reference's quadrant-sliced method
+        (scint_sim.py:294-311). The closed-form q2 grid
+        (fresnel_filter_q2) is mathematically identical to the four
+        quadrant multiplies."""
+        q2 = fresnel_filter_q2(self.nx, self.ny, self.ffconx,
+                               self.ffcony)
+        xye *= np.exp(-1j * q2 * scale)
+        return xye
+
     def frequency_scales(self):
         ifreq = np.arange(self.nf)
         if self.lamsteps:

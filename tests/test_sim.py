@@ -77,6 +77,32 @@ class TestSimulation:
         # screens differ
         assert not np.allclose(batch[0], batch[1])
 
+    def test_frfilt3_matches_quadrant_algorithm(self):
+        # closed-form q2 grid vs the reference's four quadrant
+        # multiplies (scint_sim.py:294-311), written out independently
+        from scintools_tpu.sim.simulation import Simulation
+
+        s = Simulation(ns=16, nf=4, seed=0, backend="numpy")
+        rng = np.random.default_rng(0)
+        xye = (rng.normal(size=(16, 16))
+               + 1j * rng.normal(size=(16, 16)))
+        ours = s.frfilt3(xye.copy(), 0.7)
+
+        nx = ny = 16
+        nx2 = ny2 = 9
+        filt = np.zeros((nx2, ny2), complex)
+        q2x = np.arange(nx2) ** 2 * 0.7 * s.ffconx
+        for ly in range(ny2):
+            q2 = q2x + s.ffcony * ly ** 2 * 0.7
+            filt[:, ly] = np.cos(q2) - 1j * np.sin(q2)
+        ref = xye.copy()
+        ref[0:nx2, 0:ny2] *= filt
+        ref[nx:nx2 - 1:-1, 0:ny2] *= filt[1:nx2 - 1, 0:ny2]
+        ref[0:nx2, ny:ny2 - 1:-1] *= filt[0:nx2, 1:ny2 - 1]
+        ref[nx:nx2 - 1:-1, ny:ny2 - 1:-1] *= filt[1:nx2 - 1,
+                                                  1:ny2 - 1]
+        np.testing.assert_allclose(ours, ref, atol=1e-9)
+
 
 class TestACFModel:
     def _direct_acf_quadrant(self, acf):
