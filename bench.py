@@ -58,12 +58,13 @@ def probe_accelerator():
     if os.environ.get("SCINTOOLS_BENCH_NO_PROBE"):
         record["skipped"] = True
         return record, True
-    # 4×120s with 45s gaps ≈ 10 min of bring-up budget: observed
-    # tunnel outages recover on their own, and the CPU fallback is a
-    # far worse outcome for the one benchmark run that counts
-    attempts = int(os.environ.get("SCINTOOLS_BENCH_PROBE_ATTEMPTS", 4))
+    # 8×120s with 90s gaps ≈ 26 min of bring-up budget: observed
+    # tunnel outages (a >25 min one on 2026-07-30) recover on their
+    # own, and the CPU fallback is a far worse outcome for the one
+    # benchmark run that counts
+    attempts = int(os.environ.get("SCINTOOLS_BENCH_PROBE_ATTEMPTS", 8))
     timeout = float(os.environ.get("SCINTOOLS_BENCH_PROBE_TIMEOUT", 120))
-    sleep = float(os.environ.get("SCINTOOLS_BENCH_PROBE_SLEEP", 45))
+    sleep = float(os.environ.get("SCINTOOLS_BENCH_PROBE_SLEEP", 90))
     for i in range(attempts):
         t0 = time.time()
         try:

@@ -5,7 +5,9 @@ tests/data/golden_reference.npz).
 
 Covered: Simulation seed-exact dynspec (scint_sim.py:23-414), J0437
 psrflux load + calc_sspec + calc_acf (dynspec.py:144-230, :3584-3814),
-and the θ-θ eigenvalue η-curve (ththmod.py:371-401)."""
+the θ-θ eigenvalue η-curve (ththmod.py:371-401), θ-θ forward/inverse
+maps element-for-element (ththmod.py:56-271), and the Rickett-2014
+analytic ACF grid (scint_sim.py:494-678)."""
 
 import os
 
@@ -82,19 +84,76 @@ class TestJ0437Golden:
 
 
 class TestThetaThetaGolden:
-    def test_eval_curve_matches(self, gold):
-        from scintools_tpu.thth.core import eval_calc_batch
-
+    @pytest.fixture(scope="class")
+    def chunk_cs(self, gold):
         dyn = np.asarray(gold["sim_dyn"], dtype=float)[:64, :64]
         dyn = dyn - dyn.mean()
         npad = int(gold["thth_npad"])
         pad = np.pad(dyn, ((0, npad * 64), (0, npad * 64)),
                      constant_values=dyn.mean())
-        CS = np.fft.fftshift(np.fft.fft2(pad))
-        eigs = eval_calc_batch(CS, gold["thth_tau"], gold["thth_fd"],
+        return np.fft.fftshift(np.fft.fft2(pad))
+
+    def test_eval_curve_matches(self, gold, chunk_cs):
+        from scintools_tpu.thth.core import eval_calc_batch
+
+        eigs = eval_calc_batch(chunk_cs, gold["thth_tau"],
+                               gold["thth_fd"],
                                gold["thth_etas"], gold["thth_edges"],
                                backend="numpy")
         ref = np.asarray(gold["thth_eigs"], dtype=float)
         scale = ref.max()
         np.testing.assert_allclose(eigs / scale, ref / scale,
                                    rtol=2e-4)
+
+    def test_thth_map_matches(self, gold, chunk_cs):
+        """Map-level parity: the (θ₁, θ₂) gather + Jacobian weights
+        reproduce the reference's matrix element-for-element
+        (ththmod.py:56-116)."""
+        from scintools_tpu.thth.core import thth_map
+
+        tm = np.asarray(thth_map(chunk_cs, gold["thth_tau"],
+                                 gold["thth_fd"],
+                                 float(gold["thth_map_eta"]),
+                                 gold["thth_edges"],
+                                 backend="numpy"))
+        ref = gold["thth_map_re"] + 1j * gold["thth_map_im"]
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(tm / scale, ref / scale,
+                                   atol=1e-10)
+
+    def test_rev_map_matches(self, gold, chunk_cs):
+        """Inverse-map parity: scatter-add + hermitian mirror +
+        count normalisation (ththmod.py:176-271)."""
+        from scintools_tpu.thth.core import rev_map, thth_map
+
+        tm = np.asarray(thth_map(chunk_cs, gold["thth_tau"],
+                                 gold["thth_fd"],
+                                 float(gold["thth_map_eta"]),
+                                 gold["thth_edges"],
+                                 backend="numpy"))
+        rm = np.asarray(rev_map(tm, gold["thth_tau"], gold["thth_fd"],
+                                float(gold["thth_map_eta"]),
+                                gold["thth_edges"], hermetian=True,
+                                backend="numpy"))
+        ref = gold["rev_map_re"] + 1j * gold["rev_map_im"]
+        assert rm.shape == ref.shape
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(rm / scale, ref / scale,
+                                   atol=1e-10)
+
+
+class TestRickettACFGolden:
+    def test_acf_grid_matches(self, gold):
+        """The GEMM-factorised Fresnel integral reproduces the
+        reference's O(nt·nf·nx²) loop (scint_sim.py:494-678) on an
+        anisotropic + phase-gradient model."""
+        from scintools_tpu.sim.acf_model import ACF
+
+        ours = ACF(psi=30, phasegrad=0.2, theta=0, ar=2, alpha=5 / 3,
+                   taumax=4, dnumax=4, nf=25, nt=25, amp=1,
+                   backend="numpy")
+        np.testing.assert_allclose(ours.tn, gold["rickett_tn"])
+        np.testing.assert_allclose(ours.fn, gold["rickett_fn"])
+        ref = np.asarray(gold["rickett_acf"], dtype=float)
+        assert ours.acf.shape == ref.shape
+        np.testing.assert_allclose(ours.acf, ref, atol=1e-8)

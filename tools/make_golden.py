@@ -11,7 +11,10 @@ lets the reference's numpy-only compute paths run UNMODIFIED:
 - ``Dynspec.calc_sspec``/``calc_acf`` (dynspec.py:3584-3814) on one
   real J0437-4715 epoch (psrflux parse + trim included);
 - ``ththmod.Eval_calc`` η-curve (ththmod.py:371-401) on a chunk of
-  the simulated dynspec.
+  the simulated dynspec;
+- ``ththmod.thth_map``/``rev_map`` raw matrices (ththmod.py:56-271);
+- the Rickett-2014 analytic ``ACF`` grid with anisotropy and phase
+  gradient (scint_sim.py:417-678).
 
 A shim bug cannot create false confidence: it would make the goldens
 DISAGREE with this repo's independent implementation and fail the
@@ -33,6 +36,11 @@ import astropy_shim  # noqa: E402
 astropy_shim.install()
 sys.path.insert(0, "/root/reference")
 warnings.filterwarnings("ignore")
+
+# the reference predates NumPy 2 (np.complex_ was removed;
+# scint_sim.py:589,634) — restore the alias for its unmodified code
+if not hasattr(np, "complex_"):
+    np.complex_ = np.complex128
 
 OUT = os.path.join(HERE, "..", "tests", "data",
                    "golden_reference.npz")
@@ -97,6 +105,24 @@ def main():
     out["thth_edges"] = np.asarray(edges.value, dtype=np.float64)
     out["thth_eigs"] = eigs
     out["thth_npad"] = npad
+
+    # ---- 4. θ-θ map-level goldens: thth_map + rev_map ---------------
+    eta_mid = etas[len(etas) // 2]
+    tm = thth.thth_map(CS, tau, fd, eta_mid * u.s ** 3, edges)
+    out["thth_map_eta"] = eta_mid
+    out["thth_map_re"] = np.real(tm).astype(np.float64)
+    out["thth_map_im"] = np.imag(tm).astype(np.float64)
+    rm = thth.rev_map(tm, tau, fd, eta_mid * u.s ** 3, edges,
+                      hermetian=True)
+    out["rev_map_re"] = np.real(np.asarray(rm)).astype(np.float64)
+    out["rev_map_im"] = np.imag(np.asarray(rm)).astype(np.float64)
+
+    # ---- 5. Rickett-2014 analytic ACF (numpy-only class) ------------
+    acf_obj = ss.ACF(psi=30, phasegrad=0.2, theta=0, ar=2, alpha=5 / 3,
+                     taumax=4, dnumax=4, nf=25, nt=25, amp=1)
+    out["rickett_acf"] = np.asarray(acf_obj.acf, dtype=np.float64)
+    out["rickett_tn"] = np.asarray(acf_obj.tn, dtype=np.float64)
+    out["rickett_fn"] = np.asarray(acf_obj.fn, dtype=np.float64)
 
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     np.savez_compressed(OUT, **out)
