@@ -234,35 +234,24 @@ def make_arc_dynspec(nt, nf, dt, df, f0, eta_true, n_images, seed,
     return dyn
 
 
-def bench_north_star(jax, jnp):
-    """North star (BASELINE.md): 4096×4096 sspec + θ-θ curvature
-    search — 8×8 grid of 512² chunks (CS 1024² at npad=1), 200 η,
-    256 θ edges; ref kernels dynspec.py:3584 + ththmod.py:715."""
-    from scintools_tpu.ops.sspec import secondary_spectrum_power
+def make_north_star_problem(nf, nt, n_variants=2):
+    """North-star workload construction shared by bench_north_star and
+    tools/tune_northstar.py: the synthetic known-curvature dynspec (+
+    perturbed variants so no two timed calls see identical buffers),
+    chunk geometry, η grid, θ edges, and windows. One definition so
+    the tuner measures EXACTLY the benched problem."""
     from scintools_tpu.ops.windows import get_window
-    from scintools_tpu.thth.core import eval_calc_batch, fft_axis, cs_to_ri
-    from scintools_tpu.thth.batch import make_multi_eval_fn
-    from scintools_tpu.thth.search import fit_eig_peak
+    from scintools_tpu.thth.core import fft_axis
 
-    # full north-star size on an accelerator; the CPU fallback (dead
-    # tunnel) measures a quarter-scale version of the SAME pipeline so
-    # the run still finishes inside the watchdog — the measured size
-    # is recorded in the output
-    full = jax.default_backend() != "cpu"
-    nf = nt = 4096 if full else 2048
     dt, df, f0 = 2.0, 0.05, 1400.0
     eta_true = 5e-4                             # us/mHz²
-    cf = ct = 512
-    ncf, nct = nf // cf, nt // ct               # 8×8 = 64 chunks full
+    cf = ct = min(512, nf)
     npad = 1
-    group = int(os.environ.get("SCINTOOLS_BENCH_NS_GROUP",
-                               8 if full else 4))
-    if (ncf * nct) % group:
-        raise ValueError(f"SCINTOOLS_BENCH_NS_GROUP={group} must "
-                         f"divide the chunk count {ncf * nct}")
-
     dyn0 = make_arc_dynspec(nt, nf, dt, df, f0, eta_true,
                             n_images=96, seed=21)
+    rng = np.random.default_rng(7)
+    dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
+            for i in range(n_variants)]
     times = np.arange(ct) * dt
     freqs = f0 + np.arange(cf) * df
     fd = fft_axis(times, pad=npad, scale=1e3)   # mHz
@@ -271,10 +260,78 @@ def bench_north_star(jax, jnp):
     th_lim = 0.95 * min(np.sqrt(tau.max() / etas.max()), fd.max() / 2)
     edges = np.linspace(-th_lim, th_lim, 256)
     wins = get_window(nt, nf, window="hanning", frac=0.1)
+    return dict(dyns=dyns, cf=cf, ct=ct, npad=npad, tau=tau, fd=fd,
+                etas=etas, edges=edges, wins=wins, eta_true=eta_true)
 
-    rng = np.random.default_rng(7)
-    dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
-            for i in range(2)]
+
+def make_north_star_pipeline(jax, jnp, nf, nt, cf, ct, npad, wins,
+                             tau, fd, edges, group, method="auto",
+                             iters=200):
+    """One jitted device program for the north-star workload: window +
+    padded sspec FFT, per-chunk mean-pad + fft2 → CS, and the η-grid
+    eigenvalue search with the chunk batch walked in HBM-sized groups
+    by ``lax.map``. Shared by bench_north_star and
+    tools/tune_northstar.py so the tuner measures EXACTLY the benched
+    program."""
+    from scintools_tpu.ops.sspec import secondary_spectrum_power
+    from scintools_tpu.thth.batch import make_multi_eval_fn
+
+    ncf, nct = nf // cf, nt // ct
+    n_chunks = ncf * nct
+    if n_chunks % group:
+        raise ValueError(f"group={group} must divide {n_chunks}")
+    eval_fn = make_multi_eval_fn(tau, fd, edges, iters=iters,
+                                 method=method)
+    support = np.pad(np.ones((cf, ct), np.float32),
+                     ((0, npad * cf), (0, npad * ct)))
+
+    @jax.jit
+    def jax_pipeline(d, e):
+        sec = secondary_spectrum_power(d, window_arrays=wins,
+                                       backend="jax")
+        chunks = d.reshape(ncf, cf, nct, ct).transpose(0, 2, 1, 3) \
+            .reshape(n_chunks, cf, ct)
+        mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)
+        padded = jnp.where(
+            jnp.asarray(support)[None] > 0,
+            jnp.pad(chunks, ((0, 0), (0, npad * cf), (0, npad * ct))),
+            mu)
+        CS = jnp.fft.fftshift(jnp.fft.fft2(padded), axes=(1, 2))
+        cs_ri = jnp.stack([CS.real, CS.imag], axis=1) \
+            .astype(jnp.float32)
+        grouped = cs_ri.reshape((n_chunks // group, group)
+                                + cs_ri.shape[1:])
+        eigs = jax.lax.map(lambda g: eval_fn(g, e), grouped)
+        return sec, eigs.reshape(n_chunks, -1)
+
+    return jax_pipeline
+
+
+def bench_north_star(jax, jnp):
+    """North star (BASELINE.md): 4096×4096 sspec + θ-θ curvature
+    search — 8×8 grid of 512² chunks (CS 1024² at npad=1), 200 η,
+    256 θ edges; ref kernels dynspec.py:3584 + ththmod.py:715."""
+    from scintools_tpu.ops.sspec import secondary_spectrum_power
+    from scintools_tpu.thth.core import eval_calc_batch
+    from scintools_tpu.thth.search import fit_eig_peak
+
+    # full north-star size on an accelerator; the CPU fallback (dead
+    # tunnel) measures a quarter-scale version of the SAME pipeline so
+    # the run still finishes inside the watchdog — the measured size
+    # is recorded in the output
+    full = jax.default_backend() != "cpu"
+    nf = nt = 4096 if full else 2048
+    prob = make_north_star_problem(nf, nt)
+    cf, ct, npad = prob["cf"], prob["ct"], prob["npad"]
+    tau, fd = prob["tau"], prob["fd"]
+    etas, edges, wins = prob["etas"], prob["edges"], prob["wins"]
+    dyns, eta_true = prob["dyns"], prob["eta_true"]
+    ncf, nct = nf // cf, nt // ct               # 8×8 = 64 chunks full
+    group = int(os.environ.get("SCINTOOLS_BENCH_NS_GROUP",
+                               8 if full else 4))
+    if (ncf * nct) % group:
+        raise ValueError(f"SCINTOOLS_BENCH_NS_GROUP={group} must "
+                         f"divide the chunk count {ncf * nct}")
     n_chunks = ncf * nct
 
     # Both pipelines are timed END-TO-END from the dynspec: window +
@@ -304,29 +361,9 @@ def bench_north_star(jax, jnp):
     t_np = time.perf_counter() - t0             # one timed pass (~4 min)
 
     # ---- jax: one jitted program, chunk groups walked by lax.map ----
-    eval_fn = make_multi_eval_fn(tau, fd, edges, iters=200,
-                                 method="auto")
-    support = np.pad(np.ones((cf, ct), np.float32),
-                     ((0, npad * cf), (0, npad * ct)))
-
-    @jax.jit
-    def jax_pipeline(d, e):
-        sec = secondary_spectrum_power(d, window_arrays=wins,
-                                       backend="jax")
-        chunks = d.reshape(ncf, cf, nct, ct).transpose(0, 2, 1, 3) \
-            .reshape(n_chunks, cf, ct)
-        mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)
-        padded = jnp.where(
-            jnp.asarray(support)[None] > 0,
-            jnp.pad(chunks, ((0, 0), (0, npad * cf), (0, npad * ct))),
-            mu)
-        CS = jnp.fft.fftshift(jnp.fft.fft2(padded), axes=(1, 2))
-        cs_ri = jnp.stack([CS.real, CS.imag], axis=1) \
-            .astype(jnp.float32)
-        grouped = cs_ri.reshape((n_chunks // group, group)
-                                + cs_ri.shape[1:])
-        eigs = jax.lax.map(lambda g: eval_fn(g, e), grouped)
-        return sec, eigs.reshape(n_chunks, -1)
+    jax_pipeline = make_north_star_pipeline(jax, jnp, nf, nt, cf, ct,
+                                            npad, wins, tau, fd, edges,
+                                            group, method="auto")
 
     e_j = jnp.asarray(etas)
     jvariants = [(jnp.asarray(d, dtype=jnp.float32), e_j)

@@ -1598,14 +1598,18 @@ class Dynspec:
             print(f"Sharded chunk grid: {int(ok.sum())}/{B} "
                   f"chunk fits on {ndev} devices")
 
-    def thetatheta_chunks(self, verbose=False, pool=None, memmap=False):
+    def thetatheta_chunks(self, verbose=False, pool=None, memmap=False,
+                          mesh=None):
         """Half-overlapping retrieval chunk grid (dynspec.py:1765-1826).
 
         ``pool``: used for the per-chunk retrieval fan-out on the
         numpy backend (reference pool dispatch, dynspec.py:1812-1826);
-        on jax the batched jitted retrieval replaces it."""
+        on jax the batched jitted retrieval replaces it. ``mesh``:
+        optional device mesh — each row's chunk batch is sharded over
+        every device (SPMD pool.map replacement)."""
         if not hasattr(self, "ththeta"):
-            self.fit_thetatheta(verbose=verbose)
+            # fit_thetatheta itself gates mesh on the backend
+            self.fit_thetatheta(verbose=verbose, mesh=mesh)
         if memmap:
             self.chunks = np.memmap(
                 "memmap.dat", dtype=complex, mode="w+",
@@ -1631,7 +1635,7 @@ class Dynspec:
                 self.chunks[cf] = thth_ret.chunk_retrieval_batch(
                     np.stack(row), self.edges * (freq / self.fref),
                     eta, dt, df, npad=self.npad,
-                    tau_mask=self.thth_tau_mask)
+                    tau_mask=self.thth_tau_mask, mesh=mesh)
                 if verbose:
                     print(f"retrieved row {cf + 1}/{self.ncf_ret} "
                           f"({self.nct_ret} chunks, eta={eta:.4g})")
@@ -1666,13 +1670,14 @@ class Dynspec:
                 self.chunks[cf, ct, :, :] = res[0]
 
     def calc_wavefield(self, verbose=False, pool=None, gs=False,
-                       memmap=False, niter=1):
+                       memmap=False, niter=1, mesh=None):
         """Mosaic the retrieval chunks into the wavefield
         (dynspec.py:1828-1852). ``pool`` forwards to the retrieval
-        fan-out (numpy backend)."""
+        fan-out (numpy backend); ``mesh`` shards the jax retrieval
+        batch over the device mesh."""
         if not hasattr(self, "chunks"):
             self.thetatheta_chunks(verbose=verbose, memmap=memmap,
-                                   pool=pool)
+                                   pool=pool, mesh=mesh)
         self.wavefield = thth_ret.mosaic(self.chunks)
         if gs:
             self.gerchberg_saxton(verbose=verbose, niter=niter)

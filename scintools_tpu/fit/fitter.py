@@ -18,7 +18,6 @@ from __future__ import annotations
 import numpy as np
 from scipy.optimize import least_squares
 
-from .parameters import Parameters
 
 
 class MinimizerResult:

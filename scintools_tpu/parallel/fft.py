@@ -16,8 +16,6 @@ once and XLA overlaps the collective with the surrounding FFTs.
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 from ..backend import get_jax

@@ -21,14 +21,10 @@ in ``__graft_entry__`` drives it on a virtual mesh.
 
 from __future__ import annotations
 
-from functools import partial
-
-import numpy as np
 
 from ..backend import get_jax
 from .mesh import DATA_AXIS, SEQ_AXIS, batch_freq_sharding, replicated
 from .fft import make_sspec_power_sharded, make_fft2_sharded
-from ..ops.sspec import fft_shapes
 from ..ops.windows import get_window
 from ..thth.core import make_eval_fn
 

@@ -30,7 +30,6 @@ from numpy import random as nprandom
 from scipy.special import gamma as _gamma
 
 from ..backend import get_xp, resolve_backend, get_jax
-from ..ops.windows import edge_taper
 
 SPEED_OF_LIGHT = 299792458.0  # m/s
 

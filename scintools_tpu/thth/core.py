@@ -85,6 +85,12 @@ def thth_map(CS, tau, fd, eta, edges, hermetian=True, backend=None):
     dtau = np.diff(tau).mean()
     dfd = np.diff(fd).mean()
 
+    if not np.isfinite(eta):
+        # NaN η (failed upstream fit) masks every bin out anyway —
+        # return the zero matrix without the NaN→int cast warning
+        return xp.zeros((len(th_cents), len(th_cents)),
+                        dtype=complex)
+
     tau_inv = ((eta * (th1 ** 2 - th2 ** 2) - tau[0] + dtau / 2)
                // dtau).astype(int)
     fd_inv = (((th1 - th2) - fd[0] + dfd / 2) // dfd).astype(int)

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .core import (ext_find, modeler, rev_map, th_cents_from_edges,
+from .core import (ext_find, modeler, rev_map,
                    unit_checks)
 from .search import chi_par
 

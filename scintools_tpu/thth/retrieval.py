@@ -22,7 +22,7 @@ import numpy as np
 from .core import (modeler, rev_map, thth_redmap, unit_checks,
                    fft_axis, keyed_jit_cache)
 from .search import chunk_conjugate_spectrum
-from ..backend import resolve_backend, get_jax
+from ..backend import get_jax
 
 
 def single_chunk_retrieval(dspec, edges, time, freq, eta, idx_t=0,
@@ -262,11 +262,17 @@ _RETRIEVAL_JIT_CACHE = {}
 
 
 def chunk_retrieval_batch(chunks, edges, eta, dt, df, npad=3,
-                          tau_mask=0.0, method="eigh", iters=1024):
+                          tau_mask=0.0, method="eigh", iters=1024,
+                          mesh=None):
     """Jitted batched retrieval of one frequency row of chunks:
     ``chunks[B, nf, nt]`` → complex wavefield chunks ``[B, nf, nt]``
     (host numpy). One compile per chunk geometry — edges/η are traced,
-    so every row of the retrieval grid reuses the same program."""
+    so every row of the retrieval grid reuses the same program.
+
+    ``mesh``: optional ``jax.sharding.Mesh`` — the chunk batch axis is
+    sharded over EVERY mesh device (the SPMD replacement for the
+    reference's retrieval pool.map, dynspec.py:1812-1826); the batch
+    is zero-padded up to a device multiple and cropped after."""
     jax = get_jax()
     import jax.numpy as jnp
 
@@ -280,9 +286,23 @@ def chunk_retrieval_batch(chunks, edges, eta, dt, df, npad=3,
         lambda: make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df,
                                         len(edges), npad=npad,
                                         method=method, iters=iters))
-    E_ri = np.asarray(fn(jnp.asarray(chunks), jnp.asarray(edges),
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ndev = int(np.prod(list(mesh.shape.values())))
+        pad_b = (-B) % ndev
+        if pad_b:  # pad host-side so each shard transfers straight
+            chunks = np.concatenate(
+                [chunks, np.zeros((pad_b, nf_chunk, nt_chunk))],
+                axis=0)
+        dev = jax.device_put(
+            chunks,
+            NamedSharding(mesh, P(tuple(mesh.shape), None, None)))
+    else:
+        dev = jnp.asarray(chunks)
+    E_ri = np.asarray(fn(dev, jnp.asarray(edges),
                          float(unit_checks(eta, "eta")),
-                         float(tau_mask)))
+                         float(tau_mask)))[:B]
     return E_ri[:, 0] + 1j * E_ri[:, 1]
 
 

@@ -124,6 +124,16 @@ def main():
     out["rickett_tn"] = np.asarray(acf_obj.tn, dtype=np.float64)
     out["rickett_fn"] = np.asarray(acf_obj.fn, dtype=np.float64)
 
+    # ---- 6. Brightness delay-Doppler spectrum (scipy griddata) ------
+    br = ss.Brightness(ar=2.0, psi=30, alpha=1.67, thetagx=0.3,
+                       thetagy=0.3, thetarx=0.3, thetary=0.3,
+                       df=0.05, dt=0.2, dx=0.2, nf=4, nt=16, nx=10,
+                       plot=False)
+    out["bright_SS"] = np.asarray(br.SS, dtype=np.float64)
+    out["bright_fd"] = np.asarray(br.fd, dtype=np.float64)
+    out["bright_td"] = np.asarray(br.td, dtype=np.float64)
+    out["bright_acf"] = np.asarray(br.acf, dtype=np.float64)
+
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     np.savez_compressed(OUT, **out)
     size = os.path.getsize(OUT) / 1e6

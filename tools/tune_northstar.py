@@ -1,0 +1,82 @@
+"""Sweep the north-star jax pipeline's HBM group size on the real
+chip (no numpy baseline pass — that's ~4 min of wall per run and
+unchanged by the knob). Prints one line per (group, method) with the
+best wall time so the default in bench.py:bench_north_star can be set
+from data.
+
+Problem AND pipeline come from bench.py (make_north_star_problem /
+make_north_star_pipeline), so this times exactly the benched program.
+
+Run (solo on the chip!):  python tools/tune_northstar.py [--size 4096]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=4096)
+    ap.add_argument("--groups", default="4,8,16,32")
+    ap.add_argument("--methods", default="auto")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform (env vars alone are "
+                         "not honoured once the axon plugin registers)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from scintools_tpu.backend import force_cpu_platform
+
+        force_cpu_platform()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import make_north_star_problem, make_north_star_pipeline
+
+    print(f"platform: {jax.default_backend()}")
+    nf = nt = args.size
+    # one extra variant beyond reps: the warm-up call gets its own
+    # buffers, so no timed rep ever reuses a bit-identical input (the
+    # tunneled TPU serves such repeats from a cache in ~0 ms)
+    prob = make_north_star_problem(nf, nt, n_variants=args.reps + 1)
+    n_chunks = (nf // prob["cf"]) * (nt // prob["ct"])
+    e_j = jnp.asarray(prob["etas"])
+    jvariants = [(jnp.asarray(d, dtype=jnp.float32), e_j)
+                 for d in prob["dyns"]]
+
+    for method in args.methods.split(","):
+        for group in [int(g) for g in args.groups.split(",")]:
+            if n_chunks % group:
+                print(f"method={method:6s} group={group:3d}  skipped "
+                      f"(does not divide the {n_chunks}-chunk grid)")
+                continue
+            # the EXACT program bench_north_star times
+            pipe = make_north_star_pipeline(
+                jax, jnp, nf, nt, prob["cf"], prob["ct"], prob["npad"],
+                prob["wins"], prob["tau"], prob["fd"], prob["edges"],
+                group, method=method)
+
+            t0 = time.perf_counter()
+            jax.block_until_ready(pipe(*jvariants[-1]))  # warm-up only
+            compile_s = time.perf_counter() - t0
+            best = np.inf
+            for r in range(args.reps):
+                a = jvariants[r % (len(jvariants) - 1)]
+                t0 = time.perf_counter()
+                jax.block_until_ready(pipe(*a))
+                best = min(best, time.perf_counter() - t0)
+            print(f"method={method:6s} group={group:3d}  "
+                  f"compile={compile_s:6.1f}s  best={best:7.3f}s  "
+                  f"({nf * nt / best:,.0f} px/s)")
+
+
+if __name__ == "__main__":
+    main()
