@@ -1619,26 +1619,56 @@ class Dynspec:
                 (self.ncf_ret, self.nct_ret, self.cwf, self.cwt),
                 dtype=complex)
         if self.backend == "jax":
-            # one jitted program per chunk geometry, batched over the
-            # time-chunks of each frequency row (edges/η are traced, so
-            # every row reuses the same compile); complex wavefields
-            # stay inside the program — never dropped to numpy
+            # the half-overlap grid as jitted batched programs:
+            # per-chunk η/edges are traced (batch axis), so every grid
+            # reuses one compile and the chunk axis shards over the
+            # mesh; complex wavefields stay inside the program. With
+            # memmap the grid is dispatched row-by-row so only one
+            # frequency row of chunks is ever resident in host RAM.
             dt = self.times[1] - self.times[0]
             df = self.freqs[1] - self.freqs[0]
-            for cf in range(self.ncf_ret):
+
+            def row_inputs(cf):
                 row = []
                 for ct in range(self.nct_ret):
                     dspec2, freq2, _ = self._chunk(cf, ct, fit=False)
                     row.append(dspec2)
                 freq = freq2.mean()
                 eta = self.ththeta * (self.fref / freq) ** 2
-                self.chunks[cf] = thth_ret.chunk_retrieval_batch(
-                    np.stack(row), self.edges * (freq / self.fref),
-                    eta, dt, df, npad=self.npad,
-                    tau_mask=self.thth_tau_mask, mesh=mesh)
-                if verbose:
-                    print(f"retrieved row {cf + 1}/{self.ncf_ret} "
-                          f"({self.nct_ret} chunks, eta={eta:.4g})")
+                edges = self.edges * (freq / self.fref)
+                return np.stack(row), edges, eta
+
+            if memmap:
+                for cf in range(self.ncf_ret):
+                    row, edges, eta = row_inputs(cf)
+                    self.chunks[cf] = thth_ret.grid_retrieval_batch(
+                        row, np.tile(edges, (self.nct_ret, 1)),
+                        np.full(self.nct_ret, eta), dt, df,
+                        npad=self.npad, tau_mask=self.thth_tau_mask,
+                        mesh=mesh)
+                    if verbose:
+                        print(f"retrieved row {cf + 1}/"
+                              f"{self.ncf_ret} ({self.nct_ret} "
+                              f"chunks, eta={eta:.4g})")
+                return
+            flat, edges_per, etas_per = [], [], []
+            for cf in range(self.ncf_ret):
+                row, edges, eta = row_inputs(cf)
+                flat.append(row)
+                edges_per.extend([edges] * self.nct_ret)
+                etas_per.extend([eta] * self.nct_ret)
+            if verbose:
+                print(f"retrieving {self.ncf_ret}x{self.nct_ret} "
+                      f"chunk grid in one batched program...")
+            E = thth_ret.grid_retrieval_batch(
+                np.concatenate(flat), np.stack(edges_per),
+                np.asarray(etas_per), dt, df, npad=self.npad,
+                tau_mask=self.thth_tau_mask, mesh=mesh)
+            self.chunks[:] = E.reshape(self.ncf_ret, self.nct_ret,
+                                       self.cwf, self.cwt)
+            if verbose:
+                print(f"retrieved {self.ncf_ret * self.nct_ret} "
+                      f"chunks")
             return
         if pool is not None:
             jobs = []

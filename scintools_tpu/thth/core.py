@@ -131,6 +131,13 @@ def thth_redmap(CS, tau, fd, eta, edges, hermetian=True, backend=None):
     thth = np.asarray(thth_map(CS, tau, fd, eta, edges,
                                hermetian=hermetian, backend=backend))
     th_pnts = redmap_mask(tau, fd, eta, edges)
+    if np.count_nonzero(th_pnts) < 2:
+        # non-finite or out-of-range η leaves no valid θ-θ square; a
+        # clear error here is caught by the retrieval chunk guard
+        # (retrieval.py single_chunk_retrieval) instead of an
+        # IndexError from the empty crop
+        raise ValueError(
+            f"thth_redmap: no valid theta-theta region for eta={eta}")
     th_cents = th_cents_from_edges(unit_checks(edges, "edges"))
     thth_red = thth[th_pnts, :][:, th_pnts]
     cents_red = th_cents[th_pnts]

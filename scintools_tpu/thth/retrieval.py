@@ -272,37 +272,115 @@ def chunk_retrieval_batch(chunks, edges, eta, dt, df, npad=3,
     ``mesh``: optional ``jax.sharding.Mesh`` — the chunk batch axis is
     sharded over EVERY mesh device (the SPMD replacement for the
     reference's retrieval pool.map, dynspec.py:1812-1826); the batch
-    is zero-padded up to a device multiple and cropped after."""
+    is zero-padded up to a device multiple and cropped after.
+
+    Delegates to :func:`grid_retrieval_batch` with the row's shared
+    η/edges broadcast per chunk (one shard-placement/grouping
+    implementation for both entry points)."""
+    chunks = np.asarray(chunks, dtype=float)
+    B = chunks.shape[0]
+    edges = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    return grid_retrieval_batch(
+        chunks, np.tile(edges, (B, 1)),
+        np.full(B, float(unit_checks(eta, "eta"))), dt, df,
+        npad=npad, tau_mask=tau_mask, method=method, iters=iters,
+        mesh=mesh)
+
+
+def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
+                         tau_mask=0.0, method="eigh", iters=1024,
+                         mesh=None, group=None):
+    """Whole-retrieval-grid program: ``chunks[N, nf, nt]`` with
+    PER-CHUNK ``edges_per[N, n_edges]`` and ``etas_per[N]`` → complex
+    wavefield chunks ``[N, nf, nt]``. One jitted dispatch for the
+    entire half-overlap grid (vs one per frequency row), with the
+    chunk axis walked in HBM-sized ``group``s by ``lax.map`` (bounding
+    live intermediates the way bench.py's north-star pipeline does)
+    and each group shardable over every mesh device — the end-state
+    SPMD form of the reference's retrieval pool.map
+    (dynspec.py:1812-1826).
+
+    ``group`` (chunks live per ``lax.map`` step, the HBM working-set
+    knob) defaults to: the whole batch when ≤ max(32, n_devices);
+    otherwise the largest divisor of the padded batch ≤ that cap
+    (zero padding waste), falling back to balanced ceil-groups for
+    awkward batch sizes."""
     jax = get_jax()
     import jax.numpy as jnp
 
     chunks = np.asarray(chunks, dtype=float)
-    B, nf_chunk, nt_chunk = chunks.shape
-    edges = np.asarray(unit_checks(edges, "edges"), dtype=float)
-    key = (nf_chunk, nt_chunk, float(dt), float(df), len(edges),
-           int(npad), method, int(iters))
-    fn = keyed_jit_cache(
-        _RETRIEVAL_JIT_CACHE, key,
-        lambda: make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df,
-                                        len(edges), npad=npad,
-                                        method=method, iters=iters))
+    N, nf_chunk, nt_chunk = chunks.shape
+    edges_per = np.asarray(edges_per, dtype=float)
+    etas_per = np.asarray(etas_per, dtype=float)
+    ndev = (int(np.prod(list(mesh.shape.values())))
+            if mesh is not None else 1)
+    if group is None:
+        # zero-waste group choice: one batch when it fits under the
+        # HBM cap; else the largest non-trivial divisor of the
+        # (device-multiple-padded) batch; else balanced ceil groups
+        # (pad < n_steps) — never a degenerate group of 1 for a large
+        # batch and never cap-1 discarded retrievals
+        cap = max(32, ndev)
+        n_p = max(N, 1) + ((-max(N, 1)) % ndev)
+        if n_p <= cap:
+            group = n_p               # one batch, device-pad only
+        else:
+            floor = max(ndev, min(8, cap))
+            divisors = [g for g in range(floor, cap + 1)
+                        if n_p % g == 0 and g % ndev == 0]
+            if divisors:
+                group = divisors[-1]
+            else:
+                steps = -(-n_p // cap)
+                group = -(-n_p // steps)
+        group += (-group) % ndev
+    group = min(group, max(N, 1))
+    group += (-group) % ndev            # device multiple
+    key = ("grid", nf_chunk, nt_chunk, float(dt), float(df),
+           edges_per.shape[1], int(npad), method, int(iters),
+           int(group))
+
+    def build():
+        core = make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df,
+                                       edges_per.shape[1], npad=npad,
+                                       method=method, iters=iters)
+
+        def one(c, e, et, tm):
+            return core(c[None], e, et, tm)[0]
+
+        vm = jax.vmap(one, in_axes=(0, 0, 0, None))
+        return lambda cg, eg, etg, tm: jax.lax.map(
+            lambda args: vm(*args, tm), (cg, eg, etg))
+
+    fn = keyed_jit_cache(_RETRIEVAL_JIT_CACHE, key, build)
+
+    pad_n = (-N) % group
+    if pad_n:                           # host-side pad: each shard of
+        chunks = np.concatenate(        # a group transfers straight
+            [chunks, np.zeros((pad_n, nf_chunk, nt_chunk))], 0)
+        edges_per = np.concatenate(
+            [edges_per, np.tile(edges_per[-1:], (pad_n, 1))], 0)
+        etas_per = np.concatenate(
+            [etas_per, np.full(pad_n, etas_per[-1])], 0)
+    ng = len(chunks) // group
+    cg = chunks.reshape(ng, group, nf_chunk, nt_chunk)
+    eg = edges_per.reshape(ng, group, -1)
+    etg = etas_per.reshape(ng, group)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        ndev = int(np.prod(list(mesh.shape.values())))
-        pad_b = (-B) % ndev
-        if pad_b:  # pad host-side so each shard transfers straight
-            chunks = np.concatenate(
-                [chunks, np.zeros((pad_b, nf_chunk, nt_chunk))],
-                axis=0)
-        dev = jax.device_put(
-            chunks,
-            NamedSharding(mesh, P(tuple(mesh.shape), None, None)))
+        axes = tuple(mesh.shape)
+
+        def put(x, spec):
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        cg = put(cg, P(None, axes, None, None))
+        eg = put(eg, P(None, axes, None))
+        etg = put(etg, P(None, axes))
     else:
-        dev = jnp.asarray(chunks)
-    E_ri = np.asarray(fn(dev, jnp.asarray(edges),
-                         float(unit_checks(eta, "eta")),
-                         float(tau_mask)))[:B]
+        cg, eg, etg = map(jnp.asarray, (cg, eg, etg))
+    E_ri = np.asarray(fn(cg, eg, etg, float(tau_mask)))
+    E_ri = E_ri.reshape(ng * group, 2, nf_chunk, nt_chunk)[:N]
     return E_ri[:, 0] + 1j * E_ri[:, 1]
 
 

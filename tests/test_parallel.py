@@ -248,3 +248,43 @@ class TestShardedRetrieval:
         ds.calc_wavefield(mesh=mesh)
         assert ds.wavefield.shape[0] > 0
         assert np.isfinite(ds.wavefield).all()
+
+    def test_grid_retrieval_matches_per_row(self, mesh):
+        """grid_retrieval_batch (one dispatch, per-chunk eta/edges)
+        equals per-row chunk_retrieval_batch calls, with and without
+        the mesh."""
+        from scintools_tpu.thth.retrieval import (chunk_retrieval_batch,
+                                                  grid_retrieval_batch)
+        from tests.test_thth import (ETA_TRUE, make_arc_dspec,
+                                     make_arc_edges)
+
+        dspec0, times, freqs = make_arc_dspec(nt=32, nf=32, npix=6)
+        edges = make_arc_edges(nt=32, half=6)
+        rng = np.random.default_rng(29)
+        rows = 2
+        B = 3
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        all_chunks, edges_per, etas_per, per_row = [], [], [], []
+        for r in range(rows):
+            eta_r = ETA_TRUE * (1 + 0.1 * r)
+            edges_r = edges * (1 + 0.05 * r)
+            row = np.stack([dspec0 + 1e-9 * (r * B + i)
+                            * rng.standard_normal(dspec0.shape)
+                            for i in range(B)])
+            per_row.append(chunk_retrieval_batch(
+                row, edges_r, eta_r, dt, df, npad=1))
+            all_chunks.append(row)
+            edges_per.extend([edges_r] * B)
+            etas_per.extend([eta_r] * B)
+        expect = np.concatenate(per_row)
+        flat = np.concatenate(all_chunks)
+        for m in (None, mesh):
+            got = grid_retrieval_batch(flat, np.stack(edges_per),
+                                       np.asarray(etas_per), dt, df,
+                                       npad=1, mesh=m)
+            assert got.shape == expect.shape
+            for b in range(len(expect)):
+                num = np.abs(np.vdot(got[b], expect[b]))
+                den = (np.linalg.norm(got[b])
+                       * np.linalg.norm(expect[b]) + 1e-30)
+                assert num / den > 1 - 1e-6, f"mesh={m is not None} b={b}"
