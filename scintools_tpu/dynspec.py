@@ -1641,34 +1641,34 @@ class Dynspec:
             if memmap:
                 for cf in range(self.ncf_ret):
                     row, edges, eta = row_inputs(cf)
-                    self.chunks[cf] = thth_ret.grid_retrieval_batch(
-                        row, np.tile(edges, (self.nct_ret, 1)),
-                        np.full(self.nct_ret, eta), dt, df,
-                        npad=self.npad, tau_mask=self.thth_tau_mask,
-                        mesh=mesh)
+                    self.chunks[cf] = thth_ret.chunk_retrieval_batch(
+                        row, edges, eta, dt, df, npad=self.npad,
+                        tau_mask=self.thth_tau_mask, mesh=mesh)
                     if verbose:
                         print(f"retrieved row {cf + 1}/"
                               f"{self.ncf_ret} ({self.nct_ret} "
                               f"chunks, eta={eta:.4g})")
                 return
-            flat, edges_per, etas_per = [], [], []
+            n_grid = self.ncf_ret * self.nct_ret
+            flat = np.empty((n_grid, self.cwf, self.cwt))
+            edges_per = np.empty((n_grid, len(self.edges)))
+            etas_per = np.empty(n_grid)
             for cf in range(self.ncf_ret):
                 row, edges, eta = row_inputs(cf)
-                flat.append(row)
-                edges_per.extend([edges] * self.nct_ret)
-                etas_per.extend([eta] * self.nct_ret)
+                sl = slice(cf * self.nct_ret, (cf + 1) * self.nct_ret)
+                flat[sl] = row
+                edges_per[sl] = edges
+                etas_per[sl] = eta
             if verbose:
                 print(f"retrieving {self.ncf_ret}x{self.nct_ret} "
                       f"chunk grid in one batched program...")
             E = thth_ret.grid_retrieval_batch(
-                np.concatenate(flat), np.stack(edges_per),
-                np.asarray(etas_per), dt, df, npad=self.npad,
+                flat, edges_per, etas_per, dt, df, npad=self.npad,
                 tau_mask=self.thth_tau_mask, mesh=mesh)
             self.chunks[:] = E.reshape(self.ncf_ret, self.nct_ret,
                                        self.cwf, self.cwt)
             if verbose:
-                print(f"retrieved {self.ncf_ret * self.nct_ret} "
-                      f"chunks")
+                print(f"retrieved {n_grid} chunks")
             return
         if pool is not None:
             jobs = []

@@ -131,7 +131,7 @@ def thth_redmap(CS, tau, fd, eta, edges, hermetian=True, backend=None):
     thth = np.asarray(thth_map(CS, tau, fd, eta, edges,
                                hermetian=hermetian, backend=backend))
     th_pnts = redmap_mask(tau, fd, eta, edges)
-    if np.count_nonzero(th_pnts) < 2:
+    if np.count_nonzero(th_pnts) < 3:  # <3 leaves no finite edge step
         # non-finite or out-of-range η leaves no valid θ-θ square; a
         # clear error here is caught by the retrieval chunk guard
         # (retrieval.py single_chunk_retrieval) instead of an

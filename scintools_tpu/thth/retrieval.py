@@ -325,7 +325,7 @@ def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
         if n_p <= cap:
             group = n_p               # one batch, device-pad only
         else:
-            floor = max(ndev, min(8, cap))
+            floor = max(ndev, 8)
             divisors = [g for g in range(floor, cap + 1)
                         if n_p % g == 0 and g % ndev == 0]
             if divisors:

@@ -143,3 +143,21 @@ class TestPoolParity:
         plotting.plot_eta_evolution(dyn, filename=str(fig_out),
                                     display=False)
         assert fig_out.exists()
+
+
+class TestArcAndNormSspecPlots:
+    def test_fit_arc_plot_kwarg(self, dyn, tmp_path):
+        out = tmp_path / "arcfit.png"
+        try:
+            dyn.fit_arc(plot=True, filename=str(out), display=False,
+                        numsteps=500)
+        except RuntimeError:
+            pytest.skip("no arc in synthetic smoke data")
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_norm_sspec_plot_kwarg(self, dyn, tmp_path):
+        # pass eta explicitly — never mutate the module-scoped fixture
+        out = tmp_path / "normsspec.png"
+        dyn.norm_sspec(eta=1.0, plot=True, filename=str(out),
+                       display=False, numsteps=100)
+        assert out.exists() and out.stat().st_size > 0

@@ -1,0 +1,79 @@
+"""Real-chip smoke: the end-to-end θ-θ drive on actual J0437 data,
+jax-vs-numpy (the .claude/skills/verify recipe). Run SOLO on the chip
+after the tunnel recovers, before benching.
+
+Covers the surfaces CPU tests can't: complex-transfer discipline at
+program boundaries, the Pallas warm-start batch kernel, and this
+round's whole-grid retrieval — all on the axon TPU.
+
+Run:  python tools/tpu_smoke.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+J0437 = os.environ.get(
+    "SCINTOOLS_SMOKE_DATA",
+    "/root/reference/scintools/examples/data/J0437-4715/"
+    "p111220_074112.rf.pcm.dynspec")
+
+
+def run(backend):
+    from scintools_tpu.dynspec import Dynspec
+
+    ds = Dynspec(filename=J0437, process=False, verbose=False,
+                 backend=backend)
+    ds.crop_dyn(1270, 1500)
+    ds.refill()
+    ds.prep_thetatheta(cwf=128, cwt=60, eta_min=0.05, eta_max=5.0,
+                       neta=120, nedge=128)
+    t0 = time.perf_counter()
+    ds.fit_thetatheta()
+    t_fit = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ds.calc_wavefield()
+    t_wave = time.perf_counter() - t0
+    return ds, t_fit, t_wave
+
+
+def main():
+    if not os.path.exists(J0437):
+        raise SystemExit(
+            f"sample epoch not found: {J0437}\n"
+            "set SCINTOOLS_SMOKE_DATA to a psrflux dynspec file")
+    import jax
+
+    print(f"platform: {jax.default_backend()}")
+    ds_j, tj_fit, tj_wave = run("jax")
+    print(f"jax:   ththeta={ds_j.ththeta:.4f} ± {ds_j.ththetaerr:.4f}"
+          f"  fit={tj_fit:.2f}s  wavefield={tj_wave:.2f}s")
+    ds_n, tn_fit, tn_wave = run("numpy")
+    print(f"numpy: ththeta={ds_n.ththeta:.4f} ± {ds_n.ththetaerr:.4f}"
+          f"  fit={tn_fit:.2f}s  wavefield={tn_wave:.2f}s")
+    rel = abs(ds_j.ththeta - ds_n.ththeta) / abs(ds_n.ththeta)
+    print(f"cross-backend ththeta rel diff: {rel:.2%} "
+          f"(expect <1%; skill-recorded value ~0.0595)")
+    # both finite-eta grids should agree where both fitted
+    both = np.isfinite(ds_j.eta_evo) & np.isfinite(ds_n.eta_evo)
+    if both.any():
+        d = np.abs(ds_j.eta_evo[both] - ds_n.eta_evo[both])
+        s = np.maximum(ds_j.eta_evo_err[both], 1e-12)
+        print(f"per-chunk |Δη|/σ: median "
+              f"{np.median(d / s):.3f} over {both.sum()} chunks")
+    # wavefield power sanity: |W|² lives on the dynspec scale
+    wf = ds_j.wavefield
+    dyn_crop = ds_j.dyn[:wf.shape[0], :wf.shape[1]]
+    ratio = float(np.mean(np.abs(wf) ** 2) / np.mean(dyn_crop))
+    print(f"wavefield {wf.shape}, mean |W|^2 / mean dyn = {ratio:.3g}")
+    assert 0.01 < ratio < 100, "wavefield power scale is off"
+    assert rel < 0.01, "cross-backend curvature disagrees >1%"
+    print("TPU smoke OK")
+
+
+if __name__ == "__main__":
+    main()
