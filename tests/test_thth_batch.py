@@ -220,3 +220,53 @@ class TestGridEval:
             ref = np.asarray(row_fn(cs_b[b:b + 1],
                                     jnp.asarray(etas_b[b])))[0]
             np.testing.assert_allclose(out[b], ref, rtol=2e-3)
+
+
+class TestNorthStarGeometry:
+    def test_eval_at_256_edges_matches_numpy(self):
+        """The jitted eval path at the BENCH north-star θ-θ geometry
+        (256 edges → 255² matrices, 512² chunk at npad=1) agrees with
+        the host scipy-eigsh path — the same cross-check bench.py
+        gates the headline on, pinned here at the exact geometry so a
+        regression shows up before a TPU run."""
+        import jax.numpy as jnp
+
+        from bench import make_north_star_problem
+        from scintools_tpu.thth.core import (cs_to_ri, eval_calc_batch,
+                                             make_eval_fn)
+        from scintools_tpu.thth.search import fit_eig_peak
+
+        # the EXACT benched geometry, single-sourced (one 512² chunk
+        # of it and a subsampled η grid keep the CPU cost down)
+        prob = make_north_star_problem(512, 512, n_variants=1)
+        cf, ct, npad = prob["cf"], prob["ct"], prob["npad"]
+        tau, fd, edges = prob["tau"], prob["fd"], prob["edges"]
+        etas = prob["etas"][::17]            # 200 → 12 samples
+        chunk = prob["dyns"][0][:cf, :ct]
+        chunk = chunk - chunk.mean()
+        pad = np.pad(chunk, ((0, npad * cf), (0, npad * ct)),
+                     constant_values=chunk.mean())
+        CS = np.fft.fftshift(np.fft.fft2(pad))
+        assert len(edges) == 256             # the headline resolution
+
+        ref = eval_calc_batch(CS, tau, fd, etas, edges,
+                              backend="numpy")
+        fn = make_eval_fn(tau, fd, edges, iters=200)
+        got = np.asarray(fn(jnp.asarray(cs_to_ri(CS)
+                                        .astype(np.float32)),
+                            jnp.asarray(etas)))
+        # raw curve: off-peak η have near-degenerate spectra where the
+        # fixed-iteration power method lands ~0.5% low; the bench's
+        # actual gate is the fitted peak, asserted strictly below
+        # (compared peak-normalised: off-peak η have near-degenerate
+        # spectra where the fixed-iteration power method lands ~1%
+        # low; what matters for the parabola fit is the shape near
+        # the maximum, and the fitted peak is asserted strictly)
+        assert np.isfinite(ref).all() and np.isfinite(got).all()
+        scale = np.max(ref)
+        np.testing.assert_allclose(got / scale, ref / scale,
+                                   atol=2.5e-2)
+        # the curvature peak itself agrees to <1% (the north-star gate)
+        eta_np, _ = fit_eig_peak(etas, ref, fw=0.3)
+        eta_jx, _ = fit_eig_peak(etas, got, fw=0.3)
+        assert abs(eta_jx - eta_np) < 0.01 * abs(eta_np)
