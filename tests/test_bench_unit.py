@@ -27,7 +27,9 @@ class TestMakeArcDynspec:
         dyn = bench.make_arc_dynspec(nt, nf, dt, df, f0, eta_true,
                                      n_images=48, seed=9)
         assert dyn.shape == (nf, nt)
-        assert np.isfinite(dyn).all() and dyn.min() >= dyn.max() * -1
+        assert np.isfinite(dyn).all()
+        # the 2% noise floor must not dominate the interference signal
+        assert dyn.min() >= -0.5 * dyn.max()
 
         d = dyn - dyn.mean()
         sec = np.abs(np.fft.fftshift(np.fft.fft2(d))) ** 2
@@ -37,7 +39,7 @@ class TestMakeArcDynspec:
         # positive-delay half, the power-weighted delay should track
         # eta*fd^2
         pos = tau > 0
-        sec_p = sec[pos][:, :]
+        sec_p = sec[pos]
         tau_p = tau[pos]
         col_pow = sec_p.sum(axis=0)
         cols = (np.abs(fd) > 5) & (np.abs(fd) < 60) & (
@@ -93,6 +95,7 @@ class TestProbe:
                    SCINTOOLS_BENCH_PROBE_TIMEOUT="5",
                    SCINTOOLS_BENCH_PROBE_SLEEP="0",
                    JAX_PLATFORMS="definitely_not_a_platform")
+        env.pop("SCINTOOLS_BENCH_NO_PROBE", None)  # ambient dev knob
         out = subprocess.run(
             [sys.executable, "-c",
              "import sys, json; sys.path.insert(0, %r);"
