@@ -127,10 +127,14 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     explicit = addr is not None
     if addr:
         kwargs["coordinator_address"] = addr
+        # explicit arguments win over the environment; 0 is a valid
+        # process_id, so test identity against None, not truthiness
         kwargs["num_processes"] = int(
-            num_processes or os.environ.get("NUM_PROCESSES", 1))
+            num_processes if num_processes is not None
+            else os.environ.get("NUM_PROCESSES", 1))
         kwargs["process_id"] = int(
-            process_id or os.environ.get("PROCESS_ID", 0))
+            process_id if process_id is not None
+            else os.environ.get("PROCESS_ID", 0))
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
