@@ -22,6 +22,8 @@ WORKER = textwrap.dedent("""
     sys.path.insert(0, {repo!r})
     from scintools_tpu.backend import force_cpu_platform
     force_cpu_platform(4)
+    import jax
+    jax.config.update("jax_enable_x64", True)  # f64 like conftest
     from scintools_tpu.parallel.checkpoint import initialize_distributed
     initialize_distributed({addr!r}, 2, {pid})
     import jax
@@ -42,6 +44,23 @@ WORKER = textwrap.dedent("""
                                            if idx[0].start else 0)))
     total = float(jax.jit(jnp.sum)(arr))
     assert total == 16 * sum(range(8)), total
+
+    # distributed FFT: the seq-axis all_to_all transpose crosses the
+    # process boundary (both processes hold seq shards)
+    rng = np.random.default_rng(7)
+    dyn_host = rng.standard_normal((16, 16))
+    fft_fn = par.make_fft2_sharded(mesh)
+    fft_sh = NamedSharding(mesh, P("data", "seq", None))
+    batch = jax.make_array_from_callback(
+        (4, 16, 16), fft_sh,
+        lambda idx: dyn_host[None, idx[1], :])
+    out = jax.jit(fft_fn)(batch)
+    from jax.experimental import multihost_utils
+    got = np.asarray(multihost_utils.process_allgather(
+        out, tiled=True))[0]
+    expect = np.fft.fft2(dyn_host)
+    assert np.allclose(got.real, expect.real, atol=1e-8)
+    assert np.allclose(got.imag, expect.imag, atol=1e-8)
     print("WORKER_OK", {pid}, total)
 """)
 
@@ -82,12 +101,8 @@ def test_two_process_global_mesh_collective(tmp_path):
             out, err = p.communicate()
         outs.append((p.returncode, out.decode(), err.decode()))
     if timed_out:
-        # surface every worker's stderr — the hung one usually isn't
-        # the one that broke
-        for q in procs:
-            if q.stderr and not q.stderr.closed:
-                outs.append((q.returncode, "",
-                             q.stderr.read().decode()))
+        # every worker's stderr is already drained into outs by
+        # communicate(); the hung one usually isn't the one that broke
         tails = "\n---\n".join(e[-1500:] for _, _, e in outs)
         pytest.fail(f"multi-host worker timed out; stderr tails:\n"
                     f"{tails}")
