@@ -288,3 +288,38 @@ class TestShardedRetrieval:
                 den = (np.linalg.norm(got[b])
                        * np.linalg.norm(expect[b]) + 1e-30)
                 assert num / den > 1 - 1e-6, f"mesh={m is not None} b={b}"
+
+
+class TestShardedEnsemble:
+    def test_walker_sharded_mcmc_matches_unsharded(self, mesh):
+        """The jitted ensemble sampler runs with the walker axis
+        sharded over all 8 devices (SURVEY §2.6 'sharded ensemble'):
+        same key → bit-comparable chain, XLA inserting the collectives
+        the complementary-half stretch move needs."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from scintools_tpu.fit.ensemble import make_ensemble_sampler
+
+        def logp(x):
+            return -0.5 * jnp.sum(x ** 2)
+
+        nwalkers, ndim, steps = 64, 3, 40
+        run = make_ensemble_sampler(logp, nwalkers, ndim)
+        key = jax.random.PRNGKey(0)
+        pos0 = jax.random.normal(jax.random.PRNGKey(1),
+                                 (nwalkers, ndim))
+
+        chain_plain, lps_plain, acc_plain = run(key, pos0, steps)
+
+        sharded = jax.device_put(
+            pos0, NamedSharding(mesh, P(("data", "seq"), None)))
+        chain_sh, lps_sh, acc_sh = run(key, sharded, steps)
+
+        np.testing.assert_allclose(np.asarray(chain_sh),
+                                   np.asarray(chain_plain),
+                                   rtol=1e-6, atol=1e-9)
+        assert abs(float(acc_sh) - float(acc_plain)) < 1e-6
+        # sanity: the sampler actually moved and accepted
+        assert 0.1 < float(acc_plain) < 0.99
