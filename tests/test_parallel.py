@@ -320,6 +320,9 @@ class TestShardedEnsemble:
         np.testing.assert_allclose(np.asarray(chain_sh),
                                    np.asarray(chain_plain),
                                    rtol=1e-6, atol=1e-9)
+        np.testing.assert_allclose(np.asarray(lps_sh),
+                                   np.asarray(lps_plain),
+                                   rtol=1e-6, atol=1e-9)
         assert abs(float(acc_sh) - float(acc_plain)) < 1e-6
         # sanity: the sampler actually moved and accepted
         assert 0.1 < float(acc_plain) < 0.99
