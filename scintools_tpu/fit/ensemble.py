@@ -21,7 +21,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..backend import get_jax
-from .fitter import MinimizerResult, _residual_vector
+from .fitter import (MinimizerResult, _attach_chain_covar,
+                     _residual_vector)
 
 
 def make_logp(model, params, args, is_weighted=True, backend="jax"):
@@ -178,4 +179,5 @@ def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
     result.flatchain = flat
     result.var_names = names
     result.acceptance_fraction = float(acc_frac)
+    _attach_chain_covar(result, flat, params)
     return result

@@ -42,7 +42,12 @@ class MinimizerResult:
             self.redchi = self.chisqr / self.nfree
         self.flatchain = None
 
-    def fit_report(self):
+    def fit_report(self, min_correl=0.1):
+        """lmfit-style text report. The reference stores lmfit's full
+        ``fit_report`` — including the parameter-correlations table —
+        on the Dynspec (dynspec.py:2956-2961); reproduce that layout:
+        correlations from the covariance, largest first, pairs below
+        ``min_correl`` unreported."""
         lines = [f"[[Fit]] success={self.success} nfev={self.nfev}"]
         if hasattr(self, "chisqr"):
             lines.append(f"chi-square={self.chisqr:.6g} "
@@ -51,7 +56,34 @@ class MinimizerResult:
             err = "None" if par.stderr is None else f"{par.stderr:.4g}"
             lines.append(f"  {name}: {par.value:.6g} +/- {err}"
                          f" ({'vary' if par.vary else 'fixed'})")
+        covar = getattr(self, "covar", None)
+        names = self.params.varying_names()
+        if covar is not None and len(names) == np.shape(covar)[0] > 1:
+            sig = np.sqrt(np.abs(np.diagonal(covar)))
+            pairs = []
+            for i in range(len(names)):
+                for j in range(i + 1, len(names)):
+                    denom = sig[i] * sig[j]
+                    if denom > 0:
+                        c = float(covar[i, j] / denom)
+                        if abs(c) >= min_correl:
+                            pairs.append((abs(c), names[i], names[j], c))
+            if pairs:
+                lines.append("[[Correlations]] (unreported "
+                             f"correlations are < {min_correl:.3f})")
+                for _, n1, n2, c in sorted(pairs, reverse=True):
+                    lines.append(f"  C({n1}, {n2}) = {c:+.4f}")
         return "\n".join(lines)
+
+
+def _attach_chain_covar(result, flat, params):
+    """Chain-derived covariance over the model parameters (excluding
+    any trailing __lnsigma column) so fit_report can print a
+    correlations table for MCMC fits too, as lmfit's emcee result
+    does. Shared by the host and jax samplers."""
+    nmodel = len(params.varying_names())
+    if nmodel > 1 and flat.shape[0] > 1:
+        result.covar = np.cov(flat[:, :nmodel], rowvar=False)
 
 
 def _residual_vector(model, params, args):
@@ -209,6 +241,7 @@ def sample_emcee(model, params, args=(), nwalkers=100, steps=1000,
                              nextra_vary=0 if is_weighted else 1)
     result.flatchain = flat
     result.var_names = names
+    _attach_chain_covar(result, flat, params)
     return result
 
 

@@ -1,0 +1,68 @@
+"""Fit-report parity: the reference keeps lmfit's full ``fit_report``
+— values, stderr AND the parameter-correlations table — on the
+Dynspec (/root/reference/scintools/dynspec.py:2956-2961). Pin that the
+self-contained fitter reproduces the correlations section."""
+
+import numpy as np
+
+from scintools_tpu.fit.fitter import minimize_leastsq, sample_emcee
+from scintools_tpu.fit.parameters import Parameters
+
+
+def _line(params, x, y):
+    return params["a"].value * x + params["b"].value - y
+
+
+def _make_line_fit():
+    rng = np.random.default_rng(3)
+    x = np.linspace(1.0, 3.0, 60)          # positive x: a/b strongly
+    y = 2.0 * x + 1.0 + 0.05 * rng.standard_normal(x.size)
+    p = Parameters()
+    p.add("a", value=1.0)
+    p.add("b", value=0.0)
+    return _line, p, (x, y)
+
+
+class TestFitReportCorrelations:
+    def test_known_correlated_pair_reported(self):
+        """Slope and intercept of a line sampled at x>0 are strongly
+        anti-correlated — the canonical lmfit report example."""
+        model, p, args = _make_line_fit()
+        res = minimize_leastsq(model, p, args=args)
+        report = res.fit_report()
+        assert "[[Correlations]]" in report
+        line = [ln for ln in report.splitlines() if "C(" in ln]
+        assert len(line) == 1
+        name, _, val = line[0].partition("=")
+        assert set(name.strip()[2:-1].split(", ")) == {"a", "b"}
+        corr = float(val)
+        assert corr < -0.9          # x in [1,3] → corr ≈ -0.97
+        # and the correlation is consistent with the covariance
+        c = res.covar
+        expect = c[0, 1] / np.sqrt(c[0, 0] * c[1, 1])
+        assert abs(corr - expect) < 5e-4
+
+    def test_min_correl_filters_table(self):
+        model, p, args = _make_line_fit()
+        res = minimize_leastsq(model, p, args=args)
+        assert "[[Correlations]]" not in res.fit_report(min_correl=0.99)
+
+    def test_fixed_params_and_single_vary_have_no_table(self):
+        x = np.linspace(0, 1, 20)
+        y = 2.0 * x
+        p = Parameters()
+        p.add("a", value=1.0)
+        p.add("b", value=0.0, vary=False)
+        res = minimize_leastsq(_line, p, args=(x, y))
+        assert "[[Correlations]]" not in res.fit_report()
+        assert "b: 0 +/- None (fixed)" in res.fit_report()
+
+    def test_mcmc_result_reports_correlations(self):
+        model, p, args = _make_line_fit()
+        res = sample_emcee(model, p, args=args, nwalkers=24, steps=200,
+                           burn=0.3, thin=5, seed=1)
+        assert res.covar is not None and res.covar.shape == (2, 2)
+        report = res.fit_report()
+        assert "[[Correlations]]" in report
+        line = [ln for ln in report.splitlines() if "C(" in ln][0]
+        assert float(line.partition("=")[2]) < -0.5
