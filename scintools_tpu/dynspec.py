@@ -1397,8 +1397,7 @@ class Dynspec:
         self.eta_evo_err = np.zeros((self.ncf_fit, self.nct_fit))
         self.f0s = np.zeros(self.ncf_fit)
         self.t0s = np.zeros(self.nct_fit)
-        if (mesh is not None and self.backend != "numpy"
-                and self.thetatheta_proc != "thin"):
+        if mesh is not None and self.backend != "numpy":
             self._fit_thetatheta_sharded(mesh, verbose=verbose)
         elif self.backend != "numpy" and self.nct_fit > 1:
             # all time-chunks of one frequency row share geometry →
@@ -1529,7 +1528,9 @@ class Dynspec:
     def _fit_thetatheta_sharded(self, mesh, verbose=False):
         """SPMD chunk-grid search: every (cf, ct) chunk of the θ-θ fit
         grid runs in ONE jitted program with the chunk axis sharded
-        over ``mesh`` (reference pool.map: dynspec.py:1715-1719)."""
+        over ``mesh`` (reference pool.map: dynspec.py:1715-1719).
+        Covers all procs — the thin two-curvature search included
+        (make_thth_thin_grid_search_sharded)."""
         import jax.numpy as jnp
 
         from . import parallel as par
@@ -1537,7 +1538,9 @@ class Dynspec:
         from .thth.search import (chunk_conjugate_spectrum,
                                   fit_eig_peak)
 
+        thin = self.thetatheta_proc == "thin"
         cs_list, edges_list, etas_list, meta = [], [], [], []
+        arclet_list = []
         tau = fd = None
         for cf in range(self.ncf_fit):
             for ct in range(self.nct_fit):
@@ -1552,8 +1555,11 @@ class Dynspec:
                     np.logspace(np.log10(self.eta_min),
                                 np.log10(self.eta_max), self.neta)
                     * (self.fref / freq2.mean()) ** 2)
-                edges_list.append(self.edges
-                                  * (freq2.mean() / self.fref))
+                edges = self.edges * (freq2.mean() / self.fref)
+                edges_list.append(edges)
+                if thin:
+                    arclet_list.append(
+                        edges[np.abs(edges) < self.arclet_lim])
                 meta.append((cf, ct, float(freq2.mean()),
                              float(time2.mean())))
 
@@ -1564,6 +1570,20 @@ class Dynspec:
             cs_list.append(cs_list[0])
             etas_list.append(etas_list[0])
             edges_list.append(edges_list[0])
+            if thin:
+                arclet_list.append(arclet_list[0])
+        if thin:
+            # per-row arclet-edge counts differ (|edges| < arclet_lim
+            # after the frequency rescale); pad every row to the
+            # widest with large ascending values — the padded centres
+            # fail the per-η validity mask inside the program
+            # (thth/batch.py:make_thin_grid_eval_fn)
+            n_arc = max(len(a) for a in arclet_list)
+            big = 1e6 * max(1.0, float(np.abs(self.edges).max()))
+            arclet_list = [
+                np.concatenate([a, big * (1 + np.arange(n_arc
+                                                        - len(a)))])
+                for a in arclet_list]
 
         # cache the compiled SPMD program per (geometry, mesh); NOTE
         # make_thth_grid_search_sharded returns an already-jitted fn
@@ -1573,18 +1593,32 @@ class Dynspec:
         mesh_key = (tuple(d.id for d in np.ravel(mesh.devices)),
                     tuple(mesh.axis_names),
                     tuple(mesh.shape.values()))
-        key = (tau.tobytes(), fd.tobytes(), len(self.edges), mesh_key)
+        key = (tau.tobytes(), fd.tobytes(), len(self.edges), mesh_key,
+               thin, len(arclet_list[0]) if thin else 0,
+               float(self.center_cut) if thin else 0.0)
         fn = _SHARDED_GRID_CACHE.get(key)
         if fn is None:
             if len(_SHARDED_GRID_CACHE) >= 8:
                 _SHARDED_GRID_CACHE.pop(
                     next(iter(_SHARDED_GRID_CACHE)))
-            fn = par.make_thth_grid_search_sharded(
-                mesh, tau, fd, len(self.edges))
+            if thin:
+                fn = par.make_thth_thin_grid_search_sharded(
+                    mesh, tau, fd, len(self.edges),
+                    len(arclet_list[0]), self.center_cut)
+            else:
+                fn = par.make_thth_grid_search_sharded(
+                    mesh, tau, fd, len(self.edges))
             _SHARDED_GRID_CACHE[key] = fn
-        eigs = np.asarray(fn(jnp.asarray(np.stack(cs_list)),
-                             jnp.asarray(np.stack(edges_list)),
-                             jnp.asarray(np.stack(etas_list))))[:B]
+        if thin:
+            eigs = np.asarray(fn(
+                jnp.asarray(np.stack(cs_list)),
+                jnp.asarray(np.stack(edges_list)),
+                jnp.asarray(np.stack(arclet_list)),
+                jnp.asarray(np.stack(etas_list))))[:B]
+        else:
+            eigs = np.asarray(fn(jnp.asarray(np.stack(cs_list)),
+                                 jnp.asarray(np.stack(edges_list)),
+                                 jnp.asarray(np.stack(etas_list))))[:B]
 
         for i, (cf, ct, f_m, t_m) in enumerate(meta):
             eta_fit, eta_sig = fit_eig_peak(etas_list[i], eigs[i],

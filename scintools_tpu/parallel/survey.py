@@ -51,6 +51,29 @@ def make_thth_grid_search_sharded(mesh, tau, fd, n_edges, iters=64):
                    out_shardings=chunk_sh)
 
 
+def make_thth_thin_grid_search_sharded(mesh, tau, fd, n_edges,
+                                       n_arclet_edges, center_cut,
+                                       iters=64):
+    """Thin-screen counterpart of :func:`make_thth_grid_search_sharded`:
+    ``fn(CS_ri[B, 2, ntau, nfd], edges[B, n_edges],
+    edges_arclet[B, n_arclet_edges], etas[B, neta]) → sigs[B, neta]``
+    with the chunk axis B split across every device (reference
+    pool.map over ``single_search_thin``, dynspec.py:1715-1719 /
+    ththmod.py:516-712). Arclet-edge rows are padded to the widest
+    count with large values (see thth/batch.py:make_thin_grid_eval_fn).
+    """
+    jax = get_jax()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..thth.batch import make_thin_grid_eval_fn
+
+    fn = make_thin_grid_eval_fn(tau, fd, n_edges, n_arclet_edges,
+                                center_cut, iters=iters)
+    chunk_sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    return jax.jit(fn, in_shardings=(chunk_sh,) * 4,
+                   out_shardings=chunk_sh)
+
+
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
     """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
     the η grid split over every device of the mesh (CS replicated;

@@ -230,6 +230,82 @@ def make_grid_eval_fn(tau, fd, n_edges, iters=200):
     return jax.vmap(one)
 
 
+def make_thin_grid_eval_fn(tau, fd, n_edges, n_arclet_edges,
+                           center_cut, iters=200):
+    """Whole-chunk-grid THIN-SCREEN η search with per-chunk TRACED
+    geometry: ``fn(CS_ri[B, 2, ntau, nfd], edges[B, n_edges],
+    edges_arclet[B, n_arclet_edges], etas[B, neta]) → sigs[B, neta]``.
+
+    The thin counterpart of :func:`make_grid_eval_fn` (same traced
+    edges/η so the entire (ncf × nct) grid is ONE program with the
+    chunk axis sharded over a mesh — reference pool.map over
+    ``single_search_thin``, dynspec.py:1715-1719 / ththmod.py:516-712).
+    Math follows :func:`make_thin_eval_fn` (two-curve θ-θ, largest
+    singular value via power iteration on the Gram matrix).
+
+    Per-row arclet edge COUNTS differ (``edges[|edges| < arclet_lim]``
+    after the per-row frequency rescale), but shapes must be static:
+    callers pad ``edges_arclet`` rows to the widest count with large
+    ascending values — the padded centres fail the per-η ``|θ| <
+    √(τ_max/η)`` validity mask, and zeroed rows leave singular values
+    unchanged (the same trick the fixed-shape θ-θ uses for the
+    reference's data-dependent crops).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
+    dtau = np.diff(tau_a).mean()
+    dfd = np.diff(fd_a).mean()
+    n1 = n_edges - 1
+    n2 = n_arclet_edges - 1
+    center_cut = float(unit_checks(center_cut, "center_cut"))
+
+    from .core import dominant_eig_power
+
+    def one(CS_ri, edges, edges_arclet, etas):
+        CS_c = CS_ri[0] + 1j * CS_ri[1]              # (ntau, nfd)
+        c1 = (edges[1:] + edges[:-1]) / 2
+        c1 = c1 - c1[jnp.argmin(jnp.abs(c1))]
+        c2 = (edges_arclet[1:] + edges_arclet[:-1]) / 2
+        c2 = c2 - c2[jnp.argmin(jnp.abs(c2))]
+        th1 = jnp.ones((n2, 1)) * c1[None, :]
+        th2 = c2[:, None] * jnp.ones((1, n1))
+        e = etas[:, None, None]
+        tau_inv = jnp.floor((e * (th1 ** 2 - th2 ** 2) - tau_a[1]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = jnp.floor((th1 - th2 - fd_a[1] + dfd / 2)
+                           / dfd).astype(int)
+        fd_ok = (fd_inv < len(fd_a) - 1) & (fd_inv >= -len(fd_a))
+        pnts = ((tau_inv > 0) & (tau_inv < len(tau_a) - 1)
+                & fd_ok[None])
+        vals = CS_c[jnp.where(pnts, tau_inv, 0),
+                    jnp.broadcast_to((fd_inv % len(fd_a))[None],
+                                     pnts.shape)]
+        thth = jnp.where(pnts, vals, 0.0)
+        w = (jnp.sqrt(2.0 * jnp.abs(etas))[:, None, None]
+             * jnp.sqrt(jnp.abs(th1 - th2))[None])
+        thth = jnp.nan_to_num(thth * w)
+        lim = jnp.sqrt(jnp.abs(tau_a.max()) / etas)  # (neta,)
+        ok1 = ((jnp.abs(c1)[None, :] < lim[:, None])
+               & (jnp.abs(c1) >= center_cut)[None, :])
+        ok2 = jnp.abs(c2)[None, :] < lim[:, None]
+        a = thth * ok2[:, :, None] * ok1[:, None, :]  # (neta, n2, n1)
+        scale = jnp.maximum(jnp.max(jnp.abs(a), axis=(1, 2),
+                                    keepdims=True), 1e-30)
+        an = a / scale
+        gram = jnp.einsum("eij,eik->ejk", jnp.conj(an), an)
+
+        def lam(G):
+            v, _ = dominant_eig_power(G, iters=iters, backend="jax")
+            return jnp.sqrt(jnp.abs(v))
+
+        return jax.vmap(lam)(gram) * scale[:, 0, 0]   # (neta,)
+
+    return jax.vmap(one)
+
+
 def make_thin_eval_fn(tau, fd, edges, edges_arclet, center_cut,
                       iters=200):
     """Build ``fn(CS_ri_batch, etas) -> sigmas`` for the two-curvature
