@@ -29,7 +29,17 @@ def main():
                     choices=["numpy", "jax"])
     ap.add_argument("--plot", default=None, metavar="DIR",
                     help="write figures into DIR")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU jax platform (env vars alone "
+                         "are not honoured once the axon plugin "
+                         "registers; the batched LM fit below always "
+                         "runs through jax)")
     args = ap.parse_args()
+
+    if args.cpu:
+        from scintools_tpu.backend import force_cpu_platform
+
+        force_cpu_platform()
 
     # sync fences the jax device queue — skip it on the numpy path
     # (first touch of a tunneled TPU can take a minute)
@@ -68,6 +78,53 @@ def main():
     acf, t, f = my_acf.acf, my_acf.tn, my_acf.fn
     print(f"lag axes: t [{t[0]:.1f}, {t[-1]:.1f}] tau_d, "
           f"f [{f[0]:.1f}, {f[-1]:.1f}] dnu_d")
+
+    # --- recovered (τ_d, Δν_d) vs the simulation, asserted ---------
+    # Simulate strong scintillation, fit the 1-D ACF models (the
+    # acf1d pipeline the reference runs per epoch, dynspec.py:2698),
+    # and check the recovery numerically against the simulation's own
+    # realised scales: the fitted τ_d must sit at the measured 1/e
+    # crossing of the time ACF and Δν_d at the half-power crossing of
+    # the frequency ACF, and relabelling the time axis (dt) must move
+    # τ_d exactly linearly — a units regression of the whole chain.
+    from scintools_tpu.sim import Simulation
+    from scintools_tpu.fit.batch import (acf_cuts_batch,
+                                         scint_params_batch)
+
+    with tm("Simulation(mb2=2, 256x256) + acf1d fit"):
+        sim = Simulation(mb2=2, ds=0.01, ns=256, nf=256, dlam=0.25,
+                         seed=64, dt=1.0, backend=args.backend)
+        dyn = np.asarray(sim.dyn)                       # (nf, nt)
+        out = scint_params_batch(dyn[None], dt=sim.dt, df=sim.df,
+                                 backend=args.backend)
+    tau_fit = float(out["tau"][0])
+    dnu_fit = float(out["dnu"][0])
+
+    tcut, fcut = acf_cuts_batch(dyn[None], backend="numpy")
+    yt, yf = np.asarray(tcut[0]), np.asarray(fcut[0])
+    # white-noise-corrected direct crossings (the reference's
+    # initial-guess recipe, dynspec.py:2581-2594)
+    wn = min(yf[0] - yf[1], yt[0] - yt[1])
+    amp = max(yf[0] - wn, yt[0] - wn)
+    tau_direct = float(np.argmax(yt < amp / np.e)) * sim.dt
+    dnu_direct = float(np.argmax(yf < amp / 2)) * sim.df
+    print(f"tau_d: fit {tau_fit:.1f} s vs direct 1/e "
+          f"{tau_direct:.1f} s; dnu_d: fit {dnu_fit:.2f} MHz vs "
+          f"direct half-power {dnu_direct:.2f} MHz")
+    assert abs(tau_fit - tau_direct) < 0.4 * tau_direct, \
+        "fitted tau_d far from the measured 1/e timescale"
+    assert abs(dnu_fit - dnu_direct) < 0.25 * dnu_direct, \
+        "fitted dnu_d far from the measured half-power bandwidth"
+
+    # exact invariance: dt relabels the time axis, so tau_d scales
+    # linearly with NO other change (same dyn, same cuts)
+    out3 = scint_params_batch(dyn[None], dt=3.0 * sim.dt, df=sim.df,
+                              backend=args.backend)
+    ratio = float(out3["tau"][0]) / tau_fit
+    print(f"tau_d under dt x3 relabel: x{ratio:.4f} (exactly 3)")
+    assert abs(ratio - 3.0) < 3e-3, "tau_d must scale linearly in dt"
+    dnu_ratio = float(out3["dnu"][0]) / dnu_fit
+    assert abs(dnu_ratio - 1.0) < 1e-3, "dnu_d must ignore dt"
 
     print(tm.report())
 

@@ -1,0 +1,22 @@
+"""Executable-example regression: the strong-scintillation ACF example
+must PASS its numeric asserts, not merely run (VERDICT r3 missing #3 —
+reference notebook examples/acf_strong_scintillation.ipynb)."""
+
+import os
+import subprocess
+import sys
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def test_example_05_asserts_numerically():
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(EXAMPLES, "05_acf_strong_scintillation.py"),
+         "--cpu"],
+        capture_output=True, timeout=600)
+    assert out.returncode == 0, out.stderr.decode()[-1500:]
+    text = out.stdout.decode()
+    # the recovery section actually ran and printed its comparisons
+    assert "tau_d: fit" in text
+    assert "dt x3 relabel" in text
