@@ -72,6 +72,19 @@ def main():
     print(f"wavefield {wf.shape}, mean |W|^2 / mean dyn = {ratio:.3g}")
     assert 0.01 < ratio < 100, "wavefield power scale is off"
     assert rel < 0.01, "cross-backend curvature disagrees >1%"
+    # full retrieval + mosaic + GS cross-backend intensity check (the
+    # end-to-end guard for the complex-transfer ban on the chip): the
+    # jax retrieval is float32 BY DESIGN (TPU), so the floor against
+    # the float64 numpy path is ~1e-3 at this scale (measured
+    # 1.052e-3 jax-on-CPU, correlation 0.999999); gate at 5e-3
+    Ij = np.abs(np.asarray(ds_j.wavefield)) ** 2
+    In = np.abs(np.asarray(ds_n.wavefield)) ** 2
+    rel_int = float(np.linalg.norm(Ij - In) / np.linalg.norm(In))
+    corr = float(np.corrcoef(Ij.ravel(), In.ravel())[0, 1])
+    print(f"wavefield intensity cross-backend: rel L2 {rel_int:.3e}, "
+          f"corr {corr:.6f}")
+    assert rel_int < 5e-3, "wavefield intensity diverges across backends"
+    assert corr > 0.9999, "wavefield intensity decorrelated"
     print("TPU smoke OK")
 
 
