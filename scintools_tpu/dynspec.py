@@ -1862,16 +1862,13 @@ class Dynspec:
     def plot_sspec(self, lamsteps=False, input_sspec=None, filename=None,
                    input_x=None, input_y=None, trap=False,
                    prewhite=False, plotarc=False, maxfdop=np.inf,
-                   delmax=None, ref_freq=1400, cutmid=0, startbin=0,
+                   delmax=None, cutmid=0, startbin=0,
                    display=True, colorbar=True, title=None,
                    figsize=(9, 9), subtract_artefacts=False,
                    overplot_curvature=None, dpi=200, velocity=False,
                    vmin=None, vmax=None):
-        # ref_freq is accepted for backward compatibility with this
-        # package's earlier releases; the reference plot_sspec has no
-        # such parameter (dynspec.py:693-700) and delmax is used
-        # directly on the tdel axis (dynspec.py:802-803)
-        del ref_freq
+        # signature matches the reference exactly (dynspec.py:693-700);
+        # delmax is used directly on the tdel axis (dynspec.py:802-803)
         from . import plotting
         return plotting.plot_sspec(
             self, lamsteps=lamsteps, input_sspec=input_sspec,
