@@ -5,8 +5,10 @@ tests/data/golden_reference.npz).
 
 Covered: Simulation seed-exact dynspec (scint_sim.py:23-414), J0437
 psrflux load + calc_sspec + calc_acf (dynspec.py:144-230, :3584-3814),
-the θ-θ eigenvalue η-curve (ththmod.py:371-401), θ-θ forward/inverse
-maps element-for-element (ththmod.py:56-271), and the Rickett-2014
+fit_arc curvature/errors + the norm_sspec scrunched profile on the
+λ-scaled path (dynspec.py:970-1311, :1920-2281), the θ-θ eigenvalue
+η-curve (ththmod.py:371-401), θ-θ forward/inverse maps
+element-for-element (ththmod.py:56-271), and the Rickett-2014
 analytic ACF grid (scint_sim.py:494-678)."""
 
 import os
@@ -81,6 +83,57 @@ class TestJ0437Golden:
         dyn.calc_acf()
         np.testing.assert_allclose(np.asarray(dyn.acf),
                                    gold["j0437_acf"], atol=2e-5)
+
+
+@pytest.mark.skipif(not os.path.exists(J0437),
+                    reason="J0437 sample data not mounted")
+class TestArcGolden:
+    """fit_arc + norm_sspec pinned against the unmodified reference on
+    the standard λ-scaled path (dynspec.py:970-1311, :1920-2281)."""
+
+    @pytest.fixture(scope="class")
+    def fitted(self, gold):
+        from scintools_tpu.dynspec import Dynspec
+
+        ds = Dynspec(filename=J0437, process=False, verbose=False,
+                     backend="numpy")
+        ds.calc_sspec(prewhite=False, lamsteps=True, window="hanning",
+                      window_frac=0.1)
+        return ds
+
+    def test_lamsspec_matches(self, gold, fitted):
+        ours = 10 ** (np.asarray(fitted.lamsspec, dtype=float) / 10)
+        ref = 10 ** (gold["j0437_lamsspec"].astype(float) / 10)
+        peak = np.nanmax(ref)
+        assert np.nanmax(np.abs(ours - ref)) / peak < 1e-5
+        np.testing.assert_allclose(fitted.beta, gold["j0437_beta"])
+
+    def test_fit_arc_curvature_matches(self, gold, fitted):
+        fitted.fit_arc(plot=False, lamsteps=True, logsteps=False,
+                       weighted=False, noise_error=True)
+        ref = float(gold["j0437_arc_betaeta"])
+        assert abs(fitted.betaeta - ref) / ref < 1e-6
+        # errors follow the same recipe (parabola + noise walk-out)
+        assert fitted.betaetaerr == pytest.approx(
+            float(gold["j0437_arc_betaetaerr"]), rel=1e-3)
+        assert fitted.betaetaerr2 == pytest.approx(
+            float(gold["j0437_arc_betaetaerr2"]), rel=1e-3)
+
+    def test_norm_sspec_profile_matches(self, gold, fitted):
+        fitted.norm_sspec(eta=float(gold["j0437_arc_betaeta"]),
+                          lamsteps=True, plot=False, scrunched=True,
+                          weighted=True, numsteps=200, maxnormfac=2)
+        ours = np.asarray(fitted.normsspecavg, dtype=float)
+        ref = gold["j0437_norm_avg"].astype(float)
+        np.testing.assert_allclose(np.asarray(fitted.normsspec_fdop),
+                                   gold["j0437_norm_fdop"])
+        # the reference's np.ma.average fills FULLY-masked bins (the
+        # two extreme ±maxnormfac endpoints, zero contributing rows)
+        # with literal 0.0 — exclude exact-zero reference bins, they
+        # carry no data
+        interior = ref != 0.0
+        assert interior.sum() >= len(ref) - 4
+        assert np.max(np.abs(ours[interior] - ref[interior])) < 1e-3
 
 
 class TestThetaThetaGolden:

@@ -10,6 +10,8 @@ lets the reference's numpy-only compute paths run UNMODIFIED:
   + Fresnel propagation → dynspec (seed-exact golden);
 - ``Dynspec.calc_sspec``/``calc_acf`` (dynspec.py:3584-3814) on one
   real J0437-4715 epoch (psrflux parse + trim included);
+- ``Dynspec.fit_arc`` curvature/errors + the ``norm_sspec`` scrunched
+  profile on the λ-scaled path (dynspec.py:970-1311, :1920-2281);
 - ``ththmod.Eval_calc`` η-curve (ththmod.py:371-401) on a chunk of
   the simulated dynspec;
 - ``ththmod.thth_map``/``rev_map`` raw matrices (ththmod.py:56-271);
@@ -76,6 +78,28 @@ def main():
     out["j0437_tdel"] = d.tdel.astype(np.float64)
     d.calc_acf()
     out["j0437_acf"] = d.acf.astype(np.float32)
+
+    # ---- 2b. fit_arc + norm_sspec goldens on the same epoch ---------
+    # (dynspec.py:970-1311 Hough η search; :1920-2281 normalisation) —
+    # the η-search workhorse pinned behaviourally against upstream, on
+    # the standard λ-scaled path (the reference's fit_arc needs
+    # self.beta even for lamsteps=False — upstream quirk at :1089)
+    d.calc_sspec(prewhite=False, lamsteps=True, window="hanning",
+                 window_frac=0.1)
+    out["j0437_lamsspec"] = d.lamsspec.astype(np.float32)
+    out["j0437_beta"] = np.asarray(d.beta, dtype=np.float64)
+    d.fit_arc(plot=False, lamsteps=True, logsteps=False,
+              weighted=False, noise_error=True)
+    out["j0437_arc_betaeta"] = float(d.betaeta)
+    out["j0437_arc_betaetaerr"] = float(d.betaetaerr)
+    out["j0437_arc_betaetaerr2"] = float(d.betaetaerr2)
+    d.norm_sspec(eta=d.betaeta, lamsteps=True, plot=False,
+                 scrunched=True, weighted=True, numsteps=200,
+                 maxnormfac=2)
+    out["j0437_norm_avg"] = np.asarray(d.normsspecavg,
+                                       dtype=np.float64)
+    out["j0437_norm_fdop"] = np.asarray(d.normsspec_fdop,
+                                        dtype=np.float64)
 
     # ---- 3. θ-θ eigenvalue curve on a simulated chunk ---------------
     import astropy.units as u
