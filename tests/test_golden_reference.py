@@ -195,6 +195,65 @@ class TestThetaThetaGolden:
                                    atol=1e-10)
 
 
+class TestThinScreenGolden:
+    """Two-curvature (thin-screen) kernels pinned against the
+    unmodified reference (ththmod.py:1557-1612 two_curve_map,
+    :496-513 singularvalue_calc) — the math behind
+    single_search_thin and the SPMD thin grid."""
+
+    @pytest.fixture(scope="class")
+    def chunk_cs(self, gold):
+        chunk = gold["sim_dyn"].astype(float)[:64, :64]
+        chunk = chunk - chunk.mean()
+        pad = np.pad(chunk, ((0, 64), (0, 64)),
+                     constant_values=chunk.mean())
+        return np.fft.fftshift(np.fft.fft2(pad))
+
+    def test_singularvalue_curve_matches(self, gold, chunk_cs):
+        from scintools_tpu.thth.core import singularvalue_calc
+
+        sigs = np.array([
+            singularvalue_calc(chunk_cs, gold["thth_tau"],
+                               gold["thth_fd"], e, gold["thth_edges"],
+                               e, gold["thin_arclet_edges"],
+                               float(gold["thin_center_cut"]))
+            for e in gold["thth_etas"]])
+        np.testing.assert_allclose(sigs, gold["thin_sigs"], rtol=1e-10)
+
+    def test_two_curve_map_matches(self, gold, chunk_cs):
+        from scintools_tpu.thth.core import two_curve_map
+
+        out = two_curve_map(chunk_cs, gold["thth_tau"],
+                            gold["thth_fd"],
+                            float(gold["thth_map_eta"]),
+                            gold["thth_edges"],
+                            float(gold["thth_map_eta"]),
+                            gold["thin_arclet_edges"])
+        tcm = out[0] if isinstance(out, tuple) else out
+        ref = gold["thin_map_re"] + 1j * gold["thin_map_im"]
+        assert np.shape(tcm) == ref.shape
+        np.testing.assert_allclose(np.asarray(tcm), ref, atol=1e-8
+                                   * np.abs(ref).max())
+
+    def test_jax_thin_eval_matches(self, gold, chunk_cs):
+        """The batched jax evaluator (the SPMD thin grid's kernel)
+        reproduces the reference singular-value curve."""
+        import jax.numpy as jnp
+
+        from scintools_tpu.thth.batch import make_thin_eval_fn
+        from scintools_tpu.thth.core import cs_to_ri
+
+        fn = make_thin_eval_fn(gold["thth_tau"], gold["thth_fd"],
+                               gold["thth_edges"],
+                               gold["thin_arclet_edges"],
+                               float(gold["thin_center_cut"]),
+                               iters=400)
+        sig = np.asarray(fn(
+            jnp.asarray(cs_to_ri(chunk_cs).astype(np.float32))[None],
+            jnp.asarray(gold["thth_etas"])))[0]
+        np.testing.assert_allclose(sig, gold["thin_sigs"], rtol=1e-5)
+
+
 class TestRickettACFGolden:
     def test_acf_grid_matches(self, gold):
         """The GEMM-factorised Fresnel integral reproduces the

@@ -27,7 +27,9 @@ class Unit:
 
     def __init__(self, scale, power, name="unit"):
         self.scale = float(scale)
-        self.power = int(power)
+        # float: np.sqrt of a quantity halves the power (e.g.
+        # sqrt(us/s³) → s⁻¹-like), and halves of ints are binary-exact
+        self.power = float(power)
         self.name = name
 
     # -- unit algebra ---------------------------------------------------
@@ -166,6 +168,38 @@ class Quantity(np.ndarray):
                     // other.value)
             return np.asarray(self.value // other.value)
         return Quantity(self.value // np.asarray(other), self.unit)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        """np.sqrt gets true unit algebra (the reference compares
+        sqrt(tau/eta) against mHz quantities, ththmod.py:1625-1629);
+        every other ufunc keeps the previous subclass passthrough
+        (compute on raw values, re-attach the first input's unit) so
+        already-verified golden paths are bit-unchanged."""
+        if (ufunc is np.sqrt and method == "__call__"
+                and len(inputs) == 1):
+            q = inputs[0]
+            return Quantity(np.sqrt(q.view(np.ndarray)),
+                            Unit(q.unit.scale ** 0.5,
+                                 q.unit.power / 2,
+                                 f"({q.unit.name})**0.5"))
+        arrays = [x.view(np.ndarray) if isinstance(x, Quantity) else x
+                  for x in inputs]
+        # unwrap any Quantity in out= (ndarray.mean passes its interim
+        # result as out) or the call re-dispatches here forever
+        if kwargs.get("out") is not None:
+            kwargs["out"] = tuple(
+                o.view(np.ndarray) if isinstance(o, Quantity) else o
+                for o in kwargs["out"])
+        result = getattr(ufunc, method)(*arrays, **kwargs)
+        unit = next((x.unit for x in inputs
+                     if isinstance(x, Quantity)),
+                    dimensionless_unscaled)
+        # numpy scalars too: reductions (q.max() → np.maximum.reduce)
+        # must stay Quantities, as the pre-__array_ufunc__ subclass
+        # wrapping made them
+        if isinstance(result, (np.ndarray, np.generic)):
+            return Quantity(np.asarray(result), unit)
+        return result
 
     def _cmp(self, other, op):
         return op(self.value, self._factor_from(other))

@@ -130,6 +130,31 @@ def main():
     out["thth_eigs"] = eigs
     out["thth_npad"] = npad
 
+    # ---- 3b. thin-screen goldens: two_curve_map + singular values ---
+    # (ththmod.py:1557-1612 two-curve θ-θ; :496-513 largest singular
+    # value with the centre cut) — the kernel behind single_search_thin
+    # and this repo's make_thin_eval_fn / SPMD thin grid
+    arclet_edges = edges[np.abs(edges.value) < 0.6 * th_lim]
+    center_cut = float(2 * (edges[1] - edges[0]).value) * u.mHz
+    sigs = np.array([
+        thth.singularvalue_calc(CS, tau, fd, eta * u.s ** 3, edges,
+                                eta * u.s ** 3, arclet_edges,
+                                center_cut)
+        for eta in etas])
+    out["thin_arclet_edges"] = np.asarray(arclet_edges.value,
+                                          dtype=np.float64)
+    out["thin_center_cut"] = float(center_cut.value)
+    out["thin_sigs"] = sigs
+    tcm, tcm_e1, tcm_e2 = thth.two_curve_map(
+        CS, tau, fd, etas[len(etas) // 2] * u.s ** 3, edges,
+        etas[len(etas) // 2] * u.s ** 3, arclet_edges)
+    out["thin_map_re"] = np.real(np.asarray(tcm)).astype(np.float64)
+    out["thin_map_im"] = np.imag(np.asarray(tcm)).astype(np.float64)
+    out["thin_map_e1"] = np.asarray(
+        getattr(tcm_e1, "value", tcm_e1), dtype=np.float64)
+    out["thin_map_e2"] = np.asarray(
+        getattr(tcm_e2, "value", tcm_e2), dtype=np.float64)
+
     # ---- 4. θ-θ map-level goldens: thth_map + rev_map ---------------
     eta_mid = etas[len(etas) // 2]
     tm = thth.thth_map(CS, tau, fd, eta_mid * u.s ** 3, edges)
