@@ -254,6 +254,66 @@ class TestThinScreenGolden:
         np.testing.assert_allclose(sig, gold["thin_sigs"], rtol=1e-5)
 
 
+class TestRetrievalCoreGolden:
+    """Rank-1 retrieval heart (modeler + chisq_calc, ththmod.py:
+    274-368) and the scint_utils numerics (svd_model :705-729,
+    interp_nan_2d :769-784) pinned against the unmodified reference.
+    slow_FT is NOT pinnable: the upstream function crashes on any call
+    (scint_utils.py:679 passes axis= to np.fft.fftshift)."""
+
+    @pytest.fixture(scope="class")
+    def chunk_cs(self, gold):
+        chunk = gold["sim_dyn"].astype(float)[:64, :64]
+        chunk = chunk - chunk.mean()
+        pad = np.pad(chunk, ((0, 64), (0, 64)),
+                     constant_values=chunk.mean())
+        return chunk, np.fft.fftshift(np.fft.fft2(pad))
+
+    def test_modeler_matches(self, gold, chunk_cs):
+        from scintools_tpu.thth.core import modeler
+
+        _, CS = chunk_cs
+        out = modeler(CS, gold["thth_tau"], gold["thth_fd"],
+                      float(gold["thth_map_eta"]), gold["thth_edges"],
+                      backend="numpy")
+        model, recov, w = np.asarray(out[3]), np.asarray(out[2]), out[5]
+        peak = np.abs(gold["modeler_model"]).max()
+        assert np.max(np.abs(model - gold["modeler_model"])) / peak \
+            < 1e-10
+        assert np.max(np.abs(np.abs(recov)
+                             - gold["modeler_recov_abs"])) \
+            / gold["modeler_recov_abs"].max() < 1e-10
+        w0 = float(np.abs(np.asarray(w).ravel()[0]))
+        assert w0 == pytest.approx(float(gold["modeler_w"]),
+                                   rel=1e-10)
+
+    def test_chisq_calc_matches(self, gold, chunk_cs):
+        from scintools_tpu.thth.core import chisq_calc
+
+        chunk, CS = chunk_cs
+        ch = chisq_calc(chunk, CS, gold["thth_tau"], gold["thth_fd"],
+                        float(gold["thth_map_eta"]),
+                        gold["thth_edges"], 1.0, backend="numpy")
+        assert float(ch) == pytest.approx(
+            float(gold["modeler_chisq"]), rel=1e-10)
+
+    def test_svd_model_matches_exactly(self, gold):
+        from scintools_tpu.utils.misc import svd_model
+
+        arr, model = svd_model(gold["svdmodel_in"].copy(), nmodes=1)
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      gold["svdmodel_arr"])
+        np.testing.assert_array_equal(np.abs(np.asarray(model)),
+                                      gold["svdmodel_model"])
+
+    def test_interp_nan_2d_matches_exactly(self, gold):
+        from scintools_tpu.ops.interp import interp_nan_2d
+
+        out = interp_nan_2d(gold["interpnan_in"].copy())
+        np.testing.assert_array_equal(np.asarray(out),
+                                      gold["interpnan_out"])
+
+
 class TestRickettACFGolden:
     def test_acf_grid_matches(self, gold):
         """The GEMM-factorised Fresnel integral reproduces the

@@ -155,6 +155,40 @@ def main():
     out["thin_map_e2"] = np.asarray(
         getattr(tcm_e2, "value", tcm_e2), dtype=np.float64)
 
+    # ---- 3c. retrieval-core goldens: modeler + chisq_calc -----------
+    # (ththmod.py:274-368) — the rank-1 phase-retrieval heart; the
+    # eigenvector's arbitrary phase cancels in the V·Vᴴ outer product,
+    # so model and |recov| are deterministic
+    eta_mid_q = etas[len(etas) // 2] * u.s ** 3
+    (thth_red_g, thth2_red_g, recov_g, model_g, edges_red_g, w_g,
+     V_g) = thth.modeler(CS, tau, fd, eta_mid_q, edges)
+    out["modeler_model"] = np.asarray(model_g, dtype=np.float64)
+    out["modeler_recov_abs"] = np.abs(
+        np.asarray(recov_g)).astype(np.float64)
+    out["modeler_w"] = float(np.abs(w_g))
+    out["modeler_chisq"] = float(thth.chisq_calc(
+        chunk, CS, tau, fd, eta_mid_q, edges, 1.0))
+
+    # ---- 3d. scint_utils numerics: svd_model / interp_nan_2d --------
+    # (scint_utils.py:705-767, :769-784). slow_FT is NOT pinnable: the
+    # upstream function crashes on any call (scint_utils.py:679 passes
+    # ``axis=`` to np.fft.fftshift, whose keyword is ``axes=``)
+    import scintools.scint_utils as su
+
+    rng = np.random.default_rng(99)
+    small = rng.standard_normal((24, 20)) ** 2
+    sv_in = small + 5.0
+    sv_arr, sv_model = su.svd_model(sv_in.copy(), nmodes=1)
+    out["svdmodel_in"] = sv_in
+    out["svdmodel_arr"] = np.asarray(sv_arr, dtype=np.float64)
+    out["svdmodel_model"] = np.abs(np.asarray(sv_model)
+                                   ).astype(np.float64)
+    nan_in = small.copy()
+    nan_in[rng.random(small.shape) < 0.15] = np.nan
+    out["interpnan_in"] = nan_in
+    out["interpnan_out"] = np.asarray(su.interp_nan_2d(nan_in.copy()),
+                                      dtype=np.float64)
+
     # ---- 4. θ-θ map-level goldens: thth_map + rev_map ---------------
     eta_mid = etas[len(etas) // 2]
     tm = thth.thth_map(CS, tau, fd, eta_mid * u.s ** 3, edges)
