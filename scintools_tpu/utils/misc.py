@@ -120,7 +120,11 @@ def search_and_replace(filename, search, replace):
 
 def slow_FT(dynspec, freqs):
     """DFT along scaled t·(f/fref) paths (scint_utils.py:655-702),
-    einsum-vectorised. Reference frequency is the middle of the band."""
+    einsum-vectorised. Reference frequency is the middle of the band.
+
+    Note: the upstream function is unrunnable as published (it passes
+    ``axis=`` to np.fft.fftshift at scint_utils.py:679); this is the
+    intended computation with that call corrected to ``axes=``."""
     dynspec = np.asarray(dynspec, dtype=np.float64)
     ntime = dynspec.shape[0]
     src = np.arange(ntime, dtype=np.float64)
