@@ -87,6 +87,45 @@ class TestJ0437Golden:
 
 @pytest.mark.skipif(not os.path.exists(J0437),
                     reason="J0437 sample data not mounted")
+class TestPreprocessingChainGolden:
+    """The exact preprocessing semantics pinned end-to-end against the
+    unmodified reference as a CHAIN (each stage sees the previous
+    stage's output): trim_edges (dynspec.py:259-308), crop_dyn
+    (:3816-3854), zap (:3856-3881), refill linear (:3273-3323),
+    correct_dyn SVD bandpass (:3325-3379). Bit-exact, NaN masks
+    included."""
+
+    def test_chain_matches_bit_exactly(self, gold):
+        from scintools_tpu.dynspec import Dynspec
+
+        ds = Dynspec(filename=J0437, process=False, verbose=False,
+                     backend="numpy")
+        ds.trim_edges()
+        for stage, ref_key in [
+                (None, "prep_trimmed"),
+                (lambda: ds.crop_dyn(fmin=1270, fmax=1500),
+                 "prep_cropped"),
+                (lambda: ds.zap(sigma=7), "prep_zapped"),
+                (lambda: ds.refill(method="linear"), "prep_refilled"),
+                (lambda: ds.correct_dyn(svd=True, nmodes=1,
+                                        frequency=False, time=True),
+                 "prep_corrected")]:
+            if stage is not None:
+                stage()
+            ref = gold[ref_key]
+            ours = np.asarray(ds.dyn, dtype=float)
+            assert ours.shape == ref.shape, ref_key
+            np.testing.assert_array_equal(
+                np.isnan(ours), np.isnan(ref), err_msg=ref_key)
+            np.testing.assert_array_equal(
+                np.nan_to_num(ours), np.nan_to_num(ref),
+                err_msg=ref_key)
+        np.testing.assert_allclose(ds.freqs,
+                                   gold["prep_cropped_freqs"])
+
+
+@pytest.mark.skipif(not os.path.exists(J0437),
+                    reason="J0437 sample data not mounted")
 class TestArcGolden:
     """fit_arc + norm_sspec pinned against the unmodified reference on
     the standard λ-scaled path (dynspec.py:970-1311, :1920-2281)."""

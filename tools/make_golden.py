@@ -101,6 +101,24 @@ def main():
     out["j0437_norm_fdop"] = np.asarray(d.normsspec_fdop,
                                         dtype=np.float64)
 
+    # ---- 2c. preprocessing-chain golden on a fresh J0437 load -------
+    # (dynspec.py:259-308 trim_edges, :3816-3854 crop_dyn, :3856-3881
+    # zap, :3273-3323 refill [linear — skimage absent upstream too
+    # falls back], :3325-3379 correct_dyn SVD bandpass) — the exact
+    # preprocessing semantics pinned end-to-end as a chain
+    d2 = Dynspec(filename=J0437, process=False, verbose=False)
+    d2.trim_edges()
+    out["prep_trimmed"] = d2.dyn.astype(np.float64)
+    d2.crop_dyn(fmin=1270, fmax=1500)
+    out["prep_cropped"] = d2.dyn.astype(np.float64)
+    out["prep_cropped_freqs"] = np.asarray(d2.freqs, dtype=np.float64)
+    d2.zap(sigma=7)
+    out["prep_zapped"] = d2.dyn.astype(np.float64)
+    d2.refill(method="linear")
+    out["prep_refilled"] = d2.dyn.astype(np.float64)
+    d2.correct_dyn(svd=True, nmodes=1, frequency=False, time=True)
+    out["prep_corrected"] = d2.dyn.astype(np.float64)
+
     # ---- 3. θ-θ eigenvalue curve on a simulated chunk ---------------
     import astropy.units as u
     import scintools.ththmod as thth
