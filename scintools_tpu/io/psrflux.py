@@ -114,7 +114,12 @@ def write_psrflux(ds, filename, note=None):
     """Write RawDynSpec (or any object with the same attrs) to a psrflux
     file, with provenance header (dynspec.py:330-376 semantics)."""
     with open(filename, "w") as fn:
-        fn.write("# Scintools-TPU dynamic spectrum in psrflux format\n")
+        # header text matches the reference byte-for-byte
+        # (tests/test_golden_reference.py pins the written file), so
+        # files produced here are indistinguishable downstream
+        fn.write("# Scintools-modified dynamic spectrum "
+                 "in psrflux format\n")
+        fn.write("# Created using write_file method in Dynspec class\n")
         if note is not None:
             fn.write(f"# Note: {note}\n")
         fn.write(f"# MJD0: {ds.mjd}\n")

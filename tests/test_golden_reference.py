@@ -122,6 +122,15 @@ class TestPreprocessingChainGolden:
                 err_msg=ref_key)
         np.testing.assert_allclose(ds.freqs,
                                    gold["prep_cropped_freqs"])
+        # the psrflux writer reproduces the reference's output
+        # byte-for-byte on the processed state (header text included)
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("r",
+                                         suffix=".dynspec") as tf:
+            ds.write_file(filename=tf.name, verbose=False)
+            ours = open(tf.name, "rb").read()
+        assert ours == gold["prep_written"].tobytes()
 
 
 @pytest.mark.skipif(not os.path.exists(J0437),

@@ -118,6 +118,14 @@ def main():
     out["prep_refilled"] = d2.dyn.astype(np.float64)
     d2.correct_dyn(svd=True, nmodes=1, frequency=False, time=True)
     out["prep_corrected"] = d2.dyn.astype(np.float64)
+    # psrflux writer bytes on the processed state (dynspec.py write
+    # loop below :3470 region) — deterministic text, pinnable exactly
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".dynspec") as tf:
+        d2.write_file(filename=tf.name, verbose=False)
+        out["prep_written"] = np.frombuffer(
+            open(tf.name, "rb").read(), dtype=np.uint8)
 
     # ---- 3. θ-θ eigenvalue curve on a simulated chunk ---------------
     import astropy.units as u
