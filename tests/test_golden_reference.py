@@ -135,6 +135,61 @@ class TestPreprocessingChainGolden:
 
 @pytest.mark.skipif(not os.path.exists(J0437),
                     reason="J0437 sample data not mounted")
+class TestConcatCutPrewhiteGolden:
+    """__add__ concatenation (dynspec.py:81-142), cut_dyn segmenting
+    with its default-args per-tile sspec (:3158-3271), and the
+    prewhite/postdark sspec path (the reference DEFAULT) pinned
+    against the unmodified reference."""
+
+    def test_concatenation_bit_exact(self, gold):
+        from scintools_tpu.dynspec import Dynspec
+
+        e1 = Dynspec(filename=J0437, process=False, verbose=False,
+                     backend="numpy")
+        e2 = Dynspec(filename=J0437.replace("074112", "084944"),
+                     process=False, verbose=False, backend="numpy")
+        cat = e1 + e2
+        np.testing.assert_array_equal(np.asarray(cat.dyn, float),
+                                      gold["cat_dyn"])
+        np.testing.assert_allclose(np.asarray(cat.times),
+                                   gold["cat_times"])
+        assert cat.mjd == pytest.approx(float(gold["cat_mjd"]),
+                                        abs=1e-9)
+
+    def test_cut_dyn_tiles_match(self, gold):
+        from scintools_tpu.dynspec import Dynspec
+
+        ds = Dynspec(filename=J0437, process=False, verbose=False,
+                     backend="numpy")
+        ds.cut_dyn(tcuts=1, fcuts=1, plot=False)
+        np.testing.assert_array_equal(
+            np.asarray(ds.cutdyn, float), gold["cut_dyn"])
+        # per-tile sspec compared in LINEAR power relative to the
+        # tile peak: dB values at the near-zero DC bin (-280 dB) are
+        # rounding noise (see verify-skill gotchas)
+        ours = 10 ** (np.asarray(ds.cutsspec, float) / 10)
+        ref = 10 ** (gold["cut_sspec"].astype(float) / 10)
+        assert ours.shape == ref.shape
+        for i in range(ours.shape[0]):
+            for j in range(ours.shape[1]):
+                rel = np.nanmax(np.abs(ours[i, j] - ref[i, j])) \
+                    / np.nanmax(ref[i, j])
+                assert rel < 1e-12, f"tile {i},{j}: {rel}"
+
+    def test_prewhite_sspec_matches(self, gold):
+        from scintools_tpu.dynspec import Dynspec
+
+        ds = Dynspec(filename=J0437, process=False, verbose=False,
+                     backend="numpy")
+        ds.calc_sspec(prewhite=True, lamsteps=False, window="hanning",
+                      window_frac=0.1)
+        ours = 10 ** (np.asarray(ds.sspec, float) / 10)
+        ref = 10 ** (gold["j0437_sspec_prewhite"].astype(float) / 10)
+        assert np.nanmax(np.abs(ours - ref)) / np.nanmax(ref) < 1e-12
+
+
+@pytest.mark.skipif(not os.path.exists(J0437),
+                    reason="J0437 sample data not mounted")
 class TestArcGolden:
     """fit_arc + norm_sspec pinned against the unmodified reference on
     the standard λ-scaled path (dynspec.py:970-1311, :1920-2281)."""

@@ -127,6 +127,24 @@ def main():
         out["prep_written"] = np.frombuffer(
             open(tf.name, "rb").read(), dtype=np.uint8)
 
+    # ---- 2d. concatenation, segmenting, prewhitened sspec -----------
+    # (__add__ dynspec.py:81-142; cut_dyn :3158-3271 incl. its
+    # default-args calc_sspec/calc_acf on every tile; calc_sspec with
+    # prewhite/postdark ON — the reference default — :3584 region)
+    J0437_B = J0437.replace("074112", "084944")
+    e1 = Dynspec(filename=J0437, process=False, verbose=False)
+    e2 = Dynspec(filename=J0437_B, process=False, verbose=False)
+    cat = e1 + e2
+    out["cat_dyn"] = cat.dyn.astype(np.float64)
+    out["cat_times"] = np.asarray(cat.times, dtype=np.float64)
+    out["cat_mjd"] = float(cat.mjd)
+    e1.cut_dyn(tcuts=1, fcuts=1, plot=False)
+    out["cut_dyn"] = np.asarray(e1.cutdyn, dtype=np.float64)
+    out["cut_sspec"] = np.asarray(e1.cutsspec, dtype=np.float64)
+    e1.calc_sspec(prewhite=True, lamsteps=False, window="hanning",
+                  window_frac=0.1)
+    out["j0437_sspec_prewhite"] = e1.sspec.astype(np.float64)
+
     # ---- 3. θ-θ eigenvalue curve on a simulated chunk ---------------
     import astropy.units as u
     import scintools.ththmod as thth
