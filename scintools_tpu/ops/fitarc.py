@@ -217,3 +217,107 @@ def fit_arc(sspec, yaxis, fdop, asymm=False, delmax=None, numsteps=1e4,
             fit.norm_fdop = ns.fdop
             fits.append(fit)
     return fits
+
+
+def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
+                  startbin=3, cutmid=3, etamax=None, etamin=None,
+                  low_power_diff=-1, high_power_diff=-0.5,
+                  constraint=(0, np.inf), nsmooth=5, efac=1,
+                  noise_error=True, log_parabola=False, mesh=None):
+    """Arc-curvature fit over a whole batch of same-geometry epochs.
+
+    The reference runs ``fit_arc`` serially per epoch inside its
+    survey loop (dynspec.py:4357 → :970-1311); here the expensive
+    part — the arc-normalised row interpolation and delay scrunch —
+    is ONE jitted program over the epoch batch
+    (ops/normsspec.py:make_arc_profile_batch_fn), optionally sharded
+    over a device ``mesh`` (parallel/survey.py:
+    make_arc_profile_sharded), and only the cheap peak/parabola fit
+    runs per epoch on host. Covers the reference's default single-arc
+    search (``asymm/logsteps/weighted/fit_spectrum`` off) — for those
+    variants call :func:`fit_arc` per epoch.
+
+    ``sspecs[B, ntdel, nfdop]`` in dB with shared axes ``yaxis`` (us
+    or m⁻¹) and ``fdop`` (mHz); ``etamin``/``etamax`` may be scalars
+    (shared) or per-epoch arrays. Returns a list of B
+    :class:`ArcFit`.
+    """
+    import jax.numpy as jnp
+
+    from .normsspec import make_arc_profile_batch_fn
+
+    sspecs = np.asarray(sspecs, dtype=float)
+    B = len(sspecs)
+    yaxis = np.asarray(yaxis, dtype=float)
+    fdop = np.asarray(fdop, dtype=float)
+    if etamin is not None and np.any(np.asarray(etamin) <= 0):
+        raise ValueError("etamin must be positive (curvature is η > 0)")
+    if etamax is not None and np.any(np.asarray(etamax) <= 0):
+        raise ValueError("etamax must be positive (curvature is η > 0)")
+    # even grid (normalise_sspec's nfdop rounding): the ±fdop fold
+    # below pairs bins about zero, and the profile fn applies the
+    # same rounding
+    numsteps = int(numsteps) + int(numsteps) % 2
+    if numsteps <= 2 * nsmooth:
+        raise ValueError(
+            f"numsteps={numsteps} too coarse for the smoothing "
+            f"window (nsmooth={nsmooth}); increase numsteps")
+    delmax = np.max(yaxis) if delmax is None else delmax
+    ind = int(np.argmin(np.abs(yaxis - delmax)))
+    ymax = yaxis[ind]
+    if etamax is None:
+        etamax = ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    if etamin is None:
+        etamin = (yaxis[1] - yaxis[0]) * startbin / np.max(fdop) ** 2
+    etamin_b = np.broadcast_to(np.asarray(etamin, dtype=float),
+                               (B,)).copy()
+    etamax_b = np.broadcast_to(np.asarray(etamax, dtype=float),
+                               (B,)).copy()
+    noises = [sspec_noise(s, cutmid, n_rows=ind) for s in sspecs]
+
+    if mesh is not None:
+        from ..parallel.survey import make_arc_profile_sharded
+
+        fn, ndev = make_arc_profile_sharded(
+            mesh, yaxis, fdop, delmax=delmax, startbin=startbin,
+            cutmid=cutmid, numsteps=int(numsteps))
+        pad = (-B) % ndev
+        s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
+            if pad else sspecs
+        e_in = np.concatenate([etamin_b] + [etamin_b[-1:]] * pad) \
+            if pad else etamin_b
+        profs = np.asarray(fn(jnp.asarray(s_in),
+                              jnp.asarray(e_in)))[:B]
+    else:
+        fn = make_arc_profile_batch_fn(
+            yaxis, fdop, delmax=delmax, startbin=startbin,
+            cutmid=cutmid, numsteps=int(numsteps))
+        profs = np.asarray(fn(jnp.asarray(sspecs),
+                              jnp.asarray(etamin_b)))
+
+    fdopnew = np.linspace(-1.0, 1.0, int(numsteps))
+    pos = fdopnew >= 0
+    with np.errstate(divide="ignore"):
+        etafrac = 1.0 / fdopnew[pos]
+    fits = []
+    for b in range(B):
+        spec = (profs[b][pos] + np.flip(profs[b][~pos])) / 2
+        try:
+            fit = fit_arc_profile(
+                spec, etafrac, float(etamin_b[b]), float(etamax_b[b]),
+                constraint=constraint, nsmooth=nsmooth,
+                low_power_diff=low_power_diff,
+                high_power_diff=high_power_diff, noise=noises[b],
+                noise_error=noise_error, log_parabola=log_parabola,
+                efac=efac)
+            fit.norm_fdop = fdopnew
+        except ValueError:
+            # one arc-free epoch must not kill the whole survey batch
+            # (the reference's per-epoch loop raises; its survey
+            # sorter quarantines — NaN is the batch-API equivalent)
+            fit = ArcFit(eta=np.nan, etaerr=np.nan, etaerr2=np.nan,
+                         eta_array=float(etamin_b[b]) * etafrac ** 2,
+                         profile=spec, norm_fdop=fdopnew,
+                         noise=noises[b])
+        fits.append(fit)
+    return fits

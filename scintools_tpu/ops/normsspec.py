@@ -72,6 +72,56 @@ def scaled_row_interp(sspec, fdop, tdel, eta, fdopnew, backend=None):
     return norm, mask
 
 
+def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
+                              cutmid=0, numsteps=10000, maxnormfac=1):
+    """Batched arc-normalised Doppler profile: ONE jitted program
+    computing, for every epoch of a same-geometry survey batch, the
+    delay-scrunched normalised profile that ``fit_arc`` peak-fits
+    (the reference computes it serially per epoch through
+    ``norm_sspec``, dynspec.py:970-1180 → :1920-2281; here the row
+    interpolation AND the masked mean are vmapped over epochs).
+
+    Geometry (axes, crop, cutmid, fdopnew grid) is baked; the
+    normalising curvature is a traced per-epoch scalar. Matches
+    ``normalise_sspec(..., maxnormfac=1, weighted=False)`` — the
+    fit_arc defaults (single arc, no log steps, unweighted average).
+
+    Returns jitted ``fn(sspecs[B, ntdel, nfdop], etas[B]) →
+    profiles[B, numsteps]`` (NaN where no delay row contributes).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    tdel = np.asarray(tdel, dtype=float)
+    fdop = np.asarray(fdop, dtype=float)
+    delmax = np.max(tdel) if delmax is None else delmax
+    ind = int(np.argmin(np.abs(tdel - delmax)))
+    tdel_c = tdel[startbin:ind]
+    nc = len(fdop)
+    cut_sl = (int(nc / 2 - np.floor(cutmid / 2)),
+              int(nc / 2 + np.floor(cutmid / 2))) if cutmid > 0 \
+        else None
+    # even grid, like normalise_sspec's nfdop rounding — the caller's
+    # ±fdop fold pairs bins about zero
+    numsteps = int(numsteps) + int(numsteps) % 2
+    fdopnew = np.linspace(-maxnormfac, maxnormfac, numsteps)
+
+    def one(sspec, eta):
+        s = sspec[startbin:ind, :]
+        if cut_sl is not None:
+            s = s.at[:, cut_sl[0]:cut_sl[1]].set(jnp.nan)
+        # the per-row interpolation + support mask are the serial
+        # path's scaled_row_interp, traced with a per-epoch eta
+        norm, mask = scaled_row_interp(s, fdop, tdel_c, eta, fdopnew,
+                                       backend="jax")
+        good = ~mask
+        num = jnp.sum(jnp.where(good, norm, 0.0), axis=0)
+        den = jnp.sum(good, axis=0)
+        return jnp.where(den > 0, num / den, jnp.nan)
+
+    return jax.jit(jax.vmap(one))
+
+
 def normalise_sspec(sspec, tdel, fdop, eta, delmax=None, startbin=1,
                     maxnormfac=5, minnormfac=0, cutmid=0, numsteps=None,
                     logsteps=False, weighted=True, interp_nan=False,

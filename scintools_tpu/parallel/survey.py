@@ -74,6 +74,28 @@ def make_thth_thin_grid_search_sharded(mesh, tau, fd, n_edges,
                    out_shardings=chunk_sh)
 
 
+def make_arc_profile_sharded(mesh, tdel, fdop, delmax=None,
+                             startbin=3, cutmid=3, numsteps=10000):
+    """Epoch-sharded arc-normalised profile program for the batched
+    survey arc fit (ops/fitarc.py:fit_arc_batch — the reference's
+    per-epoch ``fit_arc`` inside the survey loop, dynspec.py:4357 →
+    :970-1311, as one SPMD program). Returns ``(fn, n_devices)``;
+    the caller pads B to a multiple of n_devices."""
+    jax = get_jax()
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops.normsspec import make_arc_profile_batch_fn
+
+    fn = make_arc_profile_batch_fn(tdel, fdop, delmax=delmax,
+                                   startbin=startbin, cutmid=cutmid,
+                                   numsteps=numsteps)
+    sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    ndev = int(np.prod(list(mesh.shape.values())))
+    return jax.jit(fn, in_shardings=(sh, sh),
+                   out_shardings=sh), ndev
+
+
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
     """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
     the η grid split over every device of the mesh (CS replicated;

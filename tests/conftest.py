@@ -18,6 +18,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# initialise the backend at the 8-device count NOW: otherwise a test
+# that calls force_cpu_platform(n<8) first (e.g. an isolated
+# `-k dryrun` selection running dryrun_multichip(1)) pins the whole
+# process to fewer devices and every later mesh test fails. A plain
+# call, not an assert: the side effect must survive PYTHONOPTIMIZE,
+# and mesh-dependent tests do their own device-count checks.
+jax.devices()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

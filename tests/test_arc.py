@@ -134,3 +134,63 @@ class TestFitArc:
     def test_noise_estimate_positive(self, sim_sspec):
         _, fdop, tdel, sec = sim_sspec
         assert sspec_noise(sec, cutmid=3, n_rows=100) > 0
+
+
+class TestFitArcBatch:
+    """Batched survey arc fit (fit_arc_batch): one jitted profile
+    program over the epoch batch vs the reference's serial per-epoch
+    fit_arc (dynspec.py:4357 -> :970-1311)."""
+
+    @pytest.fixture(scope="class")
+    def arc_epochs(self):
+        import sys
+        sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+        from bench import make_arc_dynspec
+        from scintools_tpu.dynspec import BasicDyn, Dynspec
+
+        B, nt, nf = 3, 128, 128
+        dt, df, f0 = 2.0, 0.05, 1400.0
+        sspecs = []
+        tdel = fdop = None
+        for b in range(B):
+            dyn = make_arc_dynspec(nt, nf, dt, df, f0, 5e-4,
+                                   n_images=32, seed=50 + b)
+            bd = BasicDyn(dyn, name=f"e{b}",
+                          times=np.arange(nt) * dt,
+                          freqs=f0 + np.arange(nf) * df, dt=dt, df=df)
+            ds = Dynspec(dyn=bd, process=False, verbose=False,
+                         backend="numpy")
+            ds.calc_sspec(prewhite=False, lamsteps=False,
+                          window="hanning", window_frac=0.1)
+            sspecs.append(np.asarray(ds.sspec, float))
+            tdel, fdop = np.asarray(ds.tdel), np.asarray(ds.fdop)
+        return np.stack(sspecs), tdel, fdop
+
+    def test_matches_serial_fit_arc(self, arc_epochs):
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        fits_b = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000)
+        assert len(fits_b) == len(sspecs)
+        for b in range(len(sspecs)):
+            ref = fit_arc(sspecs[b], tdel, fdop, numsteps=2000,
+                          backend="numpy")[0]
+            assert fits_b[b].eta == pytest.approx(ref.eta, rel=1e-4)
+            assert fits_b[b].etaerr == pytest.approx(ref.etaerr,
+                                                     rel=1e-2)
+
+    def test_mesh_sharded_matches_unsharded(self, arc_epochs):
+        import jax
+
+        from scintools_tpu import parallel as par
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device mesh")
+        mesh = par.make_mesh(8)
+        sspecs, tdel, fdop = arc_epochs          # B=3: exercises pad
+        plain = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000)
+        sharded = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                                mesh=mesh)
+        for p, s in zip(plain, sharded):
+            assert s.eta == pytest.approx(p.eta, rel=1e-6)
