@@ -660,6 +660,74 @@ def bench_acf2d_fit(jax, jnp):
             "crop": nc, "params_agree": bool(dtau <= tol)}
 
 
+def bench_survey_arc(jax, jnp):
+    """Config #5b: the survey's per-epoch ARC fit — BASELINE #5 is
+    "sharded sspec + arc fit", and the plain `survey` config covers
+    the sspec+acf1d half. Here the arc-normalised profile program
+    runs once for the whole epoch batch (ops/fitarc.py:fit_arc_batch)
+    vs the reference's serial per-epoch fit_arc loop
+    (dynspec.py:4357 → :970-1311). Epochs are synthetic arcs of KNOWN
+    curvature, so besides batch-vs-serial agreement the recovered η
+    is gated against ground truth."""
+    from scintools_tpu.dynspec import BasicDyn, Dynspec
+    from scintools_tpu.ops.fitarc import fit_arc, fit_arc_batch
+
+    full = jax.default_backend() != "cpu"
+    B = 128 if full else 16
+    nt = nf = 128
+    dt, df, f0 = 2.0, 0.05, 1400.0
+    eta_true = 5e-4
+    numsteps = 2000
+
+    sspecs, tdel, fdop = [], None, None
+    for b in range(B + 2):
+        dyn = make_arc_dynspec(nt, nf, dt, df, f0, eta_true,
+                               n_images=32, seed=300 + b)
+        bd = BasicDyn(dyn, name=f"e{b}", times=np.arange(nt) * dt,
+                      freqs=f0 + np.arange(nf) * df, dt=dt, df=df)
+        ds = Dynspec(dyn=bd, process=False, verbose=False,
+                     backend="numpy")
+        ds.calc_sspec(prewhite=False, lamsteps=False,
+                      window="hanning", window_frac=0.1)
+        sspecs.append(np.asarray(ds.sspec, dtype=float))
+        tdel, fdop = np.asarray(ds.tdel), np.asarray(ds.fdop)
+    sspecs = np.stack(sspecs)
+    variants = [sspecs[i:i + B] for i in range(3)]
+
+    # ---- jax: one jitted profile program + host peak fits -----------
+    fits0 = fit_arc_batch(variants[0], tdel, fdop, numsteps=numsteps)
+    t_jax = _time_variants(
+        lambda s: fit_arc_batch(s, tdel, fdop, numsteps=numsteps),
+        [(v,) for v in variants], repeats=3 if full else 1)
+
+    # ---- numpy: the reference's serial per-epoch loop (failed fits
+    # quarantined as NaN, the way a survey sorter treats them) -------
+    def serial_one(s):
+        try:
+            return fit_arc(s, tdel, fdop, numsteps=numsteps,
+                           backend="numpy")[0].eta
+        except ValueError:
+            return np.nan
+
+    t0 = time.perf_counter()
+    eta_s = np.array([serial_one(variants[0][b]) for b in range(B)])
+    t_np = time.perf_counter() - t0
+
+    eta_b = np.array([f.eta for f in fits0])
+    both = np.isfinite(eta_b) & np.isfinite(eta_s)
+    agree = np.abs(eta_b[both] - eta_s[both]) \
+        <= 0.01 * np.abs(eta_s[both])
+    truth_err = np.abs(eta_b[np.isfinite(eta_b)] - eta_true) / eta_true
+    return {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+            "speedup": round(t_np / t_jax, 2), "epochs": B,
+            "epochs_per_sec": round(B / t_jax, 2),
+            "agree_frac": round(float(agree.mean()), 3)
+            if both.any() else None,
+            "eta_vs_truth_median_pct":
+                round(100 * float(np.median(truth_err)), 2)
+                if truth_err.size else None}
+
+
 def bench_sim_batch(jax, jnp):
     """Config #4: 64 Kolmogorov screens → dynspec → sspec, vmapped
     (ref scint_sim.py:169-236). numpy runs the same 64 screens
@@ -766,6 +834,7 @@ _EST_S = {
     "sspec_thth":    {"acc": 120, "cpu": 240},
     "acf_fit_batch": {"acc": 120, "cpu": 150},
     "survey":        {"acc": 150, "cpu": 120},
+    "survey_arc":    {"acc": 90,  "cpu": 90},
     "sim_batch":     {"acc": 60,  "cpu": 90},
     "acf_fit":       {"acc": 60,  "cpu": 60},
     "acf2d":         {"acc": 420, "cpu": 180},
@@ -864,6 +933,7 @@ def main():
         ("sspec_thth", bench_sspec_thth),
         ("acf_fit_batch", bench_acf_fit_batch),
         ("survey", bench_survey),
+        ("survey_arc", bench_survey_arc),
         ("sim_batch", bench_sim_batch),
         ("acf_fit", bench_acf_fit),
         ("acf2d", bench_acf2d_fit),
