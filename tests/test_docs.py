@@ -16,6 +16,7 @@ import matplotlib
 matplotlib.use("Agg")
 
 import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
@@ -69,6 +70,18 @@ def test_thth_intro_blocks_run():
     assert abs(ns["eta_fit"] - 44.0) < 5.0
     assert ns["eta_sig"] < 5.0
     assert len(ns["results"]) == 2
+
+
+def test_survey_scale_blocks_run():
+    ns = _run("survey_scale.md", scale_down=[
+        ("mesh = par.make_mesh(8)          # e.g. 8 devices",
+         "mesh = par.make_mesh(8)"),
+    ])
+    assert np.asarray(ns["params"]["tau"]).shape == (8,)
+    etas = ns["etas"]
+    ok = np.isfinite(etas)
+    assert ok.sum() >= 6                 # most arcs recovered
+    assert np.median(np.abs(etas[ok] / 5e-4 - 1)) < 0.25
 
 
 def test_dynspec_thth_blocks_run():
