@@ -121,6 +121,32 @@ def _time_variants(fn, variants, repeats):
     return best
 
 
+def _serial_acf1d_fit(dyn, nt, nf, dt, df):
+    """The reference's per-epoch acf1d recipe (host ACF cuts →
+    Bartlett weights → initial guesses → scipy least squares;
+    dynspec.py:2698, scint_models.py:29) — the ONE serial-baseline
+    implementation shared by every config that times it."""
+    from scintools_tpu.fit import (Parameters, minimize_leastsq,
+                                   models, acf_cuts_batch)
+    from scintools_tpu.fit.batch import (bartlett_weights,
+                                         initial_guesses_batch)
+
+    tcut, fcut = acf_cuts_batch(dyn[None], backend="numpy")
+    yt, yf = np.asarray(tcut[0]), np.asarray(fcut[0])
+    wt = bartlett_weights(yt, nt)
+    wf = bartlett_weights(yf, nf)
+    tau0, dnu0, amp0, _ = initial_guesses_batch(
+        yt, yf, dt, df, nt * dt, nf * df, np)
+    p = Parameters()
+    p.add("tau", value=float(tau0), vary=True, min=0, max=np.inf)
+    p.add("dnu", value=float(dnu0), vary=True, min=0, max=np.inf)
+    p.add("amp", value=float(amp0), vary=True, min=0, max=np.inf)
+    p.add("alpha", value=5 / 3, vary=False)
+    xt, xf = dt * np.arange(nt), df * np.arange(nf)
+    return minimize_leastsq(models.scint_acf_model, p,
+                            args=((xt, xf), (yt, yf), (wt, wf)))
+
+
 def bench_sspec_thth(jax, jnp):
     """Configs #1+#3: sspec + 200-η θ-θ search, 4×2 grid of 256²
     chunks (the headline; ref kernels dynspec.py:3584, ththmod.py:715)."""
@@ -428,10 +454,7 @@ def bench_acf_fit(jax, jnp):
     """Config #2: calc_acf + scint_acf_model fit (τ_d, Δν_d) on the
     same 1024×512 spectrum (ref dynspec.py:3750 + scint_models.py:112)."""
     from scintools_tpu.sim.simulation import Simulation
-    from scintools_tpu.fit import (Parameters, minimize_leastsq, models,
-                                   acf_cuts_batch, make_acf1d_batch)
-    from scintools_tpu.fit.batch import (bartlett_weights,
-                                         initial_guesses_batch)
+    from scintools_tpu.fit import make_acf1d_batch
 
     sim = Simulation(ns=512, nf=1024, dlam=0.25, seed=12, dt=2.0,
                      backend="jax")
@@ -443,25 +466,10 @@ def bench_acf_fit(jax, jnp):
             for i in range(3)]
 
     # ---- numpy baseline: reference pipeline (host fft ACF + scipy) --
-    def numpy_fit(dyn):
-        tcut, fcut = acf_cuts_batch(dyn[None], backend="numpy")
-        yt, yf = tcut[0], fcut[0]
-        wt = bartlett_weights(yt, nt)
-        wf = bartlett_weights(yf, nf)
-        tau0, dnu0, amp0, _ = initial_guesses_batch(
-            yt, yf, dt, df, nt * dt, nf * df, np)
-        p = Parameters()
-        p.add("tau", value=float(tau0), vary=True, min=0, max=np.inf)
-        p.add("dnu", value=float(dnu0), vary=True, min=0, max=np.inf)
-        p.add("amp", value=float(amp0), vary=True, min=0, max=np.inf)
-        p.add("alpha", value=5 / 3, vary=False)
-        xt, xf = dt * np.arange(nt), df * np.arange(nf)
-        return minimize_leastsq(models.scint_acf_model, p,
-                                args=((xt, xf), (yt, yf), (wt, wf)))
-
-    res_np = numpy_fit(dyns[0])
-    t_np = _time_variants(lambda d: numpy_fit(d),
-                          [(d,) for d in dyns], repeats=2)
+    res_np = _serial_acf1d_fit(dyns[0], nt, nf, dt, df)
+    t_np = _time_variants(
+        lambda d: _serial_acf1d_fit(d, nt, nf, dt, df),
+        [(d,) for d in dyns], repeats=2)
 
     # ---- jax: batched ACF + vmapped LM, one program -----------------
     from scintools_tpu.ops.acf import autocovariance
@@ -499,10 +507,7 @@ def bench_acf_fit_batch(jax, jnp):
     latency-bound and under-sells the architecture; this is the
     throughput number that reflects it."""
     from scintools_tpu.sim.simulation import simulate_dynspec_batch
-    from scintools_tpu.fit import (Parameters, minimize_leastsq, models,
-                                   acf_cuts_batch, make_acf1d_batch)
-    from scintools_tpu.fit.batch import (bartlett_weights,
-                                         initial_guesses_batch)
+    from scintools_tpu.fit import acf_cuts_batch, make_acf1d_batch
 
     full = jax.default_backend() != "cpu"
     B = 256 if full else 32
@@ -528,28 +533,10 @@ def bench_acf_fit_batch(jax, jnp):
         repeats=3 if full else 1)
 
     # ---- numpy: the reference's serial loop over the same epochs ----
-    xt, xf = dt * np.arange(nt), df * np.arange(nf)
-
     def numpy_serial(epochs):
         taus, dnus, terrs, ferrs = [], [], [], []
         for b in range(len(epochs)):
-            dyn = epochs[b]
-            tc, fc = acf_cuts_batch(dyn[None], backend="numpy")
-            yt, yf = np.asarray(tc[0]), np.asarray(fc[0])
-            wt = bartlett_weights(yt, nt)
-            wf = bartlett_weights(yf, nf)
-            tau0, dnu0, amp0, _ = initial_guesses_batch(
-                yt, yf, dt, df, nt * dt, nf * df, np)
-            p = Parameters()
-            p.add("tau", value=float(tau0), vary=True, min=0,
-                  max=np.inf)
-            p.add("dnu", value=float(dnu0), vary=True, min=0,
-                  max=np.inf)
-            p.add("amp", value=float(amp0), vary=True, min=0,
-                  max=np.inf)
-            p.add("alpha", value=5 / 3, vary=False)
-            res = minimize_leastsq(models.scint_acf_model, p,
-                                   args=((xt, xf), (yt, yf), (wt, wf)))
+            res = _serial_acf1d_fit(epochs[b], nt, nf, dt, df)
             taus.append(res.params["tau"].value)
             dnus.append(res.params["dnu"].value)
             terrs.append(res.params["tau"].stderr or 0.0)
@@ -772,10 +759,6 @@ def bench_survey(jax, jnp):
     from scintools_tpu import parallel as par
     from scintools_tpu.sim.simulation import simulate_dynspec_batch
     from scintools_tpu.ops.sspec import secondary_spectrum_power
-    from scintools_tpu.fit import (Parameters, minimize_leastsq, models,
-                                   acf_cuts_batch)
-    from scintools_tpu.fit.batch import (bartlett_weights,
-                                         initial_guesses_batch)
 
     # BASELINE config #5 is a ~1000-epoch archival survey; 32 epochs
     # (r2) was latency-bound and under-sold the sharded design — on an
@@ -798,25 +781,8 @@ def bench_survey(jax, jnp):
     # ---- numpy: serial per-epoch reference pipeline -----------------
     def numpy_survey(epochs):
         for b in range(B):
-            dyn = epochs[b]
-            secondary_spectrum_power(dyn, backend="numpy")
-            tcut, fcut = acf_cuts_batch(dyn[None], backend="numpy")
-            yt, yf = tcut[0], fcut[0]
-            wt = bartlett_weights(yt, nt)
-            wf = bartlett_weights(yf, nf)
-            tau0, dnu0, amp0, _ = initial_guesses_batch(
-                yt, yf, dt, df, nt * dt, nf * df, np)
-            p = Parameters()
-            p.add("tau", value=float(tau0), vary=True, min=0,
-                  max=np.inf)
-            p.add("dnu", value=float(dnu0), vary=True, min=0,
-                  max=np.inf)
-            p.add("amp", value=float(amp0), vary=True, min=0,
-                  max=np.inf)
-            p.add("alpha", value=5 / 3, vary=False)
-            xt, xf = dt * np.arange(nt), df * np.arange(nf)
-            minimize_leastsq(models.scint_acf_model, p,
-                             args=((xt, xf), (yt, yf), (wt, wf)))
+            secondary_spectrum_power(epochs[b], backend="numpy")
+            _serial_acf1d_fit(epochs[b], nt, nf, dt, df)
 
     t_np = _time_variants(numpy_survey, [(v,) for v in variants],
                           repeats=1)

@@ -1866,9 +1866,16 @@ class Dynspec:
                    display=True, colorbar=True, title=None,
                    figsize=(9, 9), subtract_artefacts=False,
                    overplot_curvature=None, dpi=200, velocity=False,
-                   vmin=None, vmax=None):
+                   vmin=None, vmax=None, **kwargs):
         # signature matches the reference exactly (dynspec.py:693-700);
-        # delmax is used directly on the tdel axis (dynspec.py:802-803)
+        # delmax is used directly on the tdel axis (dynspec.py:802-803).
+        # ref_freq alone is still tolerated (accepted-and-ignored by
+        # this package's earlier releases, never in the reference) so
+        # old call sites keep working; anything else is a real typo
+        kwargs.pop("ref_freq", None)
+        if kwargs:
+            raise TypeError("plot_sspec() got unexpected keyword "
+                            f"arguments {sorted(kwargs)}")
         from . import plotting
         return plotting.plot_sspec(
             self, lamsteps=lamsteps, input_sspec=input_sspec,

@@ -18,6 +18,10 @@ from scipy.signal import savgol_filter
 from .normsspec import normalise_sspec
 from ..fit.models import fit_parabola, fit_log_parabola
 
+# compiled arc-profile programs keyed on (geometry, mesh) — see
+# fit_arc_batch
+_ARC_PROFILE_CACHE = {}
+
 
 @dataclass
 class ArcFit:
@@ -275,25 +279,40 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
                                (B,)).copy()
     noises = [sspec_noise(s, cutmid, n_rows=ind) for s in sspecs]
 
+    # cache the compiled profile program per (geometry, mesh): a
+    # survey driver calls this per epoch batch, and a rebuilt jax.jit
+    # retraces+recompiles every time (~200× the warm run). Same
+    # pattern as dynspec._SHARDED_GRID_CACHE.
+    mesh_key = None
     if mesh is not None:
-        from ..parallel.survey import make_arc_profile_sharded
+        mesh_key = (tuple(d.id for d in np.ravel(mesh.devices)),
+                    tuple(mesh.axis_names),
+                    tuple(mesh.shape.values()))
+    key = (yaxis.tobytes(), fdop.tobytes(), float(delmax),
+           int(startbin), int(cutmid), int(numsteps), mesh_key)
+    entry = _ARC_PROFILE_CACHE.get(key)
+    if entry is None:
+        if len(_ARC_PROFILE_CACHE) >= 8:
+            _ARC_PROFILE_CACHE.pop(next(iter(_ARC_PROFILE_CACHE)))
+        if mesh is not None:
+            from ..parallel.survey import make_arc_profile_sharded
 
-        fn, ndev = make_arc_profile_sharded(
-            mesh, yaxis, fdop, delmax=delmax, startbin=startbin,
-            cutmid=cutmid, numsteps=int(numsteps))
-        pad = (-B) % ndev
-        s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
-            if pad else sspecs
-        e_in = np.concatenate([etamin_b] + [etamin_b[-1:]] * pad) \
-            if pad else etamin_b
-        profs = np.asarray(fn(jnp.asarray(s_in),
-                              jnp.asarray(e_in)))[:B]
-    else:
-        fn = make_arc_profile_batch_fn(
-            yaxis, fdop, delmax=delmax, startbin=startbin,
-            cutmid=cutmid, numsteps=int(numsteps))
-        profs = np.asarray(fn(jnp.asarray(sspecs),
-                              jnp.asarray(etamin_b)))
+            entry = make_arc_profile_sharded(
+                mesh, yaxis, fdop, delmax=delmax, startbin=startbin,
+                cutmid=cutmid, numsteps=int(numsteps))
+        else:
+            entry = (make_arc_profile_batch_fn(
+                yaxis, fdop, delmax=delmax, startbin=startbin,
+                cutmid=cutmid, numsteps=int(numsteps)), 1)
+        _ARC_PROFILE_CACHE[key] = entry
+    fn, ndev = entry
+
+    pad = (-B) % ndev
+    s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
+        if pad else sspecs
+    e_in = np.concatenate([etamin_b] + [etamin_b[-1:]] * pad) \
+        if pad else etamin_b
+    profs = np.asarray(fn(jnp.asarray(s_in), jnp.asarray(e_in)))[:B]
 
     fdopnew = np.linspace(-1.0, 1.0, int(numsteps))
     pos = fdopnew >= 0

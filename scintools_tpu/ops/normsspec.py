@@ -87,7 +87,8 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
     fit_arc defaults (single arc, no log steps, unweighted average).
 
     Returns jitted ``fn(sspecs[B, ntdel, nfdop], etas[B]) →
-    profiles[B, numsteps]`` (NaN where no delay row contributes).
+    profiles[B, numsteps]`` (0.0 where no delay row contributes —
+    the serial path's ``np.ma.average`` fill, reference-pinned).
     """
     jax = get_jax()
     import jax.numpy as jnp
@@ -117,7 +118,11 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
         good = ~mask
         num = jnp.sum(jnp.where(good, norm, 0.0), axis=0)
         den = jnp.sum(good, axis=0)
-        return jnp.where(den > 0, num / den, jnp.nan)
+        # fully-masked bins are 0.0, NOT NaN: the serial path's
+        # np.ma.average fills them with 0.0 (reference-pinned,
+        # tests/test_golden_reference.py) and the downstream peak fit
+        # must see the identical profile
+        return jnp.where(den > 0, num / den, 0.0)
 
     return jax.jit(jax.vmap(one))
 

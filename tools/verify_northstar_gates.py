@@ -52,7 +52,13 @@ def main():
     dyn, eta_true = prob["dyns"][0], prob["eta_true"]
     ncf, nct = nf // cf, nt // ct
     n_chunks = ncf * nct
-    group = args.group or (8 if n_chunks % 8 == 0 else 4)
+    # largest group ≤ 8 that divides the chunk grid (1 always does),
+    # validated BEFORE the multi-minute numpy pass
+    group = args.group or next(g for g in (8, 4, 2, 1)
+                               if n_chunks % g == 0)
+    if n_chunks % group:
+        raise SystemExit(f"--group {group} does not divide the "
+                         f"{n_chunks}-chunk grid")
 
     print(f"platform={jax.default_backend()} size={nf} "
           f"chunks={n_chunks} group={group}", file=sys.stderr)
