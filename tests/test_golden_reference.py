@@ -44,6 +44,20 @@ class TestSimulationGolden:
         np.testing.assert_allclose(ours / scale, ref / scale,
                                    atol=2e-6)
 
+    def test_seed_exact_anisotropic(self, gold):
+        """Anisotropic screen (ar=2, psi=30): exercises the
+        spectral-weight cross terms (scint_sim.py:276-292)."""
+        from scintools_tpu.sim.simulation import Simulation
+
+        sim = Simulation(mb2=4, rf=1, ds=0.01, alpha=5 / 3, ar=2,
+                         psi=30, inner=0.001, ns=64, nf=32,
+                         dlam=0.25, seed=7, backend="numpy")
+        ref = np.asarray(gold["sim_aniso_dyn"], dtype=float)
+        ours = np.asarray(sim.spi, dtype=float)
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(ours / scale, ref / scale,
+                                   atol=2e-6)
+
 
 @pytest.mark.skipif(not os.path.exists(J0437),
                     reason="J0437 sample data not mounted")

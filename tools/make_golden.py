@@ -62,6 +62,12 @@ def main():
     out["sim_dyn"] = np.asarray(sim.spi, dtype=np.float32)
     out["sim_seed"] = 42
     out["sim_ns"], out["sim_nf"] = 128, 64
+    # anisotropic screen (exercises the spectral-weight cross terms,
+    # scint_sim.py:276-292) — seed-exact like the isotropic case
+    sim_a = ss.Simulation(mb2=4, rf=1, ds=0.01, alpha=5 / 3, ar=2,
+                          psi=30, inner=0.001, ns=64, nf=32,
+                          dlam=0.25, seed=7)
+    out["sim_aniso_dyn"] = np.asarray(sim_a.spi, dtype=np.float32)
 
     # ---- 2. J0437 epoch: load + sspec + ACF -------------------------
     from scintools.dynspec import Dynspec
