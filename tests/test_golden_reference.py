@@ -431,6 +431,34 @@ class TestRetrievalCoreGolden:
                                       gold["interpnan_out"])
 
 
+class TestResultsCsvGolden:
+    def test_write_results_byte_identical(self, gold):
+        """The survey results CSV (scint_utils.py:103-202) matches the
+        reference byte-for-byte: header-once-then-append logic and the
+        exact column set for a fitted epoch."""
+        import os
+        import tempfile
+
+        from scintools_tpu.io.results import write_results
+
+        class FakeDyn:
+            pass
+
+        d = FakeDyn()
+        d.name, d.mjd, d.freq = "ep1", 55915.3, 1382.0
+        d.bw, d.tobs, d.dt, d.df = 400.0, 3600.0, 8.0, 0.78
+        d.tau, d.tauerr = 1234.5, 56.7
+        d.dnu, d.dnuerr = 33.1, 0.34
+        d.scint_param_method = "acf1d"
+        d.betaeta, d.betaetaerr = 0.139, 0.0007
+        with tempfile.TemporaryDirectory() as td:
+            f = os.path.join(td, "r.csv")
+            write_results(f, dyn=d)
+            write_results(f, dyn=d)
+            ours = open(f, "rb").read()
+        assert ours == gold["results_csv"].tobytes()
+
+
 class TestRickettACFGolden:
     def test_acf_grid_matches(self, gold):
         """The GEMM-factorised Fresnel integral reproduces the

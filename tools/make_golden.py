@@ -151,6 +151,30 @@ def main():
                   window_frac=0.1)
     out["j0437_sspec_prewhite"] = e1.sspec.astype(np.float64)
 
+    # ---- 2e. results-CSV schema (scint_utils.py write_results) ------
+    # two appends of a fitted-epoch record: header logic + row text
+    import tempfile
+
+    import scintools.scint_utils as su
+
+    class _FakeDyn:
+        pass
+
+    fd_rec = _FakeDyn()
+    fd_rec.name, fd_rec.mjd, fd_rec.freq = "ep1", 55915.3, 1382.0
+    fd_rec.bw, fd_rec.tobs, fd_rec.dt, fd_rec.df = (400.0, 3600.0,
+                                                    8.0, 0.78)
+    fd_rec.tau, fd_rec.tauerr = 1234.5, 56.7
+    fd_rec.dnu, fd_rec.dnuerr = 33.1, 0.34
+    fd_rec.scint_param_method = "acf1d"
+    fd_rec.betaeta, fd_rec.betaetaerr = 0.139, 0.0007
+    with tempfile.TemporaryDirectory() as td:
+        fcsv = os.path.join(td, "r.csv")
+        su.write_results(fcsv, dyn=fd_rec)
+        su.write_results(fcsv, dyn=fd_rec)
+        out["results_csv"] = np.frombuffer(
+            open(fcsv, "rb").read(), dtype=np.uint8)
+
     # ---- 3. θ-θ eigenvalue curve on a simulated chunk ---------------
     import astropy.units as u
     import scintools.ththmod as thth
