@@ -1,0 +1,57 @@
+"""Unit algebra of the golden-generation astropy shim
+(tools/astropy_shim.py). A shim bug can only ever FAIL golden tests,
+never create false confidence — but a broken shim blocks regenerating
+the fixtures, so pin its dimensional rules here."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+import astropy_shim as sh  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def u():
+    sh.install()
+    import astropy.units as units
+
+    return units
+
+
+class TestShimUnits:
+    def test_sqrt_halves_the_unit_power(self, u):
+        q = (np.array([4.0]) * u.us) / (1.0 * u.s ** 3)
+        r = np.sqrt(q)
+        # us/s**3 = 1e-6 s^-2 → sqrt = 1e-3 s^-1 = mHz exactly
+        assert r.unit.power == pytest.approx(-1)
+        np.testing.assert_allclose(r.to(u.mHz).value, [2.0])
+
+    def test_sqrt_result_comparable_with_mhz(self, u):
+        tau = np.array([8.0]) * u.us
+        eta = 2.0 * u.s ** 3
+        lim = np.sqrt(tau.max() / eta)
+        edges = np.array([1.0, 3.0]) * u.mHz
+        assert list(np.abs(edges) < lim) == [True, False]
+
+    def test_reductions_stay_quantities(self, u):
+        q = np.arange(4.0) * u.us
+        assert float(q.max().value) == 3.0
+        assert float(q.sum().value) == 6.0
+        assert float(q.mean().value) == 1.5     # exercises out= unwrap
+
+    def test_conversion_and_mismatch(self, u):
+        q = np.array([1.0]) * u.us
+        np.testing.assert_allclose(q.to(u.s).value, [1e-6])
+        with pytest.raises(sh.UnitConversionError):
+            q.to(u.mHz)
+
+    def test_passthrough_keeps_first_unit(self, u):
+        q = np.array([-2.0, 3.0]) * u.mHz
+        r = np.abs(q)
+        assert r.unit.power == q.unit.power
+        np.testing.assert_allclose(np.asarray(r.value), [2.0, 3.0])
