@@ -23,8 +23,19 @@ authoritative record. Honesty guarantees (VERDICT r1):
   process otherwise) with bounded retries and a compile-tolerant
   budget, and its full per-attempt record is embedded under
   ``probe``;
-- every repeat uses perturbed inputs (the tunneled TPU can serve
-  repeat executions with bit-identical inputs from a cache in ~0 ms).
+- every TIMED call uses an input buffer never seen by the warm-up
+  (the tunneled TPU serves repeat executions with bit-identical
+  inputs from a cache in ~0 ms — observed live: a 4096² program
+  "re-ran" in 0.0 s when the warm-up variant was re-timed);
+- ``jax.block_until_ready`` does NOT block on the tunneled platform
+  (observed live: 0.000 s on a fresh 4096² input whose real result
+  took 11 s to materialise), so every timed call forces execution by
+  FETCHING a small program output (np.asarray). Large outputs (the
+  full sspec, the survey power stack) stay device-resident — they
+  are outputs of the SAME XLA program, so the fetch of any output
+  waits for the whole program; only kilobytes cross the tunnel
+  inside the timed region. A plausibility floor rejects any timing
+  below 1 ms as a non-executing call.
 
 Env knobs: SCINTOOLS_BENCH_NO_PROBE=1 skips the probe (trust the
 default platform); SCINTOOLS_BENCH_PROBE_ATTEMPTS / _PROBE_TIMEOUT /
@@ -53,8 +64,13 @@ import time
 import numpy as np
 
 PROBE_CODE = (
-    "import jax, numpy as np, jax.numpy as jnp;"
-    "x = jnp.asarray(np.ones((64, 64), np.float32));"
+    # the probe input is randomised per invocation: the tunnel
+    # memoises program+input content, so a constant probe re-run
+    # after the startup probe could "pass" from the cache while the
+    # device itself is wedged
+    "import os, jax, numpy as np, jax.numpy as jnp;"
+    "v = 1.0 + int.from_bytes(os.urandom(2), 'little') / 65536.0;"
+    "x = jnp.asarray(np.full((64, 64), v, np.float32));"
     "f = jax.jit(lambda a: jnp.fft.fft2(a).real.sum());"
     "print(float(f(x)), float(f(x + 1)))"
 )
@@ -111,14 +127,36 @@ def probe_accelerator(deadline=None):
 def _time_variants(fn, variants, repeats):
     """Best wall time of fn(variant) over ``repeats`` calls, cycling
     through pre-built perturbed inputs so no two calls see identical
-    buffers."""
+    buffers. Callers must pass only variants the warm-up call never
+    touched, and repeats ≤ len(variants): the tunneled TPU memoises
+    executions by program+input content, so ANY bit-identical repeat
+    times as ~0 ms and corrupts the min."""
+    if repeats > len(variants):
+        raise ValueError(
+            f"repeats={repeats} > {len(variants)} distinct variants "
+            "— a repeated input would be served from the tunnel cache")
     best = np.inf
     for i in range(repeats):
         args = variants[i % len(variants)]
         t0 = time.perf_counter()
         fn(*args)
         best = min(best, time.perf_counter() - t0)
+    if best < 1e-3:
+        raise RuntimeError(
+            f"timed {best:.2e}s — below the 1 ms plausibility floor; "
+            "the timed call did not actually execute (async dispatch "
+            "not forced by an output fetch?)")
     return best
+
+
+def _fetch(tree):
+    """Force execution of an async-dispatched program by fetching its
+    (small) outputs to host: block_until_ready does not block on the
+    tunneled platform (module docstring), so every timed jax call must
+    end in a host fetch of some program output."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, tree)
 
 
 def _serial_acf1d_fit(dyn, nt, nf, dt, df):
@@ -187,9 +225,13 @@ def bench_sspec_thth(jax, jnp):
                            constant_values=chunk.mean()))))
         return CS_list
 
-    # perturbed input variants (see module docstring)
+    # perturbed input variants (see module docstring): variant 0 is
+    # the warm-up/validation input, variants 1..3 are timed; a trace
+    # run gets its own 5th variant (a traced repeat of an executed
+    # input would be served from the tunnel cache and record nothing)
+    trace_dir = os.environ.get("SCINTOOLS_BENCH_TRACE")
     dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
-            for i in range(3)]
+            for i in range(5 if trace_dir else 4)]
     cs_lists = [make_inputs(d) for d in dyns]
 
     # ---- numpy baseline: reference per-chunk loop, scipy eigsh/η ----
@@ -221,21 +263,25 @@ def bench_sspec_thth(jax, jnp):
          jnp.asarray(np.stack([cs_to_ri(CS) for CS in cs])
                      .astype(np.float32)), e_j)
         for d, cs in zip(dyns, cs_lists)]
-    sec_j, eigs_j = jax.block_until_ready(jax_pipeline(*jvariants[0]))
+    sec_j, eigs_j = jax_pipeline(*jvariants[0])
+    eigs_j = np.asarray(eigs_j)          # forces compile + execution
 
     def run_jax(*args):
-        jax.block_until_ready(jax_pipeline(*args))
+        # fetching the (8, 200) eigenvalue block forces the whole
+        # program (sspec included — same XLA program); the sspec
+        # itself stays in HBM, exactly as a real pipeline would use it
+        np.asarray(jax_pipeline(*args)[1])
 
-    trace_dir = os.environ.get("SCINTOOLS_BENCH_TRACE")
     if trace_dir:
         from scintools_tpu.utils.profiling import trace
 
         with trace(trace_dir):
-            run_jax(*jvariants[0])
+            run_jax(*jvariants[-1])     # dedicated trace-only variant
     # CPU fallback: one repeat keeps a dead-TPU bench inside the
-    # driver's budget (the jax-on-CPU headline run is ~70 s/call)
+    # driver's budget (the jax-on-CPU headline run is ~70 s/call).
+    # Timed variants EXCLUDE the warm-up input (tunnel cache).
     reps = 3 if jax.default_backend() != "cpu" else 1
-    t_jax = _time_variants(run_jax, jvariants, repeats=reps)
+    t_jax = _time_variants(run_jax, jvariants[1:4], repeats=reps)
 
     # ---- cross-backend Δη (north star <1%): compare only significant
     # fits — flat-peak (arc-free) chunks have η errors of tens of % --
@@ -370,7 +416,8 @@ def bench_north_star(jax, jnp):
     # is recorded in the output
     full = jax.default_backend() != "cpu"
     nf = nt = 4096 if full else 1024
-    prob = make_north_star_problem(nf, nt)
+    # variant 0 warms up + validates; the rest are timed (cache rule)
+    prob = make_north_star_problem(nf, nt, n_variants=4 if full else 2)
     cf, ct, npad = prob["cf"], prob["ct"], prob["npad"]
     tau, fd = prob["tau"], prob["fd"]
     etas, edges, wins = prob["etas"], prob["edges"], prob["wins"]
@@ -417,13 +464,16 @@ def bench_north_star(jax, jnp):
     e_j = jnp.asarray(etas)
     jvariants = [(jnp.asarray(d, dtype=jnp.float32), e_j)
                  for d in dyns]
-    sec_j, eigs_j = jax.block_until_ready(jax_pipeline(*jvariants[0]))
+    sec_j, eigs_j = jax_pipeline(*jvariants[0])
+    eigs_j = np.asarray(eigs_j)          # forces compile + execution
 
     def run_jax(*args):
-        jax.block_until_ready(jax_pipeline(*args))
+        # fetching the (64, 200) eigenvalue block forces the whole
+        # program; the 8192²-padded sspec stays device-resident
+        np.asarray(jax_pipeline(*args)[1])
 
     reps = 3 if jax.default_backend() != "cpu" else 1
-    t_jax = _time_variants(run_jax, jvariants, repeats=reps)
+    t_jax = _time_variants(run_jax, jvariants[1:], repeats=reps)
 
     # ---- Δη: numpy-vs-jax cross-check AND vs ground truth ----------
     mismatches, true_errs = [], []
@@ -463,7 +513,7 @@ def bench_acf_fit(jax, jnp):
     dt, df = sim.dt, sim.df
     rng = np.random.default_rng(6)
     dyns = [dyn0 + 1e-6 * i * rng.standard_normal(dyn0.shape)
-            for i in range(3)]
+            for i in range(4)]
 
     # ---- numpy baseline: reference pipeline (host fft ACF + scipy) --
     res_np = _serial_acf1d_fit(dyns[0], nt, nf, dt, df)
@@ -482,10 +532,10 @@ def bench_acf_fit(jax, jnp):
         fcut = acf[:, nf:, nt]
         return fit(tcut, fcut)
 
-    out = jax.block_until_ready(jax_fit(jnp.asarray(dyns[0])))
-    jvars = [(jnp.asarray(d),) for d in dyns]
+    out = _fetch(jax_fit(jnp.asarray(dyns[0])))
+    jvars = [(jnp.asarray(d),) for d in dyns[1:]]   # cache rule
     t_jax = _time_variants(
-        lambda d: jax.block_until_ready(jax_fit(d)), jvars, repeats=3)
+        lambda d: _fetch(jax_fit(d)), jvars, repeats=3)
 
     dtau = abs(float(out["tau"][0]) - res_np.params["tau"].value)
     ddnu = abs(float(out["dnu"][0]) - res_np.params["dnu"].value)
@@ -514,9 +564,9 @@ def bench_acf_fit_batch(jax, jnp):
     nf, nt = 512, 128                   # archival J0437 epoch shape
     dt, df = 2.0, 0.05
     epochs0 = np.transpose(np.asarray(
-        simulate_dynspec_batch(B + 2, ns=nt, nf=nf, seed=77)),
+        simulate_dynspec_batch(B + 3, ns=nt, nf=nf, seed=77)),
         (0, 2, 1)).astype(np.float64)
-    variants = [epochs0[i:i + B] for i in range(3)]
+    variants = [epochs0[i:i + B] for i in range(4)]
 
     # ---- jax: batched ACF + one vmapped LM program ------------------
     fit = make_acf1d_batch(nt, nf, dt, df)
@@ -526,10 +576,10 @@ def bench_acf_fit_batch(jax, jnp):
         tcut, fcut = acf_cuts_batch(d, backend="jax")
         return fit(tcut, fcut)
 
-    out = jax.block_until_ready(jax_batch(jnp.asarray(variants[0])))
+    out = _fetch(jax_batch(jnp.asarray(variants[0])))
     t_jax = _time_variants(
-        lambda d: jax.block_until_ready(jax_batch(d)),
-        [(jnp.asarray(v),) for v in variants],
+        lambda d: _fetch(jax_batch(d)),
+        [(jnp.asarray(v),) for v in variants[1:]],   # cache rule
         repeats=3 if full else 1)
 
     # ---- numpy: the reference's serial loop over the same epochs ----
@@ -602,7 +652,7 @@ def bench_acf2d_fit(jax, jnp):
     clean = -np.asarray(mdl.scint_acf_model_2d(
         truth, np.zeros((nc, nc)), np.ones((nc, nc))))
     ydatas = [clean + 0.01 * clean.max()
-              * rng.standard_normal((nc, nc)) for _ in range(3)]
+              * rng.standard_normal((nc, nc)) for _ in range(4)]
 
     def host_fit(y):
         return minimize_leastsq(mdl.scint_acf_model_2d,
@@ -630,7 +680,7 @@ def bench_acf2d_fit(jax, jnp):
                              y, None, n_iter=60)
 
     res_j = tpu_fit(ydatas[0])               # compile (cached after)
-    t_jax = _time_variants(tpu_fit, [(y,) for y in ydatas],
+    t_jax = _time_variants(tpu_fit, [(y,) for y in ydatas[1:]],
                            repeats=3 if full else 1)
     if res_np is not None:
         dtau = abs(res_j.params["tau"].value
@@ -661,15 +711,19 @@ def bench_survey_arc(jax, jnp):
 
     full = jax.default_backend() != "cpu"
     B = 128 if full else 16
-    nt = nf = 128
+    # 256² epochs with 96 images: the serial fit itself recovers the
+    # known curvature to ~1% median here (at 128²/32 images the
+    # profile-peak scatter is ~8-15% for BOTH backends — a workload
+    # property, not a path difference)
+    nt = nf = 256
     dt, df, f0 = 2.0, 0.05, 1400.0
     eta_true = 5e-4
     numsteps = 2000
 
     sspecs, tdel, fdop = [], None, None
-    for b in range(B + 2):
+    for b in range(B + 3):
         dyn = make_arc_dynspec(nt, nf, dt, df, f0, eta_true,
-                               n_images=32, seed=300 + b)
+                               n_images=96, seed=300 + b)
         bd = BasicDyn(dyn, name=f"e{b}", times=np.arange(nt) * dt,
                       freqs=f0 + np.arange(nf) * df, dt=dt, df=df)
         ds = Dynspec(dyn=bd, process=False, verbose=False,
@@ -679,13 +733,19 @@ def bench_survey_arc(jax, jnp):
         sspecs.append(np.asarray(ds.sspec, dtype=float))
         tdel, fdop = np.asarray(ds.tdel), np.asarray(ds.fdop)
     sspecs = np.stack(sspecs)
-    variants = [sspecs[i:i + B] for i in range(3)]
+    variants = [sspecs[i:i + B] for i in range(4)]
+    # epochs staged on device up-front, like every other config: a
+    # steady-state survey keeps its batch resident in HBM, and the
+    # tunnel link (~2 MB/s up) would otherwise be what gets timed
+    dev = [jnp.asarray(v, dtype=jnp.float32) for v in variants]
 
     # ---- jax: one jitted profile program + host peak fits -----------
-    fits0 = fit_arc_batch(variants[0], tdel, fdop, numsteps=numsteps)
+    fits0 = fit_arc_batch(variants[0], tdel, fdop, numsteps=numsteps,
+                          sspecs_device=dev[0])
     t_jax = _time_variants(
-        lambda s: fit_arc_batch(s, tdel, fdop, numsteps=numsteps),
-        [(v,) for v in variants], repeats=3 if full else 1)
+        lambda s, d: fit_arc_batch(s, tdel, fdop, numsteps=numsteps,
+                                   sspecs_device=d),
+        list(zip(variants[1:], dev[1:])), repeats=3 if full else 1)
 
     # ---- numpy: the reference's serial per-epoch loop (failed fits
     # quarantined as NaN, the way a survey sorter treats them) -------
@@ -732,7 +792,9 @@ def bench_sim_batch(jax, jnp):
         power = jax.vmap(
             lambda d: secondary_spectrum_power(d, backend="jax"))(
                 jnp.transpose(dyns, (0, 2, 1)))
-        return jax.block_until_ready(power)
+        # scalar checksum fetch forces the whole batch to execute;
+        # the power stack itself stays device-resident
+        return float(jnp.sum(jnp.abs(power)))
 
     jax_run(100)                                   # compile
     t_jax = _time_variants(jax_run, [(101,), (102,), (103,)], repeats=3)
@@ -769,14 +831,22 @@ def bench_survey(jax, jnp):
     epochs0 = np.transpose(np.asarray(
         simulate_dynspec_batch(B + 3, ns=nt, nf=nf, seed=42)),
         (0, 2, 1)).astype(np.float32)
-    variants = [epochs0[i:i + B] for i in range(3)]
+    variants = [epochs0[i:i + B] for i in range(4)]
 
     mesh = par.make_mesh(min(jax.device_count(), B))
     step = par.make_survey_step(mesh, nf, nt, dt=dt, df=df)
-    jax.block_until_ready(step(jnp.asarray(variants[0]))[1])
+
+    def run_step(d):
+        # fetch the small per-epoch outputs (params dict + chisq, a
+        # few kB) — forces the whole program; the sspec power stack
+        # stays device-resident
+        params, chisq, _, _, _ = step(d)
+        _fetch((params, chisq))
+
+    run_step(jnp.asarray(variants[0]))
     t_jax = _time_variants(
-        lambda d: jax.block_until_ready(step(d)[1]),
-        [(jnp.asarray(v),) for v in variants], repeats=3)
+        run_step,
+        [(jnp.asarray(v),) for v in variants[1:]], repeats=3)
 
     # ---- numpy: serial per-epoch reference pipeline -----------------
     def numpy_survey(epochs):
@@ -800,10 +870,10 @@ _EST_S = {
     "sspec_thth":    {"acc": 120, "cpu": 240},
     "acf_fit_batch": {"acc": 120, "cpu": 150},
     "survey":        {"acc": 150, "cpu": 120},
-    "survey_arc":    {"acc": 90,  "cpu": 90},
+    "survey_arc":    {"acc": 120, "cpu": 90},
     "sim_batch":     {"acc": 60,  "cpu": 90},
     "acf_fit":       {"acc": 60,  "cpu": 60},
-    "acf2d":         {"acc": 420, "cpu": 180},
+    "acf2d":         {"acc": 150, "cpu": 180},
 }
 
 
@@ -904,12 +974,55 @@ def main():
         ("acf_fit", bench_acf_fit),
         ("acf2d", bench_acf2d_fit),
     ]
+    # The tunneled TPU can WEDGE mid-run (observed live: after a
+    # healthy 4096² headline run, the next config's first device call
+    # blocked >900 s and even `jnp.ones((256,256)).sum()` in a fresh
+    # process hung). A native-blocked call cannot be preempted
+    # in-process, so before each accelerator config a short
+    # out-of-process probe checks the tunnel still answers; two
+    # consecutive failures mark the remaining configs skipped and
+    # leave the watchdog nothing to burn.
+    wedge_fails = 0
     for name, fn in plan:
         remaining = deadline - time.time()
         if remaining < _EST_S[name][est_key] + 30:
             configs[name] = {"skipped":
                              f"~{_EST_S[name][est_key]}s estimated, "
                              f"{remaining:.0f}s left in budget"}
+            _emit()
+            continue
+        if (state["platform"] != "cpu" and wedge_fails < 2
+                and not os.environ.get("SCINTOOLS_BENCH_NO_PROBE")):
+            t_probe = time.time()
+            try:
+                r = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                                   timeout=60, capture_output=True)
+                healthy = r.returncode == 0
+            except subprocess.TimeoutExpired:
+                healthy = False
+            if not healthy:
+                wedge_fails += 1
+                configs[name] = {
+                    "skipped": "tunnel unresponsive (probe "
+                               f"{time.time() - t_probe:.0f}s)"}
+                print(f"WARNING: {name}: tunnel unresponsive",
+                      file=sys.stderr)
+                _emit()
+                continue
+            wedge_fails = 0
+            # the probe itself costs budget (fresh jax import +
+            # tunnel compile, up to 60 s) — re-check affordability
+            # before starting the config
+            remaining = deadline - time.time()
+            if remaining < _EST_S[name][est_key] + 30:
+                configs[name] = {
+                    "skipped": f"~{_EST_S[name][est_key]}s estimated, "
+                               f"{remaining:.0f}s left after probe"}
+                _emit()
+                continue
+        elif wedge_fails >= 2:
+            configs[name] = {"skipped": "tunnel wedged (2 consecutive "
+                                        "probe failures)"}
             _emit()
             continue
         try:

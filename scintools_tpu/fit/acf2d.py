@@ -60,7 +60,11 @@ def _build(nt_crop, nf_crop, dt, df, ar, alpha, theta, tau0, vary,
 
     solver = jax.jit(make_lm_solver(residual, n_iter=n_iter,
                                     bounds=(lo, hi)))
-    return solver, residual
+    # the returned residual is jitted too: the covariance and final
+    # residual evaluations call it directly, and the eager (un-jitted)
+    # complex Fresnel model is UNIMPLEMENTED on the TPU backend —
+    # everything that touches the model must run compiled
+    return solver, jax.jit(residual)
 
 
 def fit_acf2d_tpu(params, ydata, weights, n_iter=60):

@@ -227,7 +227,8 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
                   startbin=3, cutmid=3, etamax=None, etamin=None,
                   low_power_diff=-1, high_power_diff=-0.5,
                   constraint=(0, np.inf), nsmooth=5, efac=1,
-                  noise_error=True, log_parabola=False, mesh=None):
+                  noise_error=True, log_parabola=False, mesh=None,
+                  sspecs_device=None):
     """Arc-curvature fit over a whole batch of same-geometry epochs.
 
     The reference runs ``fit_arc`` serially per epoch inside its
@@ -245,6 +246,13 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
     or m⁻¹) and ``fdop`` (mHz); ``etamin``/``etamax`` may be scalars
     (shared) or per-epoch arrays. Returns a list of B
     :class:`ArcFit`.
+
+    ``sspecs_device`` optionally supplies the SAME spectra as an
+    already-staged device array (any float dtype) — a steady-state
+    survey pipeline keeps epochs resident on device, and re-uploading
+    them per call would time the host link instead of the program.
+    The host ``sspecs`` copy is still required (noise estimates and
+    peak fits are host work).
     """
     import jax.numpy as jnp
 
@@ -308,11 +316,22 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
     fn, ndev = entry
 
     pad = (-B) % ndev
-    s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
-        if pad else sspecs
     e_in = np.concatenate([etamin_b] + [etamin_b[-1:]] * pad) \
         if pad else etamin_b
-    profs = np.asarray(fn(jnp.asarray(s_in), jnp.asarray(e_in)))[:B]
+    if sspecs_device is not None:
+        if tuple(sspecs_device.shape) != sspecs.shape:
+            raise ValueError(
+                f"sspecs_device shape {tuple(sspecs_device.shape)} "
+                f"!= host sspecs shape {sspecs.shape} — the device "
+                "copy must be the same epoch batch")
+        s_dev = sspecs_device
+        if pad:
+            s_dev = jnp.concatenate([s_dev] + [s_dev[-1:]] * pad)
+    else:
+        s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
+            if pad else sspecs
+        s_dev = jnp.asarray(s_in)
+    profs = np.asarray(fn(s_dev, jnp.asarray(e_in)))[:B]
 
     fdopnew = np.linspace(-1.0, 1.0, int(numsteps))
     pos = fdopnew >= 0

@@ -111,18 +111,20 @@ class TestProbe:
         assert res["rec"]["attempts"] == []
 
     def test_probe_records_attempts_on_failure(self):
-        # a 5s probe timeout makes the failure deterministic and fast
-        # whatever the real platform is doing (the sitecustomize may
-        # hang on a dead tunnel long before JAX_PLATFORMS is read)
+        # force the probe subprocess itself to fail: env-based platform
+        # sabotage (JAX_PLATFORMS=not_a_platform) is NOT deterministic —
+        # the site's accelerator plugin overrides the variable when the
+        # tunnel is alive, and this test must pass either way
         env = dict(os.environ, SCINTOOLS_BENCH_PROBE_ATTEMPTS="2",
                    SCINTOOLS_BENCH_PROBE_TIMEOUT="5",
-                   SCINTOOLS_BENCH_PROBE_SLEEP="0",
-                   JAX_PLATFORMS="definitely_not_a_platform")
+                   SCINTOOLS_BENCH_PROBE_SLEEP="0")
         env.pop("SCINTOOLS_BENCH_NO_PROBE", None)  # ambient dev knob
         out = subprocess.run(
             [sys.executable, "-c",
              "import sys, json; sys.path.insert(0, %r);"
-             "import bench; rec, ok = bench.probe_accelerator();"
+             "import bench;"
+             "bench.PROBE_CODE = 'raise SystemExit(1)';"
+             "rec, ok = bench.probe_accelerator();"
              "print(json.dumps({'ok': ok,"
              " 'n': len(rec['attempts'])}))"
              % os.path.dirname(bench.__file__)],

@@ -64,14 +64,17 @@ def main():
                 prob["wins"], prob["tau"], prob["fd"], prob["edges"],
                 group, method=method)
 
+            # force execution by FETCHING the small eigenvalue output:
+            # block_until_ready does not block on the tunneled TPU
+            # (bench.py module docstring)
             t0 = time.perf_counter()
-            jax.block_until_ready(pipe(*jvariants[-1]))  # warm-up only
+            np.asarray(pipe(*jvariants[-1])[1])          # warm-up only
             compile_s = time.perf_counter() - t0
             best = np.inf
             for r in range(args.reps):
                 a = jvariants[r % (len(jvariants) - 1)]
                 t0 = time.perf_counter()
-                jax.block_until_ready(pipe(*a))
+                np.asarray(pipe(*a)[1])
                 best = min(best, time.perf_counter() - t0)
             print(f"method={method:6s} group={group:3d}  "
                   f"compile={compile_s:6.1f}s  best={best:7.3f}s  "
