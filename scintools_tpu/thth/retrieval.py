@@ -162,6 +162,16 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
     anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
 
     def retrieval(chunks, edges, eta, tau_mask):
+        # trace-time precision pin: on TPU the default f32 matmul
+        # drops operands to bf16 on the MXU, and the eigendecomposition
+        # underneath the rank-1 model is matmul-built — full f32
+        # passes keep the cross-backend wavefield drift down to what
+        # the platform's FFT precision imposes (tools/tpu_smoke.py
+        # gates it); CPU is unaffected (highest is already native)
+        with jax.default_matmul_precision("highest"):
+            return _retrieval_body(chunks, edges, eta, tau_mask)
+
+    def _retrieval_body(chunks, edges, eta, tau_mask):
         B = chunks.shape[0]
         # --- pad (mean fill) → conjugate spectra (ththmod.py:777-786)
         mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)

@@ -179,6 +179,54 @@ class TestFitArcBatch:
             assert fits_b[b].etaerr == pytest.approx(ref.etaerr,
                                                      rel=1e-2)
 
+    def test_nonuniform_fdop_falls_back_and_matches(self, arc_epochs):
+        """A non-uniform Doppler axis must route the batch profile
+        program to the any-grid interp (the tent-kernel matmul assumes
+        uniform spacing) and still produce the serial path's profile."""
+        from scintools_tpu.ops.normsspec import (
+            make_arc_profile_batch_fn, scaled_row_interp)
+
+        sspecs, tdel, fdop = arc_epochs
+        # warp the axis monotonically but non-uniformly (~15% spread)
+        u = np.linspace(-1.0, 1.0, len(fdop))
+        fdop_nu = fdop * (1 + 0.075 * u ** 2)
+        startbin, cutmid, numsteps = 3, 3, 400
+        fn = make_arc_profile_batch_fn(tdel, fdop_nu,
+                                       startbin=startbin,
+                                       cutmid=cutmid,
+                                       numsteps=numsteps)
+        etas = np.full(len(sspecs), 2e-4)
+        profs = np.asarray(fn(sspecs, etas))
+
+        # serial reference: the same per-epoch masked-mean profile via
+        # the numpy any-grid interp
+        ind = int(np.argmin(np.abs(tdel - tdel.max())))
+        tdel_c = tdel[startbin:ind]
+        nc = len(fdop_nu)
+        fdopnew = np.linspace(-1, 1, numsteps)
+        for b in range(len(sspecs)):
+            s = sspecs[b][startbin:ind].copy()
+            s[:, nc // 2 - 1:nc // 2 + 1] = np.nan
+            norm, mask = scaled_row_interp(s, fdop_nu, tdel_c,
+                                           etas[b], fdopnew,
+                                           backend="numpy")
+            good = ~mask
+            den = good.sum(axis=0)
+            num = np.where(good, norm, 0.0).sum(axis=0)
+            expect = np.where(den > 0, num / np.maximum(den, 1), 0.0)
+            np.testing.assert_allclose(profs[b], expect, rtol=1e-6,
+                                       atol=1e-9)
+
+    def test_device_copy_shape_mismatch_raises(self, arc_epochs):
+        import jax.numpy as jnp
+
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        with pytest.raises(ValueError, match="sspecs_device"):
+            fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                          sspecs_device=jnp.zeros((1, 4, 4)))
+
     def test_mesh_sharded_matches_unsharded(self, arc_epochs):
         import jax
 

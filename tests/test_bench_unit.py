@@ -12,6 +12,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -75,6 +76,24 @@ class TestNorthStarProblem:
         assert len(prob["etas"]) == 200
         # eta grid brackets the ground truth
         assert prob["etas"][0] < prob["eta_true"] < prob["etas"][-1]
+
+
+class TestTimeVariants:
+    def test_rejects_more_repeats_than_variants(self):
+        with pytest.raises(ValueError, match="distinct variants"):
+            bench._time_variants(lambda: None, [()], repeats=2)
+
+    def test_rejects_implausibly_fast_calls(self):
+        # a sub-ms "timing" means the call never executed (async
+        # dispatch not forced by an output fetch) — must be an error,
+        # never a recorded number
+        with pytest.raises(RuntimeError, match="plausibility floor"):
+            bench._time_variants(lambda: None, [(), (), ()], repeats=3)
+
+    def test_times_real_work(self):
+        t = bench._time_variants(lambda: time.sleep(0.002),
+                                 [(), ()], repeats=2)
+        assert t >= 1e-3
 
 
 class TestProbe:
