@@ -51,6 +51,35 @@ def sspec_noise(sspec, cutmid, n_rows):
     return noise / np.sqrt(n_rows * 2)
 
 
+def sspec_noise_batch(sspecs, cutmid, n_rows):
+    """:func:`sspec_noise` over an epoch batch ``[B, nr, nc]`` in one
+    vectorised pass (one std per epoch instead of B python calls).
+    The two quadrant slices stay views — their first/second moments
+    combine into the concatenated population std without the copy."""
+    sspecs = np.asarray(sspecs)
+    _, nr, nc = sspecs.shape
+    a = sspecs[:, int(nr / 2):, int(nc / 2 + np.ceil(cutmid / 2)):]
+    b = sspecs[:, int(nr / 2):, 0:int(nc / 2 - np.floor(cutmid / 2))]
+    # pooled-variance combination of the two slices' (stable, two-pass)
+    # per-epoch moments — NOT the one-pass E[x²]−E[x]² form, which
+    # cancels catastrophically when std ≪ |mean|
+    na = a.shape[1] * a.shape[2]
+    nb = b.shape[1] * b.shape[2]
+    n = na + nb
+    # an empty quadrant (narrow Doppler axis + large cutmid)
+    # contributes nothing — mirror the serial path's concatenation,
+    # where the empty slice simply vanishes
+    zeros = np.zeros(len(sspecs))
+    mu_a = a.mean(axis=(1, 2)) if na else zeros
+    mu_b = b.mean(axis=(1, 2)) if nb else zeros
+    var_a = a.var(axis=(1, 2)) if na else zeros
+    var_b = b.var(axis=(1, 2)) if nb else zeros
+    mu = (na * mu_a + nb * mu_b) / n
+    var = (na * (var_a + (mu_a - mu) ** 2)
+           + nb * (var_b + (mu_b - mu) ** 2)) / n
+    return np.sqrt(var) / np.sqrt(n_rows * 2)
+
+
 def _profile_from_norm(ns, asymm=False):
     """Fold the scrunched profile about fdop=0 (dynspec.py:1166-1180)."""
     prof = np.asarray(ns.normsspecavg).squeeze()
@@ -88,7 +117,20 @@ def fit_arc_profile(spec, etafrac, etamin, etamax, constraint=(0, np.inf),
             f"profile has only {len(spec)} valid points — too few for "
             f"smoothing window nsmooth={nsmooth}")
     smoothed = savgol_filter(spec, nsmooth, 1)
+    return _peak_parabola(spec, smoothed, eta_array,
+                          constraint=constraint,
+                          low_power_diff=low_power_diff,
+                          high_power_diff=high_power_diff, noise=noise,
+                          noise_error=noise_error,
+                          log_parabola=log_parabola, efac=efac)
 
+
+def _peak_parabola(spec, smoothed, eta_array, constraint=(0, np.inf),
+                   low_power_diff=-1, high_power_diff=-0.5, noise=0.0,
+                   noise_error=True, log_parabola=False, efac=1):
+    """Peak walk-out + parabola fit on an already-smoothed profile
+    (dynspec.py:1205-1282). Split from :func:`fit_arc_profile` so the
+    batch path can smooth whole epoch groups in one savgol call."""
     inrange = np.flatnonzero((eta_array > constraint[0])
                              & (eta_array < constraint[1]))
     if len(inrange) == 0:
@@ -285,7 +327,7 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
                                (B,)).copy()
     etamax_b = np.broadcast_to(np.asarray(etamax, dtype=float),
                                (B,)).copy()
-    noises = [sspec_noise(s, cutmid, n_rows=ind) for s in sspecs]
+    noises = sspec_noise_batch(sspecs, cutmid, n_rows=ind)
 
     # cache the compiled profile program per (geometry, mesh): a
     # survey driver calls this per epoch batch, and a rebuilt jax.jit
@@ -307,11 +349,11 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
 
             entry = make_arc_profile_sharded(
                 mesh, yaxis, fdop, delmax=delmax, startbin=startbin,
-                cutmid=cutmid, numsteps=int(numsteps))
+                cutmid=cutmid, numsteps=int(numsteps), fold=True)
         else:
             entry = (make_arc_profile_batch_fn(
                 yaxis, fdop, delmax=delmax, startbin=startbin,
-                cutmid=cutmid, numsteps=int(numsteps)), 1)
+                cutmid=cutmid, numsteps=int(numsteps), fold=True), 1)
         _ARC_PROFILE_CACHE[key] = entry
     fn, ndev = entry
 
@@ -331,31 +373,61 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
         s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
             if pad else sspecs
         s_dev = jnp.asarray(s_in)
-    profs = np.asarray(fn(s_dev, jnp.asarray(e_in)))[:B]
+    # device program returns the ±fdop-folded profile (fold=True):
+    # half the fetch over the tunnel, and the fold rides the chip
+    folded = np.asarray(fn(s_dev, jnp.asarray(e_in)))[:B]
 
     fdopnew = np.linspace(-1.0, 1.0, int(numsteps))
     pos = fdopnew >= 0
     with np.errstate(divide="ignore"):
         etafrac = 1.0 / fdopnew[pos]
-    fits = []
+
+    # Per-epoch prep (finite mask, η-range crop) is cheap numpy; the
+    # expensive savgol smoothing — dominated by its edge polyfits —
+    # runs ONCE per group of equal-length profiles (one 2-D call),
+    # which in the common survey case (shared geometry and η range,
+    # geometry-determined NaN pattern) is a single call for all B
+    # epochs. Row-wise it is the same computation scipy performs on a
+    # 1-D input, so the result matches fit_arc_profile exactly.
+    prepped = {}
+    fits = [None] * B
+
+    def _nan_fit(b, spec):
+        # one arc-free epoch must not kill the whole survey batch
+        # (the reference's per-epoch loop raises; its survey sorter
+        # quarantines — NaN is the batch-API equivalent)
+        return ArcFit(eta=np.nan, etaerr=np.nan, etaerr2=np.nan,
+                      eta_array=float(etamin_b[b]) * etafrac ** 2,
+                      profile=spec, norm_fdop=fdopnew,
+                      noise=noises[b])
+
     for b in range(B):
-        spec = (profs[b][pos] + np.flip(profs[b][~pos])) / 2
-        try:
-            fit = fit_arc_profile(
-                spec, etafrac, float(etamin_b[b]), float(etamax_b[b]),
-                constraint=constraint, nsmooth=nsmooth,
-                low_power_diff=low_power_diff,
-                high_power_diff=high_power_diff, noise=noises[b],
-                noise_error=noise_error, log_parabola=log_parabola,
-                efac=efac)
-            fit.norm_fdop = fdopnew
-        except ValueError:
-            # one arc-free epoch must not kill the whole survey batch
-            # (the reference's per-epoch loop raises; its survey
-            # sorter quarantines — NaN is the batch-API equivalent)
-            fit = ArcFit(eta=np.nan, etaerr=np.nan, etaerr2=np.nan,
-                         eta_array=float(etamin_b[b]) * etafrac ** 2,
-                         profile=spec, norm_fdop=fdopnew,
-                         noise=noises[b])
-        fits.append(fit)
+        spec = folded[b]
+        valid = np.isfinite(spec)
+        spec_v = np.flip(spec[valid])
+        ef_v = np.flip(etafrac[valid])
+        eta_arr = float(etamin_b[b]) * ef_v ** 2
+        sel = eta_arr < float(etamax_b[b])
+        spec_s = spec_v[sel]
+        if len(spec_s) <= nsmooth:
+            fits[b] = _nan_fit(b, spec)
+            continue
+        prepped.setdefault(len(spec_s), []).append(
+            (b, spec, spec_s, eta_arr[sel]))
+
+    for _, items in prepped.items():
+        smoothed = savgol_filter(
+            np.stack([it[2] for it in items]), nsmooth, 1, axis=-1)
+        for (b, spec, spec_s, eta_s), sm_row in zip(items, smoothed):
+            try:
+                fit = _peak_parabola(
+                    spec_s, sm_row, eta_s, constraint=constraint,
+                    low_power_diff=low_power_diff,
+                    high_power_diff=high_power_diff, noise=noises[b],
+                    noise_error=noise_error,
+                    log_parabola=log_parabola, efac=efac)
+                fit.norm_fdop = fdopnew
+                fits[b] = fit
+            except ValueError:
+                fits[b] = _nan_fit(b, spec)
     return fits

@@ -93,7 +93,8 @@ def scaled_row_interp(sspec, fdop, tdel, eta, fdopnew, backend=None):
 
 
 def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
-                              cutmid=0, numsteps=10000, maxnormfac=1):
+                              cutmid=0, numsteps=10000, maxnormfac=1,
+                              fold=False):
     """Batched arc-normalised Doppler profile: ONE jitted program
     computing, for every epoch of a same-geometry survey batch, the
     delay-scrunched normalised profile that ``fit_arc`` peak-fits
@@ -109,6 +110,11 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
     Returns jitted ``fn(sspecs[B, ntdel, nfdop], etas[B]) →
     profiles[B, numsteps]`` (0.0 where no delay row contributes —
     the serial path's ``np.ma.average`` fill, reference-pinned).
+
+    With ``fold=True`` the ±fdop halves are averaged about zero
+    INSIDE the program (fit_arc's folding, dynspec.py:1166-1180) and
+    the output is ``[B, numsteps//2]`` over the fdopnew ≥ 0 bins —
+    halving the device→host fetch, which matters on a tunneled link.
     """
     jax = get_jax()
     import jax.numpy as jnp
@@ -202,7 +208,16 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
         # must see the identical profile
         return jnp.where(den > 0, num / den, 0.0)
 
-    return jax.jit(jax.vmap(one if uniform else one_any_grid))
+    base = jax.vmap(one if uniform else one_any_grid)
+    if not fold:
+        return jax.jit(base)
+    pos = fdopnew >= 0
+
+    def folded(sspecs, etas):
+        profs = base(sspecs, etas)
+        return (profs[:, pos] + jnp.flip(profs[:, ~pos], axis=1)) / 2
+
+    return jax.jit(folded)
 
 
 def normalise_sspec(sspec, tdel, fdop, eta, delmax=None, startbin=1,

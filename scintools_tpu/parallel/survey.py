@@ -75,7 +75,8 @@ def make_thth_thin_grid_search_sharded(mesh, tau, fd, n_edges,
 
 
 def make_arc_profile_sharded(mesh, tdel, fdop, delmax=None,
-                             startbin=3, cutmid=3, numsteps=10000):
+                             startbin=3, cutmid=3, numsteps=10000,
+                             fold=False):
     """Epoch-sharded arc-normalised profile program for the batched
     survey arc fit (ops/fitarc.py:fit_arc_batch — the reference's
     per-epoch ``fit_arc`` inside the survey loop, dynspec.py:4357 →
@@ -89,7 +90,7 @@ def make_arc_profile_sharded(mesh, tdel, fdop, delmax=None,
 
     fn = make_arc_profile_batch_fn(tdel, fdop, delmax=delmax,
                                    startbin=startbin, cutmid=cutmid,
-                                   numsteps=numsteps)
+                                   numsteps=numsteps, fold=fold)
     sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     ndev = int(np.prod(list(mesh.shape.values())))
     return jax.jit(fn, in_shardings=(sh, sh),

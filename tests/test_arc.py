@@ -135,6 +135,51 @@ class TestFitArc:
         _, fdop, tdel, sec = sim_sspec
         assert sspec_noise(sec, cutmid=3, n_rows=100) > 0
 
+    def test_noise_batch_matches_serial(self, sim_sspec):
+        from scintools_tpu.ops.fitarc import sspec_noise_batch
+
+        _, fdop, tdel, sec = sim_sspec
+        rng = np.random.default_rng(7)
+        batch = np.stack([sec + rng.normal(0, 0.5, sec.shape)
+                          for _ in range(4)])
+        got = sspec_noise_batch(batch, cutmid=3, n_rows=100)
+        want = [sspec_noise(s, cutmid=3, n_rows=100) for s in batch]
+        np.testing.assert_allclose(got, want, rtol=1e-10)
+
+    def test_noise_batch_stable_on_offset_float32(self):
+        """Large mean offset with tiny scatter in float32 — the
+        pooled-moment path must not cancel catastrophically."""
+        from scintools_tpu.ops.fitarc import sspec_noise_batch
+
+        rng = np.random.default_rng(11)
+        batch = (1e4 + rng.normal(0, 1e-3, (2, 64, 64))) \
+            .astype(np.float32)
+        got = sspec_noise_batch(batch, cutmid=3, n_rows=30)
+        want = [sspec_noise(s, cutmid=3, n_rows=30) for s in batch]
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    def test_noise_batch_empty(self):
+        from scintools_tpu.ops.fitarc import sspec_noise_batch
+
+        got = sspec_noise_batch(np.zeros((0, 64, 64)), cutmid=3,
+                                n_rows=30)
+        assert got.shape == (0,)
+
+    def test_noise_batch_empty_quadrant_matches_serial(self):
+        """A zero-width quadrant slice (narrow Doppler axis + large
+        cutmid) must vanish, exactly as it does in the serial path's
+        concatenation — not poison the pooled variance with NaN."""
+        from scintools_tpu.ops.fitarc import sspec_noise_batch
+
+        rng = np.random.default_rng(3)
+        batch = rng.normal(5.0, 2.0, (3, 32, 8))
+        # odd cutmid=7 with nc=8: slice a (right of centre) is
+        # zero-width while slice b keeps one column
+        got = sspec_noise_batch(batch, cutmid=7, n_rows=16)
+        want = [sspec_noise(s, cutmid=7, n_rows=16) for s in batch]
+        assert np.all(np.isfinite(got))
+        np.testing.assert_allclose(got, want, rtol=1e-10)
+
 
 class TestFitArcBatch:
     """Batched survey arc fit (fit_arc_batch): one jitted profile
@@ -216,6 +261,28 @@ class TestFitArcBatch:
             expect = np.where(den > 0, num / np.maximum(den, 1), 0.0)
             np.testing.assert_allclose(profs[b], expect, rtol=1e-6,
                                        atol=1e-9)
+
+    def test_folded_program_matches_host_fold(self, arc_epochs):
+        """fold=True folds the ±fdop halves inside the jitted program
+        (halving the device→host fetch); it must equal folding the
+        fold=False output on host."""
+        from scintools_tpu.ops.normsspec import (
+            make_arc_profile_batch_fn)
+
+        sspecs, tdel, fdop = arc_epochs
+        numsteps = 400
+        kw = dict(startbin=3, cutmid=3, numsteps=numsteps)
+        etas = np.full(len(sspecs), 2e-4)
+        profs = np.asarray(
+            make_arc_profile_batch_fn(tdel, fdop, **kw)(sspecs, etas))
+        folded = np.asarray(
+            make_arc_profile_batch_fn(tdel, fdop, fold=True,
+                                      **kw)(sspecs, etas))
+        pos = np.linspace(-1.0, 1.0, numsteps) >= 0
+        expect = (profs[:, pos] + np.flip(profs[:, ~pos], axis=1)) / 2
+        assert folded.shape == (len(sspecs), numsteps // 2)
+        np.testing.assert_allclose(folded, expect, rtol=1e-6,
+                                   atol=1e-9)
 
     def test_device_copy_shape_mismatch_raises(self, arc_epochs):
         import jax.numpy as jnp
