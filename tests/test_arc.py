@@ -262,6 +262,24 @@ class TestFitArcBatch:
             np.testing.assert_allclose(profs[b], expect, rtol=1e-6,
                                        atol=1e-9)
 
+    def test_per_epoch_eta_ranges_match_serial(self, arc_epochs):
+        """Per-epoch etamin/etamax arrays give different post-crop
+        profile lengths, so the grouped savgol path runs with several
+        length groups — each epoch must still match its serial fit."""
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        B = len(sspecs)
+        etamin = np.full(B, 2e-5)
+        etamax = np.array([3e-3, 1.5e-3, 2.4e-3])[:B]
+        fits_b = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                               etamin=etamin, etamax=etamax)
+        for b in range(B):
+            ref = fit_arc(sspecs[b], tdel, fdop, numsteps=2000,
+                          etamin=etamin[b], etamax=etamax[b],
+                          backend="numpy")[0]
+            assert fits_b[b].eta == pytest.approx(ref.eta, rel=1e-4)
+
     def test_folded_program_matches_host_fold(self, arc_epochs):
         """fold=True folds the ±fdop halves inside the jitted program
         (halving the device→host fetch); it must equal folding the

@@ -72,17 +72,30 @@ def main():
     print(f"wavefield {wf.shape}, mean |W|^2 / mean dyn = {ratio:.3g}")
     assert 0.01 < ratio < 100, "wavefield power scale is off"
     assert rel < 0.01, "cross-backend curvature disagrees >1%"
-    # full retrieval + mosaic + GS cross-backend intensity check (the
-    # end-to-end guard for the complex-transfer ban on the chip): the
-    # jax retrieval is float32 BY DESIGN (TPU), so the floor against
-    # the float64 numpy path is ~1e-3 at this scale (measured
-    # 1.052e-3 jax-on-CPU, correlation 0.999999); gate at 5e-3
-    Ij = np.abs(np.asarray(ds_j.wavefield)) ** 2
+    # full retrieval + mosaic cross-backend intensity check (the
+    # end-to-end guard for the complex-transfer ban on the chip),
+    # gated at a COMMON curvature: each backend's own fitted η
+    # differs by up to the 1% gate above, and feeding different η
+    # into the θ-θ gather legitimately moves the intensity by ~1e-2
+    # (measured 1.68e-2 on-chip for a 0.36% Δη) — that spread is the
+    # η-fit's, already gated. With η pinned to the numpy fit, what
+    # remains is pure retrieval numerics: jax f32 BY DESIGN (TPU) vs
+    # the f64 numpy path floors at ~1e-3 here (measured 1.052e-3
+    # both jax-on-CPU and on-chip, correlation 0.999999); gate 5e-3.
+    Ij_own = np.abs(np.asarray(ds_j.wavefield)) ** 2
     In = np.abs(np.asarray(ds_n.wavefield)) ** 2
+    rel_own = float(np.linalg.norm(Ij_own - In) / np.linalg.norm(In))
+    print(f"wavefield intensity (each backend's own η): rel L2 "
+          f"{rel_own:.3e} [informational — tracks Δη]")
+    ds_j.ththeta = ds_n.ththeta
+    ds_j.ththetaerr = ds_n.ththetaerr
+    ds_j.thetatheta_chunks()
+    ds_j.calc_wavefield()
+    Ij = np.abs(np.asarray(ds_j.wavefield)) ** 2
     rel_int = float(np.linalg.norm(Ij - In) / np.linalg.norm(In))
     corr = float(np.corrcoef(Ij.ravel(), In.ravel())[0, 1])
-    print(f"wavefield intensity cross-backend: rel L2 {rel_int:.3e}, "
-          f"corr {corr:.6f}")
+    print(f"wavefield intensity cross-backend at common η: rel L2 "
+          f"{rel_int:.3e}, corr {corr:.6f}")
     assert rel_int < 5e-3, "wavefield intensity diverges across backends"
     assert corr > 0.9999, "wavefield intensity decorrelated"
     print("TPU smoke OK")
