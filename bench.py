@@ -153,10 +153,56 @@ def _fetch(tree):
     """Force execution of an async-dispatched program by fetching its
     (small) outputs to host: block_until_ready does not block on the
     tunneled platform (module docstring), so every timed jax call must
-    end in a host fetch of some program output."""
+    end in a host fetch of some program output.
+
+    Multi-leaf trees are packed into ONE device array per dtype group
+    (an async dispatch, no extra round trip) and fetched in a single
+    transfer: a per-leaf ``np.asarray`` costs one tunnel round trip
+    per leaf, and at the ~70 ms RTT observed live that turned an
+    8-leaf params fetch into ~0.5 s of pure latency inside the timed
+    region. The pack consumes every leaf, so the single fetch still
+    forces the whole upstream program."""
     import jax
 
-    return jax.tree_util.tree_map(np.asarray, tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dev = [isinstance(x, jax.Array) for x in leaves]
+    if sum(dev) <= 1:
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    groups = {}                       # dtype -> [leaf index]
+    for i, x in enumerate(leaves):
+        if dev[i]:
+            groups.setdefault(np.dtype(x.dtype), []).append(i)
+    out = [x if dev[i] else np.asarray(x)
+           for i, x in enumerate(leaves)]
+    for dt_, idxs in groups.items():
+        if len(idxs) == 1:
+            out[idxs[0]] = np.asarray(leaves[idxs[0]])
+            continue
+        flat = np.asarray(_pack_leaves(*[leaves[i] for i in idxs]))
+        off = 0
+        for i in idxs:
+            sz = leaves[i].size
+            out[i] = flat[off:off + sz].reshape(leaves[i].shape)
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+_PACK_JIT = None
+
+
+def _pack_leaves(*xs):
+    # one persistent jit wrapper: jax caches compilations per input
+    # signature on it, so repeat fetches of the same tree shape cost
+    # no retrace inside the timed region
+    global _PACK_JIT
+    if _PACK_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        _PACK_JIT = jax.jit(
+            lambda *ys: jnp.concatenate([y.ravel() for y in ys]))
+    return _PACK_JIT(*xs)
 
 
 def _serial_acf1d_fit(dyn, nt, nf, dt, df):

@@ -100,18 +100,7 @@ def fit_arc_profile(spec, etafrac, etamin, etamax, constraint=(0, np.inf),
                     efac=1):
     """Peak search + parabola fit on one folded profile
     (dynspec.py:1182-1282)."""
-    spec = np.asarray(spec).squeeze()
-    etafrac = np.asarray(etafrac).squeeze()
-
-    valid = np.isfinite(spec)
-    spec = np.flip(spec[valid])
-    etafrac = np.flip(etafrac[valid])
-
-    eta_array = etamin * etafrac ** 2
-    sel = eta_array < etamax
-    eta_array = eta_array[sel]
-    spec = spec[sel]
-
+    spec, eta_array = _prep_profile(spec, etafrac, etamin, etamax)
     if len(spec) <= nsmooth:
         raise ValueError(
             f"profile has only {len(spec)} valid points — too few for "
@@ -123,6 +112,22 @@ def fit_arc_profile(spec, etafrac, etamin, etamax, constraint=(0, np.inf),
                           high_power_diff=high_power_diff, noise=noise,
                           noise_error=noise_error,
                           log_parabola=log_parabola, efac=efac)
+
+
+def _prep_profile(spec, etafrac, etamin, etamax):
+    """Shared profile prep (dynspec.py:1182-1203): finite mask, flip
+    to ascending η, crop at etamax. One definition for the serial and
+    batch paths so their semantics cannot drift."""
+    spec = np.asarray(spec).squeeze()
+    etafrac = np.asarray(etafrac).squeeze()
+
+    valid = np.isfinite(spec)
+    spec = np.flip(spec[valid])
+    etafrac = np.flip(etafrac[valid])
+
+    eta_array = float(etamin) * etafrac ** 2
+    sel = eta_array < float(etamax)
+    return spec[sel], eta_array[sel]
 
 
 def _peak_parabola(spec, smoothed, eta_array, constraint=(0, np.inf),
@@ -403,17 +408,13 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
 
     for b in range(B):
         spec = folded[b]
-        valid = np.isfinite(spec)
-        spec_v = np.flip(spec[valid])
-        ef_v = np.flip(etafrac[valid])
-        eta_arr = float(etamin_b[b]) * ef_v ** 2
-        sel = eta_arr < float(etamax_b[b])
-        spec_s = spec_v[sel]
+        spec_s, eta_s = _prep_profile(spec, etafrac, etamin_b[b],
+                                      etamax_b[b])
         if len(spec_s) <= nsmooth:
             fits[b] = _nan_fit(b, spec)
             continue
         prepped.setdefault(len(spec_s), []).append(
-            (b, spec, spec_s, eta_arr[sel]))
+            (b, spec, spec_s, eta_s))
 
     for _, items in prepped.items():
         smoothed = savgol_filter(

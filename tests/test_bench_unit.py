@@ -96,6 +96,39 @@ class TestTimeVariants:
         assert t >= 1e-3
 
 
+class TestFetch:
+    """_fetch packs multi-leaf trees into one device array per dtype
+    group (one tunnel round trip instead of one per leaf) and must
+    preserve tree structure, shapes, dtypes, and values."""
+
+    def test_multi_leaf_dict_roundtrip(self):
+        import jax.numpy as jnp
+
+        import bench
+
+        tree = {"tau": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                "dnu": jnp.ones(4, dtype=jnp.float32) * 2.5,
+                "n": jnp.arange(3, dtype=jnp.int32),
+                "scalar": jnp.float32(7.0)}
+        got = bench._fetch(tree)
+        assert set(got) == set(tree)
+        for k in tree:
+            assert isinstance(got[k], np.ndarray)
+            assert got[k].shape == np.shape(tree[k])
+            assert got[k].dtype == np.dtype(tree[k].dtype)
+            np.testing.assert_array_equal(got[k], np.asarray(tree[k]))
+
+    def test_single_leaf_and_nondevice_leaves(self):
+        import jax.numpy as jnp
+
+        import bench
+
+        got = bench._fetch((jnp.ones(3), np.arange(2), 5.0))
+        np.testing.assert_array_equal(got[0], np.ones(3))
+        np.testing.assert_array_equal(got[1], np.arange(2))
+        assert float(np.asarray(got[2])) == 5.0
+
+
 class TestProbe:
     def test_no_probe_env_short_circuits(self):
         env = dict(os.environ, SCINTOOLS_BENCH_NO_PROBE="1")
