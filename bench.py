@@ -469,8 +469,11 @@ def bench_north_star(jax, jnp):
     etas, edges, wins = prob["etas"], prob["edges"], prob["wins"]
     dyns, eta_true = prob["dyns"], prob["eta_true"]
     ncf, nct = nf // cf, nt // ct               # 8×8 = 64 chunks full
+    # default from the tools/tune_northstar.py sweep on the v5e chip
+    # (2026-07-31): group 8 → 2.24 s, 16 → 1.63 s, 32 → 2.32 s,
+    # 64 → HBM ResourceExhausted; 16 is the measured optimum
     group = int(os.environ.get("SCINTOOLS_BENCH_NS_GROUP",
-                               8 if full else 4))
+                               16 if full else 4))
     if (ncf * nct) % group:
         raise ValueError(f"SCINTOOLS_BENCH_NS_GROUP={group} must "
                          f"divide the chunk count {ncf * nct}")
