@@ -25,9 +25,49 @@ def _load_jax():
         import jax
         import jax.numpy as jnp
 
+        _maybe_enable_compilation_cache(jax)
         _jax = jax
         _jnp = jnp
     return _jax, _jnp
+
+
+def _maybe_enable_compilation_cache(jax):
+    """Point XLA's persistent compilation cache at a per-user dir so
+    repeat processes skip recompilation (measured: a θ-θ test module
+    re-runs in 3.1 s instead of 7.7 s on CPU; first TPU compiles via
+    the tunnel are 20-40 s, so warm processes gain far more there
+    when the backend supports executable serialisation).
+
+    ``SCINTOOLS_XLA_CACHE=<dir>`` overrides the location, ``=0``
+    disables; an explicit jax-level setting (env or config) wins.
+    Failures are swallowed — the cache is an optimisation only.
+    """
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    path = os.environ.get("SCINTOOLS_XLA_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "scintools_tpu", "xla")
+    if path == "0":
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # export too, so subprocesses (the bench's tunnel probe, pool
+        # workers) inherit the cache — a cached executable still has
+        # to RUN on the device, so probes keep probing the tunnel
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+        if not os.environ.get(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.3)
+        if not os.environ.get("JAX_COMPILATION_CACHE_MAX_SIZE"):
+            # LRU-evict past 2 GB so dev iterations can't grow the
+            # dir without bound
+            jax.config.update("jax_compilation_cache_max_size",
+                              2 * 1024 ** 3)
+    except Exception:
+        pass
 
 
 def set_default_backend(backend):

@@ -18,6 +18,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# persistent XLA compilation cache: repeat suite runs skip recompiles
+# (cache keys include platform/flags, so the x64 CPU programs here
+# never collide with user-session entries). Same knobs as
+# scintools_tpu.backend._maybe_enable_compilation_cache.
+from scintools_tpu.backend import (  # noqa: E402
+    _maybe_enable_compilation_cache)
+
+_maybe_enable_compilation_cache(jax)
+
 # initialise the backend at the 8-device count NOW: otherwise a test
 # that calls force_cpu_platform(n<8) first (e.g. an isolated
 # `-k dryrun` selection running dryrun_multichip(1)) pins the whole
