@@ -67,15 +67,27 @@ def main():
             # force execution by FETCHING the small eigenvalue output:
             # block_until_ready does not block on the tunneled TPU
             # (bench.py module docstring)
-            t0 = time.perf_counter()
-            np.asarray(pipe(*jvariants[-1])[1])          # warm-up only
-            compile_s = time.perf_counter() - t0
-            best = np.inf
-            for r in range(args.reps):
-                a = jvariants[r % (len(jvariants) - 1)]
+            try:
                 t0 = time.perf_counter()
-                np.asarray(pipe(*a)[1])
-                best = min(best, time.perf_counter() - t0)
+                np.asarray(pipe(*jvariants[-1])[1])      # warm-up only
+                compile_s = time.perf_counter() - t0
+                best = np.inf
+                for r in range(args.reps):
+                    a = jvariants[r % (len(jvariants) - 1)]
+                    t0 = time.perf_counter()
+                    np.asarray(pipe(*a)[1])
+                    best = min(best, time.perf_counter() - t0)
+            except Exception as e:                       # noqa: BLE001
+                # a too-large group OOMs HBM (ResourceExhausted) —
+                # report it and keep sweeping instead of losing the
+                # groups already measured. NOTE an OOM can wedge the
+                # tunnel (observed live 2026-07-31: group 64 OOM'd
+                # and even trivial ops hung afterwards) — if the next
+                # group stalls, restart the sweep without the fat one.
+                msg = (str(e).splitlines() or [""])[0][:80]
+                print(f"method={method:6s} group={group:3d}  FAILED "
+                      f"({type(e).__name__}: {msg})")
+                continue
             print(f"method={method:6s} group={group:3d}  "
                   f"compile={compile_s:6.1f}s  best={best:7.3f}s  "
                   f"({nf * nt / best:,.0f} px/s)")
