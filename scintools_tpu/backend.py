@@ -57,24 +57,27 @@ def _maybe_enable_compilation_cache(jax):
         # workers) inherit the cache — a cached executable still has
         # to RUN on the device, so probes keep probing the tunnel
         os.environ["JAX_COMPILATION_CACHE_DIR"] = path
-        # export the companion knobs too: subprocesses read only env,
-        # and without the max-size bound their writes would be
-        # unbounded (jax default -1 = no eviction)
-        if not os.environ.get(
-                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.3)
-            os.environ[
-                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.3"
-        if not os.environ.get("JAX_COMPILATION_CACHE_MAX_SIZE"):
+    except Exception:
+        return            # no cache, no exports — a consistent state
+    # companion knobs: subprocesses read only env, and without the
+    # max-size bound their writes would be unbounded (jax default -1
+    # = no eviction). Env export comes FIRST and each knob gets its
+    # own exception scope, so a jax version without one flag still
+    # hands subprocesses the bound via env.
+    for env_key, flag, val in (
+            ("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+             "jax_persistent_cache_min_compile_time_secs", 0.3),
             # LRU-evict past 2 GB so dev iterations can't grow the
             # dir without bound
-            jax.config.update("jax_compilation_cache_max_size",
-                              2 * 1024 ** 3)
-            os.environ["JAX_COMPILATION_CACHE_MAX_SIZE"] = str(
-                2 * 1024 ** 3)
-    except Exception:
-        pass
+            ("JAX_COMPILATION_CACHE_MAX_SIZE",
+             "jax_compilation_cache_max_size", 2 * 1024 ** 3)):
+        if os.environ.get(env_key):
+            continue
+        os.environ[env_key] = str(val)
+        try:
+            jax.config.update(flag, val)
+        except Exception:
+            pass
 
 
 def set_default_backend(backend):

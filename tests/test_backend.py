@@ -1,0 +1,147 @@
+"""Backend dispatch and compilation-cache wiring."""
+
+import os
+
+import numpy as np
+import pytest
+
+from scintools_tpu import backend
+
+
+class TestBackendDispatch:
+    def test_resolve_and_get_xp(self):
+        assert backend.resolve_backend("numpy") == "numpy"
+        assert backend.resolve_backend("jax") == "jax"
+        assert backend.get_xp("numpy") is np
+        with pytest.raises(ValueError, match="unknown backend"):
+            backend.get_xp("torch")
+
+    def test_set_default_backend_rejects_unknown(self):
+        with pytest.raises(ValueError, match="numpy.*jax"):
+            backend.set_default_backend("cuda")
+
+
+class _FakeConfig:
+    def __init__(self):
+        self.jax_compilation_cache_dir = None
+        self.updates = {}
+
+    def update(self, key, value):
+        self.updates[key] = value
+        if key == "jax_compilation_cache_dir":
+            self.jax_compilation_cache_dir = value
+
+
+class _FakeJax:
+    def __init__(self):
+        self.config = _FakeConfig()
+
+
+class TestCompilationCacheGuards:
+    """_maybe_enable_compilation_cache: explicit jax-level settings
+    win, =0 disables, and the knobs it sets are exported so
+    subprocesses inherit the same bounded cache."""
+
+    def _clean_env(self, monkeypatch, tmp_path):
+        for k in ("JAX_COMPILATION_CACHE_DIR",
+                  "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                  "JAX_COMPILATION_CACHE_MAX_SIZE",
+                  "SCINTOOLS_XLA_CACHE"):
+            monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv("SCINTOOLS_XLA_CACHE",
+                           str(tmp_path / "xla"))
+
+    def test_sets_and_exports_all_knobs(self, monkeypatch, tmp_path):
+        self._clean_env(monkeypatch, tmp_path)
+        fake = _FakeJax()
+        backend._maybe_enable_compilation_cache(fake)
+        assert fake.config.jax_compilation_cache_dir \
+            == str(tmp_path / "xla")
+        assert os.path.isdir(tmp_path / "xla")
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] \
+            == str(tmp_path / "xla")
+        assert os.environ[
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.3"
+        assert os.environ["JAX_COMPILATION_CACHE_MAX_SIZE"] \
+            == str(2 * 1024 ** 3)
+        assert fake.config.updates[
+            "jax_compilation_cache_max_size"] == 2 * 1024 ** 3
+
+    def test_disabled_by_zero(self, monkeypatch, tmp_path):
+        self._clean_env(monkeypatch, tmp_path)
+        monkeypatch.setenv("SCINTOOLS_XLA_CACHE", "0")
+        fake = _FakeJax()
+        backend._maybe_enable_compilation_cache(fake)
+        assert fake.config.updates == {}
+
+    def test_explicit_env_dir_wins(self, monkeypatch, tmp_path):
+        self._clean_env(monkeypatch, tmp_path)
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                           str(tmp_path / "user"))
+        fake = _FakeJax()
+        backend._maybe_enable_compilation_cache(fake)
+        assert fake.config.updates == {}
+
+    def test_explicit_config_dir_wins(self, monkeypatch, tmp_path):
+        self._clean_env(monkeypatch, tmp_path)
+        fake = _FakeJax()
+        fake.config.jax_compilation_cache_dir = "/somewhere/else"
+        backend._maybe_enable_compilation_cache(fake)
+        assert "jax_compilation_cache_dir" not in fake.config.updates
+
+    def test_user_min_compile_time_respected(self, monkeypatch,
+                                             tmp_path):
+        self._clean_env(monkeypatch, tmp_path)
+        monkeypatch.setenv(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+        fake = _FakeJax()
+        backend._maybe_enable_compilation_cache(fake)
+        assert "jax_persistent_cache_min_compile_time_secs" \
+            not in fake.config.updates
+        assert os.environ[
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "5"
+
+    def test_dir_failure_leaves_consistent_off_state(
+            self, monkeypatch, tmp_path):
+        """If even the cache-dir flag can't be set, nothing may be
+        exported — half-configured env would hand subprocesses an
+        unbounded cache."""
+        self._clean_env(monkeypatch, tmp_path)
+
+        class _Boom(_FakeJax):
+            def __init__(self):
+                super().__init__()
+                self.config.update = self._raise
+
+            def _raise(self, *a):
+                raise RuntimeError("no such flag")
+
+        backend._maybe_enable_compilation_cache(_Boom())  # no raise
+        assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+
+    def test_knob_failure_still_exports_bound(self, monkeypatch,
+                                              tmp_path):
+        """A jax version missing the max-size flag must still export
+        the env bound so subprocesses (which parse env themselves)
+        stay LRU-bounded."""
+        self._clean_env(monkeypatch, tmp_path)
+
+        class _NoMaxSize(_FakeJax):
+            def __init__(self):
+                super().__init__()
+                self._orig = _FakeConfig.update.__get__(self.config)
+                self.config.update = self._update
+
+            def _update(self, key, value):
+                if key == "jax_compilation_cache_max_size":
+                    raise RuntimeError("no such flag")
+                self._orig(key, value)
+
+        fake = _NoMaxSize()
+        backend._maybe_enable_compilation_cache(fake)
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] \
+            == str(tmp_path / "xla")
+        assert os.environ["JAX_COMPILATION_CACHE_MAX_SIZE"] \
+            == str(2 * 1024 ** 3)
+        assert "jax_compilation_cache_max_size" \
+            not in fake.config.updates
