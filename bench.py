@@ -996,6 +996,16 @@ def main():
     timer.daemon = True
     timer.start()
 
+    # enable the persistent compilation cache BEFORE the probe so the
+    # probe subprocesses inherit it via env (a cached executable still
+    # has to run on the device — probes keep probing the tunnel) and
+    # repeat CPU-fallback runs skip recompiles. get_jax() wires the
+    # cache as a side effect and initialises no backend (jax modules
+    # are preloaded at interpreter startup in this image).
+    from scintools_tpu.backend import get_jax
+
+    get_jax()
+
     # the probe may use at most ~40% of the total budget; the rest is
     # reserved for the CPU-fallback configs
     probe, ok = probe_accelerator(deadline=t_start + 0.4 * budget)

@@ -43,13 +43,17 @@ class TestCompilationCacheGuards:
     subprocesses inherit the same bounded cache."""
 
     def _clean_env(self, monkeypatch, tmp_path):
+        # swap in a plain-dict copy of the environment: the code under
+        # test writes os.environ directly, and monkeypatch.delenv on an
+        # ABSENT key records nothing to restore — without the swap the
+        # writes would leak into later tests in this process
+        monkeypatch.setattr(os, "environ", dict(os.environ))
         for k in ("JAX_COMPILATION_CACHE_DIR",
                   "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                   "JAX_COMPILATION_CACHE_MAX_SIZE",
                   "SCINTOOLS_XLA_CACHE"):
-            monkeypatch.delenv(k, raising=False)
-        monkeypatch.setenv("SCINTOOLS_XLA_CACHE",
-                           str(tmp_path / "xla"))
+            os.environ.pop(k, None)
+        os.environ["SCINTOOLS_XLA_CACHE"] = str(tmp_path / "xla")
 
     def test_sets_and_exports_all_knobs(self, monkeypatch, tmp_path):
         self._clean_env(monkeypatch, tmp_path)
