@@ -1756,7 +1756,8 @@ class Dynspec:
             self.calc_wavefield(verbose=verbose)
         self.wavefield = thth_ret.gerchberg_saxton(
             self.wavefield, self.dyn,
-            freqs=self.freqs[: self.wavefield.shape[0]], niter=niter)
+            freqs=self.freqs[: self.wavefield.shape[0]], niter=niter,
+            backend=self.backend)
         return self.wavefield
 
     def calc_asymmetry(self, verbose=False, pool=None):

@@ -588,12 +588,22 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
     return E, res
 
 
-def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True):
+def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True,
+                     backend=None):
     """Gerchberg–Saxton amplitude-replacement + causality iterations
     (dynspec.py:1854-1890): rescale |E|² to the dynspec mean, replace
     |E| with √dyn at finite positive pixels, then zero acausal (τ<0)
     components each iteration. Single implementation shared with
-    ``Dynspec.gerchberg_saxton``."""
+    ``Dynspec.gerchberg_saxton``.
+
+    The jax path runs the whole iteration as ONE program — a
+    ``lax.fori_loop`` of fft2/ifft2 with the complex field living
+    entirely inside it (only (real, imag) float stacks cross the
+    program boundary; the tunneled TPU cannot transfer complex
+    buffers). ``niter`` is a traced loop bound, so changing it does
+    not recompile."""
+    from ..backend import resolve_backend
+
     E = np.array(wavefield, dtype=complex)
     dyn = np.asarray(dyn, dtype=float)[: E.shape[0], : E.shape[1]]
     # replace amplitudes only at finite, positive dynspec pixels
@@ -613,6 +623,13 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True):
         # start at (n+1)//2 (for odd n, index n//2 is still positive)
         neg = np.zeros(E.shape[0], dtype=bool)
         neg[(E.shape[0] + 1) // 2:] = True
+
+    if resolve_backend(backend) == "jax":
+        fn = _gs_jit_fn()
+        E_ri = np.stack([E.real, E.imag])
+        out = np.asarray(fn(E_ri, amp, good, neg, int(niter)))
+        return out[0] + 1j * out[1]
+
     E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
     for _ in range(niter):
         spec = np.fft.fft2(E)
@@ -620,6 +637,42 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True):
         E = np.fft.ifft2(spec)
         E = np.where(good, amp * np.exp(1j * np.angle(E)), E)
     return E
+
+
+_GS_JIT = None
+
+
+def _gs_jit_fn():
+    """The jitted GS program: amplitude replacement + fori_loop of
+    (fft2 → zero τ<0 rows → ifft2 → amplitude replacement). Complex
+    only inside; ri-stacks at the boundary. One lazily-built wrapper —
+    it closes over nothing shape-dependent, so jax.jit's own
+    per-signature cache handles different wavefield shapes."""
+    global _GS_JIT
+    if _GS_JIT is not None:
+        return _GS_JIT
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    def replace(E, amp, good):
+        # amp·e^{i·arg E} at good pixels — arg(0)=0 ⇒ amp·1, matching
+        # the numpy path
+        return jnp.where(good, amp * jnp.exp(1j * jnp.angle(E)), E)
+
+    @jax.jit
+    def gs(E_ri, amp, good, neg, niter):
+        E = replace(E_ri[0] + 1j * E_ri[1], amp, good)
+
+        def body(_, E):
+            spec = jnp.fft.fft2(E)
+            spec = jnp.where(neg[:, None], 0.0, spec)
+            return replace(jnp.fft.ifft2(spec), amp, good)
+
+        E = jax.lax.fori_loop(0, niter, body, E)
+        return jnp.stack([E.real, E.imag])
+
+    _GS_JIT = gs
+    return gs
 
 
 def calc_asymmetry(eigenvector, edges_red):

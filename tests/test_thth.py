@@ -257,6 +257,23 @@ class TestRetrieval:
         # sqrt(dyn) at finite positive pixels (dynspec.py:1887-1890)
         np.testing.assert_allclose(np.abs(out), np.sqrt(dyn), atol=1e-10)
 
+    def test_gerchberg_saxton_jax_matches_numpy(self, rng):
+        """The jax GS (one fori_loop program, ri-stacks at the
+        boundary) must reproduce the numpy iteration, including the
+        freqs-derived causality mask and the rescale step."""
+        E = rng.standard_normal((16, 12)) + 1j * rng.standard_normal(
+            (16, 12))
+        dyn = rng.random((16, 12)) + 0.5
+        dyn[2, 3] = np.nan                       # RFI-flagged pixel
+        freqs = 1400.0 + 0.05 * np.arange(16)
+        for niter in (1, 4):                     # traced bound: both
+            want = gerchberg_saxton(E, dyn, freqs=freqs, niter=niter,
+                                    backend="numpy")
+            got = gerchberg_saxton(E, dyn, freqs=freqs, niter=niter,
+                                   backend="jax")
+            np.testing.assert_allclose(got, want, rtol=1e-9,
+                                       atol=1e-12)
+
     def test_gerchberg_saxton_nan_safe(self, rng):
         E = rng.standard_normal((16, 16)) + 1j * rng.standard_normal(
             (16, 16))
