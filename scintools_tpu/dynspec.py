@@ -1734,30 +1734,38 @@ class Dynspec:
                 self.chunks[cf, ct, :, :] = res[0]
 
     def calc_wavefield(self, verbose=False, pool=None, gs=False,
-                       memmap=False, niter=1, mesh=None):
+                       memmap=False, niter=1, mesh=None,
+                       gs_mesh=None):
         """Mosaic the retrieval chunks into the wavefield
         (dynspec.py:1828-1852). ``pool`` forwards to the retrieval
         fan-out (numpy backend); ``mesh`` shards the jax retrieval
-        batch over the device mesh."""
+        batch over the device mesh. ``gs_mesh`` (a data-axis-1 mesh,
+        ``make_mesh(n, seq=n)``) shards the GS refinement's FFT loop —
+        a separate knob because the retrieval grid wants chunk
+        fan-out while GS wants one wavefield split over devices."""
         if not hasattr(self, "chunks"):
             self.thetatheta_chunks(verbose=verbose, memmap=memmap,
                                    pool=pool, mesh=mesh)
         self.wavefield = thth_ret.mosaic(self.chunks)
         if gs:
-            self.gerchberg_saxton(verbose=verbose, niter=niter)
+            self.gerchberg_saxton(verbose=verbose, niter=niter,
+                                  mesh=gs_mesh)
         return self.wavefield
 
-    def gerchberg_saxton(self, niter=1, verbose=False, pool=None):
+    def gerchberg_saxton(self, niter=1, verbose=False, pool=None,
+                         mesh=None):
         """GS amplitude/causality iterations on the wavefield
         (dynspec.py:1854-1890); delegates to the shared kernel.
         ``pool`` is accepted for API parity — the iteration is one
-        whole-array FFT loop with nothing to fan out."""
+        whole-array FFT loop with nothing to fan out. ``mesh`` shards
+        that loop's FFTs over a device mesh's ``seq`` axis for
+        wavefields beyond one chip (parallel/fft.py:make_gs_sharded)."""
         if not hasattr(self, "wavefield"):
             self.calc_wavefield(verbose=verbose)
         self.wavefield = thth_ret.gerchberg_saxton(
             self.wavefield, self.dyn,
             freqs=self.freqs[: self.wavefield.shape[0]], niter=niter,
-            backend=self.backend)
+            backend=self.backend, mesh=mesh)
         return self.wavefield
 
     def calc_asymmetry(self, verbose=False, pool=None):

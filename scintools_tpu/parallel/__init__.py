@@ -5,7 +5,8 @@ scintools/dynspec.py:1669-1671)."""
 
 from .mesh import (make_mesh, device_count, DATA_AXIS, SEQ_AXIS,
                    data_sharding, batch_freq_sharding, replicated)
-from .fft import make_fft2_sharded, make_sspec_power_sharded
+from .fft import (make_fft2_sharded, make_gs_sharded,
+                  make_sspec_power_sharded)
 from .survey import (make_survey_step, make_eta_search_sharded,
                      make_arc_profile_sharded,
                      make_thth_grid_search_sharded,
@@ -14,7 +15,8 @@ from .survey import (make_survey_step, make_eta_search_sharded,
 __all__ = [
     "make_mesh", "device_count", "DATA_AXIS", "SEQ_AXIS",
     "data_sharding", "batch_freq_sharding", "replicated",
-    "make_fft2_sharded", "make_sspec_power_sharded",
+    "make_fft2_sharded", "make_gs_sharded",
+    "make_sspec_power_sharded",
     "make_survey_step", "make_eta_search_sharded",
     "make_arc_profile_sharded",
     "make_thth_grid_search_sharded",

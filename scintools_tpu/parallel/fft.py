@@ -58,6 +58,36 @@ def make_fft2_sharded(mesh, inverse=False):
     return _shard_map(local, mesh, (spec,), spec)
 
 
+def make_gs_sharded(mesh):
+    """Mesh-sharded Gerchberg–Saxton: the wavefield-refinement
+    fft2/ifft2 loop (thth/retrieval.py:gerchberg_saxton; reference
+    dynspec.py:1854-1890) with the frequency axis block-sharded over
+    the ``seq`` mesh axis (distributed FFT, collectives on ICI) and
+    the batch over ``data`` — a wavefield larger than one chip's HBM
+    refines without ever materialising on one device.
+
+    Returns jitted ``fn(E_ri[B, 2, NF, NT], amp[B, NF, NT],
+    good[B, NF, NT], neg[NF], niter) → E_ri'``. Complex lives only
+    inside the program; ``NF`` and ``NT`` must be divisible by the
+    ``seq`` axis size and ``B`` by the ``data`` axis size.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # lazy: retrieval imports this module lazily too, so a top-level
+    # import either way would cycle
+    from ..thth.retrieval import make_gs_kernel
+
+    gs = make_gs_kernel(jax, jnp, make_fft2_sharded(mesh),
+                        make_fft2_sharded(mesh, inverse=True))
+    sh3 = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
+    sh4 = NamedSharding(mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(gs, in_shardings=(sh4, sh3, sh3, repl, None),
+                   out_shardings=sh4)
+
+
 def make_sspec_power_sharded(mesh, nf, nt, window_arrays=None,
                              halve=True):
     """Build the distributed secondary-spectrum kernel

@@ -589,7 +589,7 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
 
 
 def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True,
-                     backend=None):
+                     backend=None, mesh=None):
     """Gerchberg–Saxton amplitude-replacement + causality iterations
     (dynspec.py:1854-1890): rescale |E|² to the dynspec mean, replace
     |E| with √dyn at finite positive pixels, then zero acausal (τ<0)
@@ -601,7 +601,13 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True,
     entirely inside it (only (real, imag) float stacks cross the
     program boundary; the tunneled TPU cannot transfer complex
     buffers). ``niter`` is a traced loop bound, so changing it does
-    not recompile."""
+    not recompile.
+
+    ``mesh`` shards the loop's FFTs over the mesh's ``seq`` axis
+    (parallel/fft.py:make_gs_sharded) for wavefields beyond one
+    chip's HBM: the mesh must have a data axis of 1
+    (``make_mesh(n, seq=n)``) and the wavefield shape must be
+    divisible by the seq axis size."""
     from ..backend import resolve_backend
 
     E = np.array(wavefield, dtype=complex)
@@ -624,6 +630,25 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True,
         neg = np.zeros(E.shape[0], dtype=bool)
         neg[(E.shape[0] + 1) // 2:] = True
 
+    if mesh is not None:
+        from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+        if mesh.shape[DATA_AXIS] != 1:
+            raise ValueError(
+                "gerchberg_saxton(mesh=...) refines ONE wavefield — "
+                "use a data-axis-1 mesh (make_mesh(n, seq=n)); batch "
+                "fan-out belongs on the retrieval grid, not here")
+        k = mesh.shape[SEQ_AXIS]
+        if E.shape[0] % k or E.shape[1] % k:
+            raise ValueError(
+                f"wavefield shape {E.shape} must be divisible by the "
+                f"seq axis size {k} for the distributed FFT")
+        fn = _gs_sharded_fn(mesh)
+        E_ri = np.stack([E.real, E.imag])[None]
+        out = np.asarray(fn(E_ri, amp[None], good[None], neg,
+                            int(niter)))[0]
+        return out[0] + 1j * out[1]
+
     if resolve_backend(backend) == "jax":
         fn = _gs_jit_fn()
         E_ri = np.stack([E.real, E.imag])
@@ -639,14 +664,60 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True,
     return E
 
 
+_GS_SHARDED_CACHE = {}
+
+
+def _gs_sharded_fn(mesh):
+    """Cached mesh-sharded GS program per mesh (the jit carries
+    mesh-specific shardings, so it is keyed on the device layout)."""
+    key = (tuple(d.id for d in np.ravel(mesh.devices)),
+           tuple(mesh.axis_names), tuple(mesh.shape.values()))
+    fn = _GS_SHARDED_CACHE.get(key)
+    if fn is None:
+        from ..parallel.fft import make_gs_sharded
+
+        if len(_GS_SHARDED_CACHE) >= 4:
+            _GS_SHARDED_CACHE.pop(next(iter(_GS_SHARDED_CACHE)))
+        fn = make_gs_sharded(mesh)
+        _GS_SHARDED_CACHE[key] = fn
+    return fn
+
+
+def make_gs_kernel(jax, jnp, fft2, ifft2):
+    """The one GS iteration body, batched ``[B, NF, NT]``: amplitude
+    replacement + fori_loop of (fft2 → zero τ<0 rows → ifft2 →
+    amplitude replacement). Parameterised over the FFT pair so the
+    single-device jit and the mesh-sharded program
+    (parallel/fft.py:make_gs_sharded) share ONE definition of the
+    semantics — the numpy loop in :func:`gerchberg_saxton` is the
+    reference-pinned third form."""
+
+    def replace(E, amp, good):
+        # amp·e^{i·arg E} at good pixels — arg(0)=0 ⇒ amp·1, matching
+        # the numpy path
+        return jnp.where(good, amp * jnp.exp(1j * jnp.angle(E)), E)
+
+    def gs(E_ri, amp, good, neg, niter):
+        E = replace(E_ri[:, 0] + 1j * E_ri[:, 1], amp, good)
+
+        def body(_, E):
+            spec = fft2(E)
+            spec = jnp.where(neg[None, :, None], 0.0, spec)
+            return replace(ifft2(spec), amp, good)
+
+        E = jax.lax.fori_loop(0, niter, body, E)
+        return jnp.stack([E.real, E.imag], axis=1)
+
+    return gs
+
+
 _GS_JIT = None
 
 
 def _gs_jit_fn():
-    """The jitted GS program: amplitude replacement + fori_loop of
-    (fft2 → zero τ<0 rows → ifft2 → amplitude replacement). Complex
-    only inside; ri-stacks at the boundary. One lazily-built wrapper —
-    it closes over nothing shape-dependent, so jax.jit's own
+    """The single-device jitted GS program (ri-stacks at the
+    boundary, complex only inside). One lazily-built wrapper — it
+    closes over nothing shape-dependent, so jax.jit's own
     per-signature cache handles different wavefield shapes."""
     global _GS_JIT
     if _GS_JIT is not None:
@@ -654,22 +725,13 @@ def _gs_jit_fn():
     jax = get_jax()
     import jax.numpy as jnp
 
-    def replace(E, amp, good):
-        # amp·e^{i·arg E} at good pixels — arg(0)=0 ⇒ amp·1, matching
-        # the numpy path
-        return jnp.where(good, amp * jnp.exp(1j * jnp.angle(E)), E)
+    kern = make_gs_kernel(
+        jax, jnp, lambda x: jnp.fft.fft2(x, axes=(1, 2)),
+        lambda x: jnp.fft.ifft2(x, axes=(1, 2)))
 
     @jax.jit
     def gs(E_ri, amp, good, neg, niter):
-        E = replace(E_ri[0] + 1j * E_ri[1], amp, good)
-
-        def body(_, E):
-            spec = jnp.fft.fft2(E)
-            spec = jnp.where(neg[:, None], 0.0, spec)
-            return replace(jnp.fft.ifft2(spec), amp, good)
-
-        E = jax.lax.fori_loop(0, niter, body, E)
-        return jnp.stack([E.real, E.imag])
+        return kern(E_ri[None], amp[None], good[None], neg, niter)[0]
 
     _GS_JIT = gs
     return gs
