@@ -617,8 +617,13 @@ def gerchberg_saxton(wavefield, dyn, freqs=None, niter=1, rescale=True,
     good = np.isfinite(dyn) & (dyn > 0)
     amp = np.sqrt(np.where(good, dyn, 0.0))
     if rescale:
-        E = E * np.sqrt(dyn[good].mean()
-                        / np.abs(E[good] ** 2).mean())
+        den = np.abs(E[good] ** 2).mean()
+        if den > 0:
+            E = E * np.sqrt(dyn[good].mean() / den)
+        # else: a fully-quarantined (all-zero) wavefield — skip the
+        # rescale instead of 0·inf = NaN-poisoning the field; the
+        # amplitude replacement below still installs √dyn at good
+        # pixels, so GS degrades to a flat-phase seed
     if freqs is not None:
         tau = np.fft.fftshift(
             np.fft.fftfreq(E.shape[0],

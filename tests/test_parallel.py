@@ -334,6 +334,17 @@ class TestShardedThinGrid:
         assert np.max(d / s) < 1e-3
 
 
+def thth_retrieval_gs_reference(wavefield, ds, niter):
+    """Numpy GS on the same pre-GS wavefield — the oracle for the
+    façade's gs_mesh path."""
+    from scintools_tpu.thth.retrieval import gerchberg_saxton
+
+    return gerchberg_saxton(np.asarray(wavefield), np.asarray(ds.dyn),
+                            freqs=np.asarray(
+                                ds.freqs[: wavefield.shape[0]]),
+                            niter=niter, backend="numpy")
+
+
 class TestShardedRetrieval:
     def test_retrieval_batch_mesh_matches_plain(self, mesh):
         """chunk_retrieval_batch with the chunk axis sharded over all
@@ -386,6 +397,17 @@ class TestShardedRetrieval:
         ds.calc_wavefield(mesh=mesh)
         assert ds.wavefield.shape[0] > 0
         assert np.isfinite(ds.wavefield).all()
+        # GS refinement through the façade's gs_mesh knob: the 3x3
+        # half-overlap mosaic is 32x32 — divisible by seq=2 of a
+        # data-axis-1 mesh — and must match the single-device GS
+        wf_before = np.array(ds.wavefield)
+        gs_mesh = par.make_mesh(2, seq=2)
+        del ds.wavefield
+        ds.calc_wavefield(mesh=mesh, gs=True, niter=2,
+                          gs_mesh=gs_mesh)
+        ds2_wf = thth_retrieval_gs_reference(wf_before, ds, niter=2)
+        np.testing.assert_allclose(ds.wavefield, ds2_wf, rtol=1e-9,
+                                   atol=1e-12)
 
     def test_grid_retrieval_matches_per_row(self, mesh):
         """grid_retrieval_batch (one dispatch, per-chunk eta/edges)

@@ -274,6 +274,18 @@ class TestRetrieval:
             np.testing.assert_allclose(got, want, rtol=1e-9,
                                        atol=1e-12)
 
+    def test_gerchberg_saxton_zero_wavefield_degrades(self, rng):
+        """A fully-quarantined (all-zero) wavefield must not NaN-poison
+        GS through the 0·inf rescale — it degrades to a flat-phase
+        √dyn seed on every backend."""
+        dyn = rng.random((16, 12)) + 0.5
+        for backend in ("numpy", "jax"):
+            out = gerchberg_saxton(np.zeros((16, 12), complex), dyn,
+                                   niter=2, backend=backend)
+            assert np.isfinite(out).all()
+            np.testing.assert_allclose(np.abs(out), np.sqrt(dyn),
+                                       atol=1e-10)
+
     def test_gerchberg_saxton_nan_safe(self, rng):
         E = rng.standard_normal((16, 16)) + 1j * rng.standard_normal(
             (16, 16))
