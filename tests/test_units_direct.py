@@ -1,0 +1,202 @@
+"""Direct unit tests for closed-form model functions and small
+utilities that until now were exercised only indirectly through the
+pipelines (fit_arc, get_scint_params, refill, …). Each has an exact
+analytic expectation, so direct pins are cheap and catch regressions
+at the source instead of two layers up."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.fit.models import (
+    arc_power_curve, dnu_acf_model, dnu_acf_model_values, fit_parabola,
+    fit_log_parabola, powerspectrum_model, tau_acf_model,
+    tau_acf_model_values)
+from scintools_tpu.fit.parameters import Parameters
+
+
+def _params(**kw):
+    p = Parameters()
+    for k, v in kw.items():
+        p.add(k, value=v)
+    return p
+
+
+class TestAcfModels:
+    def test_tau_model_values_analytic(self):
+        p = _params(tau=10.0, alpha=2.0, amp=3.0, wn=0.0, mu=0.0)
+        x = np.linspace(0.0, 40.0, 5)
+        got = np.asarray(tau_acf_model_values(p, x))
+        want = 3.0 * np.exp(-(x / 10.0) ** 2) * (1 - x / 40.0)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_dnu_model_values_analytic(self):
+        p = _params(dnu=2.0, amp=1.5, wn=0.0)
+        x = np.linspace(0.0, 8.0, 5)
+        got = np.asarray(dnu_acf_model_values(p, x))
+        want = 1.5 * np.exp(-x / (2.0 / np.log(2))) * (1 - x / 8.0)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+        # half-power definition (scint_models.py:88-109): at f = dnu
+        # the model's exponential factor — its value divided by the
+        # triangle taper — is amp/2
+        at_dnu = np.asarray(
+            dnu_acf_model_values(p, np.array([2.0, 8.0])))[0]
+        assert at_dnu / (1 - 2.0 / 8.0) == pytest.approx(1.5 / 2)
+
+    def test_residual_models_zero_on_exact_data(self):
+        p = _params(tau=10.0, alpha=2.0, amp=3.0, wn=0.0, mu=0.0,
+                    dnu=2.0)
+        x = np.linspace(0.0, 40.0, 32)
+        y = np.asarray(tau_acf_model_values(p, x))
+        res = np.asarray(tau_acf_model(p, x, y, None))
+        # lag-0 weight is zeroed (white-noise spike); rest vanish
+        np.testing.assert_allclose(res, 0.0, atol=1e-12)
+        xf = np.linspace(0.0, 8.0, 32)
+        yf = np.asarray(dnu_acf_model_values(p, xf))
+        resf = np.asarray(dnu_acf_model(p, xf, yf, None))
+        np.testing.assert_allclose(resf, 0.0, atol=1e-12)
+
+    def test_powerspectrum_model_residual(self):
+        p = _params(wn=0.5, amp=2.0, alpha=-1.5)
+        x = np.array([1.0, 2.0, 4.0])
+        y = 0.5 + 2.0 * x ** -1.5
+        np.testing.assert_allclose(
+            np.asarray(powerspectrum_model(p, x, y)), 0.0, atol=1e-12)
+
+    def test_arc_power_curve_same_family(self):
+        p = _params(wn=0.5, amp=2.0, alpha=-1.5)
+        x = np.array([1.0, 2.0, 4.0])
+        y = 0.5 + 2.0 * x ** -1.5
+        np.testing.assert_allclose(
+            np.asarray(arc_power_curve(p, x, y, None)), 0.0,
+            atol=1e-12)
+
+
+class TestParabolaFits:
+    def test_exact_parabola_recovered(self):
+        x = np.linspace(2.0, 6.0, 21)
+        y = -(x - 4.2) ** 2 + 7.0
+        yfit, peak, err = fit_parabola(x, y)
+        assert peak == pytest.approx(4.2, abs=1e-9)
+        np.testing.assert_allclose(yfit, y, atol=1e-9)
+
+    def test_log_parabola_peak_in_linear_x(self):
+        x = np.geomspace(1.0, 100.0, 41)
+        y = -(np.log(x) - np.log(10.0)) ** 2 + 5.0
+        yfit, peak, err = fit_log_parabola(x, y)
+        assert peak == pytest.approx(10.0, rel=1e-6)
+
+
+class TestThthSupport:
+    def test_len_arc_matches_quadrature(self):
+        from scipy.integrate import quad
+
+        from scintools_tpu.thth.core import len_arc
+
+        eta = 0.3
+        for x in (0.5, 2.0):
+            want = quad(lambda u: np.sqrt(1 + (2 * eta * u) ** 2),
+                        0, x)[0]
+            assert len_arc(x, eta) == pytest.approx(want, rel=1e-9)
+
+    def test_ext_find_half_pixel_extent(self):
+        from scintools_tpu.thth.core import ext_find
+
+        x = np.array([0.0, 1.0, 2.0])
+        y = np.array([10.0, 20.0])
+        assert ext_find(x, y) == [-0.5, 2.5, 5.0, 25.0]
+
+    def test_dominant_eig_power_matches_eigh(self):
+        from scintools_tpu.thth.core import dominant_eig_power
+
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((24, 24)) \
+            + 1j * rng.standard_normal((24, 24))
+        A = A + A.conj().T
+        lam, v = dominant_eig_power(A, iters=500, backend="numpy")
+        w, V = np.linalg.eigh(A)
+        assert lam == pytest.approx(w[-1], rel=1e-9)
+        overlap = np.abs(np.vdot(v, V[:, -1]))
+        assert overlap == pytest.approx(1.0, abs=1e-6)
+
+
+class TestOpsHelpers:
+    def test_apply_window_separable(self):
+        from scintools_tpu.ops.windows import apply_window
+
+        rng = np.random.default_rng(2)
+        dyn = rng.random((4, 6))
+        cw = rng.random(6)
+        sw = rng.random(4)
+        got = apply_window(dyn, cw, sw)
+        np.testing.assert_allclose(got, dyn * np.outer(sw, cw),
+                                   rtol=1e-12)
+
+    def test_acf_from_sspec_matches_direct_acf(self):
+        from scintools_tpu.ops.acf import acf_from_sspec
+        from scintools_tpu.ops.sspec import secondary_spectrum
+
+        rng = np.random.default_rng(9)
+        dyn = rng.random((32, 16)) + 0.5
+        _, _, sec = secondary_spectrum(dyn, dt=1.0, df=1.0,
+                                       window=None, prewhite=False,
+                                       halve=False, backend="numpy")
+        via_sspec = acf_from_sspec(sec, backend="numpy")
+        assert np.isfinite(via_sspec).all()
+        # the sspec route is |FFT|² → ifft — its central peak must
+        # land at the centre and dominate, like the padded-FFT ACF's
+        c = np.unravel_index(np.argmax(via_sspec), via_sspec.shape)
+        assert c == (via_sspec.shape[0] // 2, via_sspec.shape[1] // 2)
+
+    def test_columnwise_cubic_interp_exact_on_cubic(self):
+        from scintools_tpu.ops.interp import columnwise_cubic_interp
+
+        x = np.linspace(0.0, 1.0, 9)
+        arr = np.stack([x ** 3, 1 - x ** 3], axis=1)  # (9, 2)
+        xq = np.linspace(0.0, 1.0, 17)
+        got = columnwise_cubic_interp(arr, x, xq, axis=0)
+        np.testing.assert_allclose(got[:, 0], xq ** 3, atol=1e-12)
+        np.testing.assert_allclose(got[:, 1], 1 - xq ** 3, atol=1e-12)
+
+    def test_inpaint_biharmonic_smooth_fill(self):
+        from scintools_tpu.ops.inpaint import inpaint_biharmonic
+
+        x, y = np.meshgrid(np.linspace(0, 1, 16),
+                           np.linspace(0, 1, 16))
+        img = 2.0 + x + 0.5 * y          # harmonic (linear) field
+        mask = np.zeros_like(img, bool)
+        mask[6:9, 7:10] = True
+        out = inpaint_biharmonic(img, mask)
+        # a linear field satisfies the biharmonic equation exactly
+        np.testing.assert_allclose(out, img, atol=1e-6)
+
+
+class TestUtilsMisc:
+    def test_mjd_to_year_epoch(self):
+        from scintools_tpu.utils.misc import mjd_to_year
+
+        assert mjd_to_year(51544.5) == pytest.approx(2000.0)
+        assert mjd_to_year(51544.5 + 365.25) == pytest.approx(2001.0)
+
+    def test_is_valid(self):
+        from scintools_tpu.utils.misc import is_valid
+
+        a = np.array([1.0, np.nan, np.inf, -3.0])
+        np.testing.assert_array_equal(is_valid(a),
+                                      [True, False, False, True])
+
+    def test_search_and_replace(self, tmp_path):
+        from scintools_tpu.utils.misc import search_and_replace
+
+        f = tmp_path / "t.txt"
+        f.write_text("alpha beta alpha")
+        search_and_replace(str(f), "alpha", "gamma")
+        assert f.read_text() == "gamma beta gamma"
+
+    def test_kepler_solve_satisfies_equation(self):
+        from scintools_tpu.utils.orbit import kepler_solve
+
+        M = np.linspace(0.0, 2 * np.pi, 13)
+        for ecc in (0.0, 0.3, 0.9):
+            E = np.asarray(kepler_solve(M, ecc, backend="numpy"))
+            np.testing.assert_allclose(E - ecc * np.sin(E), M,
+                                       atol=1e-10)
