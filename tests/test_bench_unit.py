@@ -129,6 +129,31 @@ class TestFetch:
         assert float(np.asarray(got[2])) == 5.0
 
 
+class TestBenchPlan:
+    def test_every_config_has_a_budget_estimate(self):
+        """The budget-skip logic reads _EST_S[name]; a config added to
+        the plan without an estimate would KeyError mid-run instead of
+        being skipped cleanly."""
+        import ast
+
+        import bench
+
+        src = open(bench.__file__).read()
+        tree = ast.parse(src)
+        plan_names = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and any(getattr(t, "id", "") == "plan"
+                            for t in node.targets):
+                for elt in node.value.elts:
+                    plan_names.add(elt.elts[0].value)
+        assert plan_names, "could not locate the plan list"
+        assert plan_names == set(bench._EST_S), \
+            "bench plan and _EST_S budget table disagree"
+        for est in bench._EST_S.values():
+            assert set(est) == {"acc", "cpu"}
+
+
 class TestProbe:
     def test_no_probe_env_short_circuits(self):
         env = dict(os.environ, SCINTOOLS_BENCH_NO_PROBE="1")
