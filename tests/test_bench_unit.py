@@ -138,7 +138,8 @@ class TestBenchPlan:
 
         import bench
 
-        src = open(bench.__file__).read()
+        with open(bench.__file__, encoding="utf-8") as f:
+            src = f.read()
         tree = ast.parse(src)
         plan_names = set()
         for node in ast.walk(tree):
