@@ -129,6 +129,81 @@ class TestFetch:
         assert float(np.asarray(got[2])) == 5.0
 
 
+class TestNorthStarPipeline:
+    """The jitted program bench_north_star times (shared with
+    tools/tune_northstar.py) must recover the synthetic curvature at
+    suite scale — guarding the benched pipeline's correctness in CI,
+    not just its speed."""
+
+    def test_single_chunk_recovers_truth(self):
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from scintools_tpu.thth.search import fit_eig_peak
+
+        nf = nt = 256
+        prob = bench.make_north_star_problem(nf, nt, n_variants=1)
+        pipe = bench.make_north_star_pipeline(
+            jax, jnp, nf, nt, prob["cf"], prob["ct"], prob["npad"],
+            prob["wins"], prob["tau"], prob["fd"], prob["edges"],
+            group=1, method="auto", iters=64)
+        d = jnp.asarray(prob["dyns"][0], dtype=jnp.float32)
+        sec, eigs = pipe(d, jnp.asarray(prob["etas"]))
+        eigs = np.asarray(eigs)
+        assert np.isfinite(eigs).all()
+        errs = []
+        for b in range(eigs.shape[0]):
+            eta_fit, _ = fit_eig_peak(prob["etas"], eigs[b], fw=0.2)
+            if np.isfinite(eta_fit):
+                errs.append(abs(eta_fit - prob["eta_true"])
+                            / prob["eta_true"])
+        assert errs, "no chunk produced a finite curvature fit"
+        assert np.median(errs) < 0.05
+
+    def test_chunk_grid_and_group_walk_recover_truth(self):
+        """4 chunks walked in 2 lax.map groups: exercises the
+        multi-chunk reshape/transpose and the grouped HBM walk that
+        the 1-chunk case reduces to identities (the 4096² bench runs
+        64 chunks / group 16 through this same code)."""
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from scintools_tpu.ops.windows import get_window
+        from scintools_tpu.thth.core import fft_axis
+        from scintools_tpu.thth.search import fit_eig_peak
+
+        dt, df, f0 = 2.0, 0.05, 1400.0
+        eta_true = 5e-4
+        nf = nt = 256
+        cf = ct = 128                       # 2×2 grid of chunks
+        dyn = bench.make_arc_dynspec(nt, nf, dt, df, f0, eta_true,
+                                     n_images=96, seed=21)
+        fd = fft_axis(np.arange(ct) * dt, pad=1, scale=1e3)
+        tau = fft_axis(np.arange(cf) * df, pad=1, scale=1.0)
+        etas = np.linspace(0.5 * eta_true, 2.0 * eta_true, 100)
+        th_lim = 0.95 * min(np.sqrt(tau.max() / etas.max()),
+                            fd.max() / 2)
+        edges = np.linspace(-th_lim, th_lim, 128)
+        wins = get_window(nt, nf, window="hanning", frac=0.1)
+        pipe = bench.make_north_star_pipeline(
+            jax, jnp, nf, nt, cf, ct, 1, wins, tau, fd, edges,
+            group=2, method="auto", iters=64)
+        _, eigs = pipe(jnp.asarray(dyn, dtype=jnp.float32),
+                       jnp.asarray(etas))
+        eigs = np.asarray(eigs)
+        assert eigs.shape == (4, len(etas))
+        assert np.isfinite(eigs).all()
+        errs = []
+        for b in range(4):
+            eta_fit, _ = fit_eig_peak(etas, eigs[b], fw=0.2)
+            if np.isfinite(eta_fit):
+                errs.append(abs(eta_fit - eta_true) / eta_true)
+        assert len(errs) >= 3, "chunk fits mostly failed"
+        assert np.median(errs) < 0.1
+
+
 class TestBenchPlan:
     def test_every_config_has_a_budget_estimate(self):
         """The budget-skip logic reads _EST_S[name]; a config added to
