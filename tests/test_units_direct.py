@@ -170,6 +170,68 @@ class TestOpsHelpers:
         np.testing.assert_allclose(out, img, atol=1e-6)
 
 
+class TestRandomizedBackendParity:
+    """Seeded random-config cross-backend sweep: the fixed-seed parity
+    tests pin known shapes; this sweeps kernel options × odd shapes so
+    an option-dependent backend divergence (window kind, prewhite,
+    halve, non-pow2 sizes) surfaces in CI. A 40-config exploratory
+    soak found zero divergences; these 8 seeded configs keep that
+    property pinned cheaply."""
+
+    def test_sspec_acf_norm_parity_random_configs(self):
+        from scintools_tpu.ops.acf import autocovariance
+        from scintools_tpu.ops.normsspec import normalise_sspec
+        from scintools_tpu.ops.sspec import secondary_spectrum
+
+        rng = np.random.default_rng(0)
+        for trial in range(8):
+            nf = int(rng.integers(16, 90))
+            nt = int(rng.integers(16, 90))
+            dyn = np.abs(rng.normal(1.0, 0.4, (nf, nt))) + 0.1
+            window = rng.choice(["hanning", "hamming", "blackman",
+                                 "bartlett", None])
+            prewhite = bool(rng.integers(0, 2))
+            halve = True if prewhite else bool(rng.integers(0, 2))
+            kw = dict(dt=float(rng.uniform(0.5, 10)),
+                      df=float(rng.uniform(0.01, 1)),
+                      window=window,
+                      window_frac=float(rng.uniform(0.05, 0.3)),
+                      prewhite=prewhite, halve=halve)
+            f1, t1, s1 = secondary_spectrum(dyn, backend="numpy",
+                                            **kw)
+            f2, t2, s2 = secondary_spectrum(dyn, backend="jax", **kw)
+            # axes are host-derived on both backends today, so these
+            # are identity checks — they become real guards if a
+            # refactor ever computes axes on-device
+            np.testing.assert_allclose(f1, np.asarray(f2), rtol=1e-12)
+            np.testing.assert_allclose(t1, np.asarray(t2), rtol=1e-12)
+            lin1 = 10 ** (np.asarray(s1) / 10)
+            lin2 = 10 ** (np.asarray(s2) / 10)
+            assert np.linalg.norm(lin1 - lin2) \
+                <= 1e-8 * np.linalg.norm(lin1), (trial, kw)
+
+            a1 = autocovariance(dyn, backend="numpy")
+            a2 = np.asarray(autocovariance(dyn, backend="jax"))
+            assert np.linalg.norm(a1 - a2) \
+                <= 1e-9 * np.linalg.norm(a1), (trial, nf, nt)
+
+            fn, tn, sn = secondary_spectrum(dyn, dt=2.0, df=0.05,
+                                            backend="numpy")
+            eta = float(rng.uniform(1e-4, 1e-2))
+            ns1 = normalise_sspec(np.asarray(sn), tn, fn, eta,
+                                  numsteps=200, backend="numpy")
+            ns2 = normalise_sspec(np.asarray(sn), tn, fn, eta,
+                                  numsteps=200, backend="jax")
+            p1 = np.asarray(ns1.normsspecavg)
+            p2 = np.asarray(ns2.normsspecavg)
+            np.testing.assert_array_equal(np.isfinite(p1),
+                                          np.isfinite(p2))
+            m = np.isfinite(p1)
+            if m.any():
+                assert np.linalg.norm(p1[m] - p2[m]) <= 1e-7 * max(
+                    np.linalg.norm(p1[m]), 1e-30), (trial, eta)
+
+
 class TestUtilsMisc:
     def test_mjd_to_year_epoch(self):
         from scintools_tpu.utils.misc import mjd_to_year
