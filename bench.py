@@ -788,12 +788,15 @@ def bench_survey_arc(jax, jnp):
     # tunnel link (~2 MB/s up) would otherwise be what gets timed
     dev = [jnp.asarray(v, dtype=jnp.float32) for v in variants]
 
-    # ---- jax: one jitted profile program + host peak fits -----------
+    # ---- jax: whole fit (profile + savgol + peak + parabola) as ONE
+    # device program; the fetch is [B, 10] scalars (full_output=False
+    # skips the folded-profile pull — ops/fitarc_device.py) ----------
     fits0 = fit_arc_batch(variants[0], tdel, fdop, numsteps=numsteps,
-                          sspecs_device=dev[0])
+                          sspecs_device=dev[0], full_output=False)
     t_jax = _time_variants(
         lambda s, d: fit_arc_batch(s, tdel, fdop, numsteps=numsteps,
-                                   sspecs_device=d),
+                                   sspecs_device=d,
+                                   full_output=False),
         list(zip(variants[1:], dev[1:])), repeats=3 if full else 1)
 
     # ---- numpy: the reference's serial per-epoch loop (failed fits

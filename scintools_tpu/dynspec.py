@@ -1119,9 +1119,13 @@ class Dynspec:
                              plot=False, plot_log=True, use_angle=False,
                              use_spatial=False):
         """Map sspec power onto the (θx, θy) plane assuming primary-arc
-        interference (dynspec.py:3412-3582)."""
-        from scipy.interpolate import RectBivariateSpline
+        interference (dynspec.py:3412-3582).
 
+        The spline-evaluation stage (reference :3538-3547, a host
+        FITPACK ``RectBivariateSpline.ev``) runs as a cubic-convolution
+        weight-matmul on the FFT grid (ops/scatim.py) — on device for
+        ``backend='jax'``; a non-uniform axis (no FFT grid) falls back
+        to the host spline."""
         if input_sspec is None:
             sspec, yaxis = self._select_sspec(lamsteps=lamsteps,
                                               trap=trap)
@@ -1184,8 +1188,17 @@ class Dynspec:
         FX, FY = np.meshgrid(fdop_x, fdop_y)
         tdel_est = (FX ** 2 + FY ** 2) * eta
 
-        interp = RectBivariateSpline(tdel, fdop, linsspec)
-        image = interp.ev(tdel_est, FX) * FY
+        from .ops.scatim import is_uniform, scattered_image_interp
+
+        if is_uniform(tdel) and is_uniform(fdop):
+            image = np.asarray(scattered_image_interp(
+                linsspec, tdel, fdop, tdel_est, FX,
+                backend=self.backend)) * FY
+        else:                            # no FFT grid (e.g. trap axis)
+            from scipy.interpolate import RectBivariateSpline
+
+            interp = RectBivariateSpline(tdel, fdop, linsspec)
+            image = interp.ev(tdel_est, FX) * FY
         scat_im = np.zeros((nx, nx))
         scat_im[ny - 1:nx, :] = image
         scat_im[0:ny - 1, :] = image[ny - 1:0:-1, :]

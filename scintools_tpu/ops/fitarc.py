@@ -51,12 +51,15 @@ def sspec_noise(sspec, cutmid, n_rows):
     return noise / np.sqrt(n_rows * 2)
 
 
-def sspec_noise_batch(sspecs, cutmid, n_rows):
+def sspec_noise_batch(sspecs, cutmid, n_rows, xp=np):
     """:func:`sspec_noise` over an epoch batch ``[B, nr, nc]`` in one
     vectorised pass (one std per epoch instead of B python calls).
     The two quadrant slices stay views — their first/second moments
-    combine into the concatenated population std without the copy."""
-    sspecs = np.asarray(sspecs)
+    combine into the concatenated population std without the copy.
+    ``xp`` selects the array namespace: the device fit program
+    (ops/fitarc_device.py) runs the SAME implementation with
+    ``xp=jax.numpy`` so the two paths cannot drift."""
+    sspecs = xp.asarray(sspecs)
     _, nr, nc = sspecs.shape
     a = sspecs[:, int(nr / 2):, int(nc / 2 + np.ceil(cutmid / 2)):]
     b = sspecs[:, int(nr / 2):, 0:int(nc / 2 - np.floor(cutmid / 2))]
@@ -66,10 +69,15 @@ def sspec_noise_batch(sspecs, cutmid, n_rows):
     na = a.shape[1] * a.shape[2]
     nb = b.shape[1] * b.shape[2]
     n = na + nb
+    if n == 0:
+        # BOTH quadrants zero-width (cutmid >= Doppler width): the
+        # serial path stds an empty concat → NaN with a RuntimeWarning;
+        # return the same NaNs without the raw divide warning
+        return xp.full(sspecs.shape[0], np.nan)
     # an empty quadrant (narrow Doppler axis + large cutmid)
     # contributes nothing — mirror the serial path's concatenation,
     # where the empty slice simply vanishes
-    zeros = np.zeros(len(sspecs))
+    zeros = xp.zeros(sspecs.shape[0], dtype=sspecs.dtype)
     mu_a = a.mean(axis=(1, 2)) if na else zeros
     mu_b = b.mean(axis=(1, 2)) if nb else zeros
     var_a = a.var(axis=(1, 2)) if na else zeros
@@ -77,7 +85,7 @@ def sspec_noise_batch(sspecs, cutmid, n_rows):
     mu = (na * mu_a + nb * mu_b) / n
     var = (na * (var_a + (mu_a - mu) ** 2)
            + nb * (var_b + (mu_b - mu) ** 2)) / n
-    return np.sqrt(var) / np.sqrt(n_rows * 2)
+    return xp.sqrt(var) / np.sqrt(n_rows * 2)
 
 
 def _profile_from_norm(ns, asymm=False):
@@ -275,7 +283,8 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
                   low_power_diff=-1, high_power_diff=-0.5,
                   constraint=(0, np.inf), nsmooth=5, efac=1,
                   noise_error=True, log_parabola=False, mesh=None,
-                  sspecs_device=None):
+                  sspecs_device=None, on_device=None,
+                  full_output=True):
     """Arc-curvature fit over a whole batch of same-geometry epochs.
 
     The reference runs ``fit_arc`` serially per epoch inside its
@@ -298,8 +307,20 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
     already-staged device array (any float dtype) — a steady-state
     survey pipeline keeps epochs resident on device, and re-uploading
     them per call would time the host link instead of the program.
-    The host ``sspecs`` copy is still required (noise estimates and
-    peak fits are host work).
+
+    ``on_device`` selects where the post-profile tail (savgol, peak
+    walk-out, parabola fit, noise estimate) runs. The default (None →
+    True unless ``log_parabola``) appends it to the profile program
+    (ops/fitarc_device.py) so the whole fit is ONE dispatch returning
+    ten scalars per epoch (η, errors, noise, plus the peak window and
+    parabola coefficients); ``on_device=False`` keeps the f64 host
+    tail (the parity oracle, and the only path for ``log_parabola``).
+    With the device path, ``full_output=False`` skips fetching the
+    folded profiles — the ArcFit diagnostics fields (profile,
+    eta_array, prob_eta_peak, xdata, yfit) are then None, which is
+    what a survey driver that only consumes eta/etaerr wants on a
+    tunneled link; with ``full_output=True`` every diagnostic is
+    rebuilt host-side from the packed columns.
     """
     import jax.numpy as jnp
 
@@ -332,9 +353,13 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
                                (B,)).copy()
     etamax_b = np.broadcast_to(np.asarray(etamax, dtype=float),
                                (B,)).copy()
-    noises = sspec_noise_batch(sspecs, cutmid, n_rows=ind)
+    if on_device is None:
+        on_device = not log_parabola
+    if on_device and log_parabola:
+        raise ValueError("log_parabola is host-only — pass "
+                         "on_device=False")
 
-    # cache the compiled profile program per (geometry, mesh): a
+    # cache the compiled program per (geometry, fit params, mesh): a
     # survey driver calls this per epoch batch, and a rebuilt jax.jit
     # retraces+recompiles every time (~200× the warm run). Same
     # pattern as dynspec._SHARDED_GRID_CACHE.
@@ -343,13 +368,38 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
         mesh_key = (tuple(d.id for d in np.ravel(mesh.devices)),
                     tuple(mesh.axis_names),
                     tuple(mesh.shape.values()))
+    fit_key = (int(nsmooth), float(low_power_diff),
+               float(high_power_diff), tuple(map(float, constraint)),
+               bool(noise_error)) if on_device else None
     key = (yaxis.tobytes(), fdop.tobytes(), float(delmax),
-           int(startbin), int(cutmid), int(numsteps), mesh_key)
+           int(startbin), int(cutmid), int(numsteps), mesh_key,
+           fit_key)
     entry = _ARC_PROFILE_CACHE.get(key)
     if entry is None:
         if len(_ARC_PROFILE_CACHE) >= 8:
             _ARC_PROFILE_CACHE.pop(next(iter(_ARC_PROFILE_CACHE)))
-        if mesh is not None:
+        if on_device:
+            if mesh is not None:
+                from ..parallel.survey import make_arc_fit_sharded
+
+                entry = make_arc_fit_sharded(
+                    mesh, yaxis, fdop, delmax=delmax,
+                    startbin=startbin, cutmid=cutmid,
+                    numsteps=int(numsteps), nsmooth=nsmooth,
+                    low_power_diff=low_power_diff,
+                    high_power_diff=high_power_diff,
+                    constraint=constraint, noise_error=noise_error)
+            else:
+                from .fitarc_device import make_arc_fit_batch_fn
+
+                entry = (make_arc_fit_batch_fn(
+                    yaxis, fdop, delmax=delmax, startbin=startbin,
+                    cutmid=cutmid, numsteps=int(numsteps),
+                    nsmooth=nsmooth, low_power_diff=low_power_diff,
+                    high_power_diff=high_power_diff,
+                    constraint=constraint,
+                    noise_error=noise_error), 1)
+        elif mesh is not None:
             from ..parallel.survey import make_arc_profile_sharded
 
             entry = make_arc_profile_sharded(
@@ -378,6 +428,60 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
         s_in = np.concatenate([sspecs] + [sspecs[-1:]] * pad) \
             if pad else sspecs
         s_dev = jnp.asarray(s_in)
+
+    if on_device:
+        from .fitarc_device import eta_crop_lengths, eta_grid
+
+        emax_in = np.concatenate([etamax_b] + [etamax_b[-1:]] * pad) \
+            if pad else etamax_b
+        Ls = eta_crop_lengths(numsteps, e_in, emax_in)
+        packed, folded_dev = fn(s_dev, jnp.asarray(e_in),
+                                jnp.asarray(Ls))
+        out = np.asarray(packed)[:B]     # ONE tiny fetch: [B, 10]
+        ef2, fdopnew = eta_grid(numsteps)
+        with np.errstate(divide="ignore"):
+            # the UNflipped profile-order etafrac (_prep_profile
+            # flips internally); ef2 is already flipped-ascending
+            etafrac_f = 1.0 / fdopnew[fdopnew >= 0]
+        folded = np.asarray(folded_dev)[:B] if full_output else None
+        fits = []
+        for b in range(B):
+            (eta_b, err_b, err2_b, noise_b, lo_b, n_b, a2_b, a1_b,
+             a0_b, scale_b) = out[b]
+            fit = ArcFit(eta=float(eta_b), etaerr=float(err_b),
+                         etaerr2=float(err2_b),
+                         eta_array=None, profile=None,
+                         norm_fdop=fdopnew, noise=float(noise_b))
+            if full_output:
+                spec = folded[b]
+                spec_s, eta_s = _prep_profile(
+                    spec, etafrac_f, etamin_b[b], etamax_b[b])
+                if np.isfinite(eta_b):
+                    fit.profile, fit.eta_array = spec_s, eta_s
+                    sigma = float(noise_b) * efac
+                    with np.errstate(divide="ignore",
+                                     invalid="ignore"):
+                        fit.prob_eta_peak = (
+                            1 / (sigma * np.sqrt(2 * np.pi))
+                            * np.exp(-0.5 * ((spec_s - np.max(spec_s))
+                                             / sigma) ** 2))
+                    # fit_parabola diagnostics from the packed window
+                    # + xs-parameterisation coefficients
+                    lo_i, n_i = int(lo_b), int(n_b)
+                    fit.xdata = eta_s[lo_i:lo_i + n_i]
+                    xs = fit.xdata * float(scale_b)
+                    fit.yfit = (float(a2_b) * xs ** 2
+                                + float(a1_b) * xs + float(a0_b))
+                else:           # quarantined: _nan_fit's shape — the
+                    # UNflipped profile paired with its descending
+                    # eta axis (profile order, not crop order)
+                    fit.profile = spec
+                    fit.eta_array = (float(etamin_b[b])
+                                     * etafrac_f ** 2)
+            fits.append(fit)
+        return fits
+
+    noises = sspec_noise_batch(sspecs, cutmid, n_rows=ind)
     # device program returns the ±fdop-folded profile (fold=True):
     # half the fetch over the tunnel, and the fold rides the chip
     folded = np.asarray(fn(s_dev, jnp.asarray(e_in)))[:B]

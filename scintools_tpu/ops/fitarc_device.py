@@ -1,0 +1,291 @@
+"""Whole-survey arc fit as ONE device program.
+
+Re-design of the peak/parabola stage of ``Dynspec.fit_arc``
+(/root/reference/scintools/dynspec.py:1182-1311). The batched host
+path (ops/fitarc.py:fit_arc_batch) already runs the expensive
+arc-normalised profile on device, but then fetches every epoch's
+folded profile ([B, numsteps/2] floats — ~0.5 MB per survey batch
+over a tunneled link) and walks the peak on host in python loops.
+Here the ENTIRE per-epoch tail — savgol smoothing, peak walk-out,
+masked parabola fit, noise-error walk — is fixed-shape masked device
+math appended to the profile program, so a survey batch returns ten
+scalars per epoch and the fetch is one ~5 KB transfer.
+
+Semantics are pinned to the host path index-for-index:
+
+- savgol_filter(window, polyorder=1, mode='interp'): interior is the
+  uniform moving mean (the order-1 Savitzky–Golay centre weight);
+  the first/last ``window//2`` points come from a linear LS fit over
+  the first/last ``window`` valid points (scipy's edge polyfit).
+- the peak walk-outs replicate the reference's while-loops — including
+  their quirks (the scan starts at ``ind±2``; the noise walk's left
+  scan stops at index 2 and over-counts by one; a fully-walked-out
+  left edge lands on index -1, which python wraps to the last valid
+  element) — see _fit_one below.
+- the parabola fit reproduces ``fit_parabola``
+  (fit/models.py:221-233 → reference scint_models.py:300-328):
+  x is scaled by 1000/ptp, the deg-2 LS solve runs in centred
+  coordinates for f32 conditioning, and the covariance is
+  np.polyfit(cov=True)'s — inv(AᵀA)·resid/(n-3), with the reference's
+  sqrt-of-abs-diagonal error propagation.
+
+The profile crop length per epoch (the host path's ``_prep_profile``
+η-range selection — a pure function of etamin/etamax and the fdop
+grid, since the folded profile is always finite) is computed on host
+by :func:`eta_crop_lengths` and passed in as a traced int per epoch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+
+
+def eta_grid(numsteps):
+    """The ascending per-epoch η grid factor: ``eta_array =
+    etamin · eta_grid(numsteps)`` after the host path's finite-mask
+    flip (ops/fitarc.py:_prep_profile). Also returns the folded
+    (fdop ≥ 0) normalised-Doppler axis."""
+    numsteps = int(numsteps) + int(numsteps) % 2
+    fdopnew = np.linspace(-1.0, 1.0, numsteps)
+    pos = fdopnew >= 0
+    with np.errstate(divide="ignore"):
+        etafrac = 1.0 / fdopnew[pos]
+    return np.flip(etafrac) ** 2, fdopnew
+
+
+def eta_crop_lengths(numsteps, etamins, etamaxs):
+    """Per-epoch valid-prefix length L of the flipped folded profile:
+    the count of ``etamin·etafrac² < etamax`` — evaluated with the
+    identical float expression the host crop uses."""
+    ef2, _ = eta_grid(numsteps)
+    etamins = np.atleast_1d(np.asarray(etamins, dtype=float))
+    etamaxs = np.atleast_1d(np.asarray(etamaxs, dtype=float))
+    return (etamins[:, None] * ef2[None, :]
+            < etamaxs[:, None]).sum(axis=1).astype(np.int32)
+
+
+def make_savgol_interp(nsmooth, H):
+    """Fixed-shape masked ``savgol_filter(q[:L], nsmooth, 1,
+    mode='interp')``: interior is the uniform moving mean (the
+    order-1 Savitzky–Golay centre weight); the first/last
+    ``nsmooth//2`` valid points come from a linear LS fit over the
+    first/last ``nsmooth`` valid points (scipy's edge polyfit).
+    Returns ``smooth(q[H], L) → [H]`` (entries at j >= L are unused
+    garbage); pinned against scipy in tests/test_arc.py."""
+    get_jax()                            # jax import guard
+    import jax
+    import jax.numpy as jnp
+
+    w = int(nsmooth)
+    half = w // 2
+    tc = (w - 1) / 2.0
+    t_rel = np.arange(w, dtype=float) - tc
+    den_t = float(np.sum(t_rel ** 2))
+    idx = np.arange(H, dtype=np.int32)
+
+    def smooth(q, L):
+        mov = jnp.convolve(q, jnp.ones(w, q.dtype) / w, mode="same")
+        yl = q[:w]
+        bl = jnp.dot(jnp.asarray(t_rel, q.dtype), yl) / den_t
+        al = jnp.mean(yl)
+        val_l = al + bl * (idx - tc)
+        yr = jax.lax.dynamic_slice(q, (L - w,), (w,))
+        br = jnp.dot(jnp.asarray(t_rel, q.dtype), yr) / den_t
+        ar = jnp.mean(yr)
+        val_r = ar + br * ((idx - (L - w)) - tc)
+        return jnp.where(idx < half, val_l,
+                         jnp.where(idx >= L - half, val_r, mov))
+
+    return smooth
+
+
+def make_arc_fit_batch_fn(tdel, fdop, delmax=None, startbin=3, cutmid=3,
+                          numsteps=10000, nsmooth=5,
+                          low_power_diff=-1.0, high_power_diff=-0.5,
+                          constraint=(0.0, np.inf), noise_error=True):
+    """Build the jitted whole-fit program.
+
+    Returns ``fn(sspecs[B, ntdel, nfdop], etamins[B], Ls[B]) →
+    (out[B, 10], folded[B, numsteps//2])`` where the packed columns
+    are ``(eta, etaerr, etaerr2, noise, lo, n, a2, a1, a0, scale)`` —
+    the last six reconstruct the fit_parabola diagnostics (window
+    start/length in the cropped array; parabola coefficients and the
+    1000/ptp scale in the xs parameterisation). NaN η marks an epoch
+    the host path would quarantine (profile too short, no grid point
+    inside the constraint, too few window points for the covariance
+    polyfit, or a forward parabola). ``folded`` is the
+    device-resident folded profile (only fetch it when diagnostics
+    are wanted).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    from .normsspec import make_arc_profile_batch_fn
+
+    tdel = np.asarray(tdel, dtype=float)
+    fdop = np.asarray(fdop, dtype=float)
+    numsteps = int(numsteps) + int(numsteps) % 2
+    H = numsteps // 2
+    if nsmooth % 2 != 1 or nsmooth < 3:
+        raise ValueError("nsmooth must be an odd window >= 3 "
+                         "(scipy savgol_filter requirement)")
+    delmax = np.max(tdel) if delmax is None else float(delmax)
+    n_rows = int(np.argmin(np.abs(tdel - delmax)))  # noise divisor
+
+    profile_fn = make_arc_profile_batch_fn(
+        tdel, fdop, delmax=delmax, startbin=startbin, cutmid=cutmid,
+        numsteps=numsteps, fold=True)
+
+    ef2, _ = eta_grid(numsteps)
+    c0, c1 = float(constraint[0]), float(constraint[1])
+    w = int(nsmooth)
+    idx = np.arange(H, dtype=np.int32)
+    smooth_one = make_savgol_interp(w, H)
+
+    def _noise_batch(s):
+        """sspec_noise over the batch, on device: the SAME pooled
+        two-pass moment combination as the host path, via its
+        xp-parameterised implementation (fitarc.py:sspec_noise_batch
+        with xp=jnp)."""
+        from .fitarc import sspec_noise_batch
+
+        return sspec_noise_batch(s, cutmid, n_rows=n_rows, xp=jnp)
+
+    def _fit_one(q, sm, L, eta_row, noise):
+        valid = idx < L
+        BIG = jnp.asarray(np.inf, q.dtype)
+
+        # peak index: max of smoothed inside the constraint, then the
+        # reference's argmin(|smoothed - max|) over the WHOLE cropped
+        # array (dynspec.py:1205-1213)
+        inr = valid & (eta_row > c0) & (eta_row < c1)
+        has_inr = jnp.any(inr)
+        max_in = jnp.max(jnp.where(inr, sm, -BIG))
+        ind = jnp.argmin(jnp.where(valid, jnp.abs(sm - max_in), BIG))
+        max_power = sm[ind]
+
+        # power walk-outs (dynspec.py:1215-1228): the while-loops scan
+        # smoothed[ind-2], ind-3, … (resp. ind+2, ind+3, …) until the
+        # first value at or below threshold; the boundary stops at
+        # index 0 (resp. L-1). Loop never entered when ind < 2 (resp.
+        # ind+1 >= L-1): i stays 1.
+        t_lo = max_power + low_power_diff
+        t_hi = max_power + high_power_diff
+        if low_power_diff < 0:           # loop never entered otherwise
+            ml = valid & (idx <= ind - 2) & (sm <= t_lo)
+            jl = jnp.max(jnp.where(ml, idx, -1))
+            i1 = jnp.where(ind >= 2,
+                           jnp.where(jl >= 0, ind - jl, ind), 1)
+        else:
+            i1 = jnp.asarray(1, idx.dtype)
+        if high_power_diff < 0:
+            mr = valid & (idx >= ind + 2) & (sm <= t_hi)
+            jr = jnp.min(jnp.where(mr, idx, H + 1))
+            i2 = jnp.where(ind + 1 < L - 1,
+                           jnp.where(jr <= H, jr - ind, L - 1 - ind),
+                           1)
+        else:
+            i2 = jnp.asarray(1, idx.dtype)
+
+        # masked parabola fit over [ind-i1, ind+i2) — fit_parabola
+        # (fit/models.py:221-233): xs = x·1000/ptp, deg-2 LS, polyfit
+        # covariance = inv(AᵀA)·resid/(n-3). Solved in centred/scaled
+        # u = (xs - mean)/500 (u ∈ ~[-2, 2]) so the normal equations
+        # stay f32-conditioned, then mapped back to the xs
+        # parameterisation for the reference's error formula.
+        lo, hi = ind - i1, ind + i2
+        wm = valid & (idx >= lo) & (idx < hi)
+        n = jnp.sum(wm)
+        nf_ = n.astype(q.dtype)
+        xmin = jnp.min(jnp.where(wm, eta_row, BIG))
+        xmax = jnp.max(jnp.where(wm, eta_row, -BIG))
+        scale = 1000.0 / (xmax - xmin)
+        xs = eta_row * scale
+        m = jnp.sum(jnp.where(wm, xs, 0.0)) / nf_
+        h = 500.0
+        u = jnp.where(wm, (xs - m) / h, 0.0)
+        # centre y too: the constant term absorbs any shift, so the
+        # LS residuals are invariant — but in f32 they'd otherwise be
+        # tiny differences of O(|y|) numbers (measured ~5% noise on
+        # etaerr2 without this)
+        ym = jnp.sum(jnp.where(wm, q, 0.0)) / nf_
+        y = jnp.where(wm, q - ym, 0.0)
+        u2 = u * u
+        S1 = jnp.sum(u)
+        S2 = jnp.sum(u2)
+        S3 = jnp.sum(u2 * u)
+        S4 = jnp.sum(u2 * u2)
+        G = jnp.array([[S4, S3, S2], [S3, S2, S1], [S2, S1, nf_]])
+        r = jnp.array([jnp.sum(u2 * y), jnp.sum(u * y), jnp.sum(y)])
+        c = jnp.linalg.solve(G, r)
+        c2, c1_, c0_ = c[0], c[1], c[2]
+        fitv = c2 * u2 + c1_ * u + c0_
+        resid = jnp.sum(jnp.where(wm, (y - fitv) ** 2, 0.0))
+        fac = resid / (nf_ - 3.0)        # np.polyfit cov scale: n-dof
+        Ginv = jnp.linalg.inv(G)
+        var_c2 = Ginv[0, 0] * fac
+        var_c1 = Ginv[1, 1] * fac
+        cov12 = Ginv[0, 1] * fac
+        a2 = c2 / h ** 2
+        a1 = c1_ / h - 2.0 * m * c2 / h ** 2
+        var_a2 = var_c2 / h ** 4
+        var_a1 = (var_c1 / h ** 2 + 4.0 * m ** 2 / h ** 4 * var_c2
+                  - 4.0 * m / h ** 3 * cov12)
+        err_a1 = jnp.sqrt(jnp.abs(var_a1))
+        err_a2 = jnp.sqrt(jnp.abs(var_a2))
+        eta_fit = (-a1 / (2.0 * a2)) / scale
+        etaerr2 = jnp.sqrt(err_a1 ** 2 * (1.0 / (2.0 * a2)) ** 2
+                           + err_a2 ** 2 * (a1 / 2.0) ** 2) / scale
+
+        # noise-error walk (dynspec.py:1232-1247): left scan reads
+        # smoothed[ind-1] … smoothed[2] and lands one PAST the
+        # crossing (i1 = ind - j* + 1); right scan mirrors the power
+        # walk with threshold max-noise. ind <= 2 (resp.
+        # ind+1 >= L-1) skips the loop: i stays 1.
+        t_n = max_power - noise
+        walk = noise > 0                 # noise <= 0: loop not entered
+        mln = valid & (idx >= 2) & (idx <= ind - 1) & (sm <= t_n)
+        jln = jnp.max(jnp.where(mln, idx, -1))
+        i1n = jnp.where(walk & (ind > 2),
+                        jnp.where(jln >= 0, ind - jln + 1, ind - 1), 1)
+        mrn = valid & (idx >= ind + 2) & (sm <= t_n)
+        jrn = jnp.min(jnp.where(mrn, idx, H + 1))
+        i2n = jnp.where(walk & (ind + 1 < L - 1),
+                        jnp.where(jrn <= H, jrn - ind, L - 1 - ind), 1)
+        il = jnp.mod(ind - i1n, L)       # python wrap: eta_array[-1]
+        ir = jnp.minimum(ind + i2n, L - 1)
+        err_noise = jnp.abs(eta_row[il] - eta_row[ir]) / 2.0
+
+        # host-path quarantine conditions → NaN η (fit_arc_batch
+        # catches the equivalent ValueErrors)
+        # lo < 0 (peak on the first grid point): the host slice
+        # eta_array[-1:hi] is empty and fit_parabola's ptp raises →
+        # quarantine, matching here
+        ok = ((L > w) & has_inr & (n > 3) & (lo >= 0) & ~(a2 > 0)
+              & jnp.isfinite(eta_fit))
+        nan = jnp.asarray(np.nan, q.dtype)
+        sq2 = np.sqrt(2.0)
+        etaerr = (err_noise if noise_error else etaerr2) / sq2
+        # window + xs-parameterisation coefficients so the host can
+        # rebuild the fit_parabola diagnostics (yfit over xdata =
+        # eta_array[lo:lo+n]) without fetching the profile
+        a0 = ym + c0_ - c1_ * m / h + c2 * m ** 2 / h ** 2
+        return (jnp.where(ok, eta_fit, nan),
+                jnp.where(ok, etaerr, nan),
+                jnp.where(ok, etaerr2 / sq2, nan),
+                lo.astype(q.dtype), n.astype(q.dtype),
+                a2, a1, a0, scale)
+
+    def program(sspecs, etamins, Ls):
+        folded = profile_fn(sspecs, etamins)
+        q = jnp.flip(folded, axis=1)
+        eta_rows = etamins[:, None] * jnp.asarray(ef2, folded.dtype)
+        noises = _noise_batch(sspecs)
+        sm = jax.vmap(smooth_one)(q, Ls)
+        cols = jax.vmap(_fit_one)(q, sm, Ls, eta_rows, noises)
+        packed = jnp.stack(cols[:3] + (noises,) + cols[3:], axis=1)
+        return packed, folded
+
+    return jax.jit(program)

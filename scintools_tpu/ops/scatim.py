@@ -1,0 +1,154 @@
+"""Scattered-image interpolation: sspec power → (θx, θy) plane.
+
+Re-design of the interpolation stage of ``Dynspec.calc_scattered_image``
+(/root/reference/scintools/dynspec.py:3412-3582; the spline evaluation
+is :3538-3547). The reference evaluates a FITPACK bicubic spline
+(``RectBivariateSpline.ev``) at every (tdel_est, fdop) query point on
+the host. Both secondary-spectrum axes come from ``fft_axis`` and are
+uniform, so the same mapping here is a **Keys cubic-convolution
+(Catmull–Rom) interpolation** — C¹, interpolating, and expressible as
+dense per-axis weight matrices:
+
+    val[q] = Σ_r Wt[q, r] · (Wf @ linᵀ)[q, r]
+
+i.e. one matmul over the Doppler axis plus a row-wise contraction over
+the delay axis — the ``ops/normsspec.py`` tent-matmul trick at cubic
+order, which rides the MXU where a 16-point gather crawls. Queries are
+processed one image row at a time (``lax.map``) so the weight slabs
+stay O(nx · n_src).
+
+Not bit-identical to FITPACK (different cubic family, and queries
+outside the grid clamp to the edge instead of spline extrapolation) —
+the parity budget is physical, not bitwise; see
+tests/test_scatim.py for the spline-agreement tolerance on smooth
+golden data. Non-uniform axes (no FFT grid) are the caller's cue to
+fall back to the host spline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax, resolve_backend
+
+# compiled query programs keyed on (grid shape, query shape, dtype)
+_SCATIM_CACHE = {}
+
+
+def _keys_1d(u, xp=np):
+    """The Keys (a=-0.5) cubic-convolution kernel, elementwise."""
+    au = xp.abs(u)
+    au2 = au * au
+    au3 = au2 * au
+    near = 1.5 * au3 - 2.5 * au2 + 1.0
+    far = -0.5 * au3 + 2.5 * au2 - 4.0 * au + 2.0
+    return xp.where(au <= 1.0, near,
+                    xp.where(au < 2.0, far, xp.zeros_like(au)))
+
+
+def _keys_weights(pos, n_src, xp):
+    """Dense Keys weights on the edge-padded source grid (the
+    MXU-matmul form). ``pos[nq]`` are float index coordinates clamped
+    to [0, n_src-1]; returns ``[nq, n_src+2]`` weights against the
+    padded axis (one replicated sample each side), rows summing to 1.
+    """
+    u = (pos[:, None] + 1.0) - xp.arange(n_src + 2, dtype=pos.dtype)
+    return _keys_1d(u, xp)
+
+
+def _pad_edge(lin, xp):
+    """Replicate-pad one row/column each side (the clamped-query
+    boundary condition)."""
+    lin = xp.concatenate([lin[:1], lin, lin[-1:]], axis=0)
+    return xp.concatenate([lin[:, :1], lin, lin[:, -1:]], axis=1)
+
+
+def cubic_interp2d(lin, tpos, fpos, backend=None):
+    """Cubic-convolution interpolation of ``lin[nr, nc]`` at float
+    index coordinates ``tpos``/``fpos`` (each ``[ny, nx]``, delay and
+    Doppler axes respectively). Coordinates are clamped to the grid.
+    Returns ``[ny, nx]`` (numpy for the numpy backend, device array
+    for jax)."""
+    backend = resolve_backend(backend)
+    nr, nc = np.shape(lin)
+    if backend == "jax":
+        return _cubic_interp2d_jax(lin, tpos, fpos)
+
+    # numpy: 16-tap stencil gather — O(nq·16), where the dense-weight
+    # matmul form (the jax path, built for the MXU) would be
+    # O(nq·nc·nr) on host
+    lin = _pad_edge(np.asarray(lin, dtype=float), np)
+    tpos = np.clip(np.asarray(tpos, dtype=float), 0, nr - 1)
+    fpos = np.clip(np.asarray(fpos, dtype=float), 0, nc - 1)
+    # clamp the base cell so taps stay inside the padded grid; at the
+    # top edge frac hits exactly 1.0, where the Keys weights reduce to
+    # the pure node value — identical to the dense form
+    it = np.clip(np.floor(tpos).astype(int), 0, nr - 2)
+    jf = np.clip(np.floor(fpos).astype(int), 0, nc - 2)
+    ft = tpos - it
+    ff = fpos - jf
+    out = np.zeros(tpos.shape)
+    for a in range(-1, 3):
+        wt = _keys_1d(ft - a)
+        for b in range(-1, 3):
+            out += wt * _keys_1d(ff - b) \
+                * lin[it + 1 + a, jf + 1 + b]
+    return out
+
+
+def _cubic_interp2d_jax(lin, tpos, fpos):
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    nr, nc = np.shape(lin)
+    key = (nr, nc, np.shape(tpos))
+    fn = _SCATIM_CACHE.get(key)
+    if fn is None:
+        if len(_SCATIM_CACHE) >= 8:
+            _SCATIM_CACHE.pop(next(iter(_SCATIM_CACHE)))
+
+        def program(lin_d, tq, fq):
+            lin_p = _pad_edge(lin_d, jnp)
+            tq = jnp.clip(tq, 0, nr - 1)
+            fq = jnp.clip(fq, 0, nc - 1)
+            hi = jax.lax.Precision.HIGHEST
+
+            def row(args):
+                tp, fp = args
+                wf = _keys_weights(fp, nc, jnp)
+                wt = _keys_weights(tp, nr, jnp)
+                m = jnp.dot(wf, lin_p.T, precision=hi)
+                return jnp.sum(wt * m, axis=1)
+
+            return jax.lax.map(row, (tq, fq))
+
+        fn = jax.jit(program)
+        _SCATIM_CACHE[key] = fn
+    return fn(jnp.asarray(lin), jnp.asarray(tpos),
+              jnp.asarray(fpos))
+
+
+def is_uniform(axis, rtol=1e-6):
+    """True when ``axis`` is an (ascending) uniform grid — the
+    precondition for index-arithmetic interpolation."""
+    axis = np.asarray(axis, dtype=float)
+    d = np.diff(axis)
+    return d.size > 0 and np.all(d > 0) and np.allclose(d, d[0],
+                                                        rtol=rtol)
+
+
+def scattered_image_interp(linsspec, tdel, fdop, tdel_q, fdop_q,
+                           backend=None):
+    """The calc_scattered_image query: interpolate the linear-power
+    secondary spectrum at (tdel_q, fdop_q) grids. Axes must be
+    uniform (fft_axis grids are); raises ValueError otherwise so the
+    caller can fall back to a host spline."""
+    tdel = np.asarray(tdel, dtype=float)
+    fdop = np.asarray(fdop, dtype=float)
+    if not (is_uniform(tdel) and is_uniform(fdop)):
+        raise ValueError("non-uniform axis — host-spline territory")
+    tpos = (np.asarray(tdel_q, dtype=float) - tdel[0]) \
+        / (tdel[1] - tdel[0])
+    fpos = (np.asarray(fdop_q, dtype=float) - fdop[0]) \
+        / (fdop[1] - fdop[0])
+    return cubic_interp2d(linsspec, tpos, fpos, backend=backend)

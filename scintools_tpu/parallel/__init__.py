@@ -8,7 +8,7 @@ from .mesh import (make_mesh, device_count, DATA_AXIS, SEQ_AXIS,
 from .fft import (make_fft2_sharded, make_gs_sharded,
                   make_sspec_power_sharded)
 from .survey import (make_survey_step, make_eta_search_sharded,
-                     make_arc_profile_sharded,
+                     make_arc_profile_sharded, make_arc_fit_sharded,
                      make_thth_grid_search_sharded,
                      make_thth_thin_grid_search_sharded)
 
@@ -18,7 +18,7 @@ __all__ = [
     "make_fft2_sharded", "make_gs_sharded",
     "make_sspec_power_sharded",
     "make_survey_step", "make_eta_search_sharded",
-    "make_arc_profile_sharded",
+    "make_arc_profile_sharded", "make_arc_fit_sharded",
     "make_thth_grid_search_sharded",
     "make_thth_thin_grid_search_sharded",
 ]

@@ -97,6 +97,34 @@ def make_arc_profile_sharded(mesh, tdel, fdop, delmax=None,
                    out_shardings=sh), ndev
 
 
+def make_arc_fit_sharded(mesh, tdel, fdop, delmax=None, startbin=3,
+                         cutmid=3, numsteps=10000, nsmooth=5,
+                         low_power_diff=-1.0, high_power_diff=-0.5,
+                         constraint=(0.0, float("inf")),
+                         noise_error=True):
+    """Epoch-sharded WHOLE arc fit (profile + savgol + peak walk +
+    parabola, ops/fitarc_device.py) — the survey arc stage as one
+    SPMD program returning ten scalars per epoch. Returns
+    ``(fn, n_devices)``; the caller pads B to a multiple of
+    n_devices."""
+    jax = get_jax()
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops.fitarc_device import make_arc_fit_batch_fn
+
+    fn = make_arc_fit_batch_fn(
+        tdel, fdop, delmax=delmax, startbin=startbin, cutmid=cutmid,
+        numsteps=numsteps, nsmooth=nsmooth,
+        low_power_diff=low_power_diff,
+        high_power_diff=high_power_diff, constraint=constraint,
+        noise_error=noise_error)
+    sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    ndev = int(np.prod(list(mesh.shape.values())))
+    return jax.jit(fn, in_shardings=(sh, sh, sh),
+                   out_shardings=(sh, sh)), ndev
+
+
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
     """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
     the η grid split over every device of the mesh (CS replicated;

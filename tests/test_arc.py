@@ -312,6 +312,146 @@ class TestFitArcBatch:
             fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
                           sspecs_device=jnp.zeros((1, 4, 4)))
 
+    def test_device_vs_host_tail_parity(self, arc_epochs):
+        """The on-device fit tail (savgol + walk-outs + masked
+        parabola, ops/fitarc_device.py) against the f64 host tail on
+        the same profile program output — every ArcFit scalar."""
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        dev = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                            on_device=True)
+        host = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                             on_device=False)
+        for d, h in zip(dev, host):
+            assert d.eta == pytest.approx(h.eta, rel=1e-4)
+            assert d.etaerr == pytest.approx(h.etaerr, rel=1e-3)
+            assert d.etaerr2 == pytest.approx(h.etaerr2, rel=5e-2)
+            assert d.noise == pytest.approx(h.noise, rel=1e-4)
+            np.testing.assert_allclose(d.profile, h.profile,
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(d.eta_array, h.eta_array,
+                                       rtol=1e-10)
+            # fit_parabola diagnostics rebuilt from packed columns
+            np.testing.assert_allclose(d.xdata, h.xdata, rtol=1e-10)
+            span = np.ptp(h.yfit)
+            np.testing.assert_allclose(d.yfit, h.yfit,
+                                       atol=1e-3 * span)
+
+    def test_device_quarantine_eta_array_matches_host(self,
+                                                      arc_epochs):
+        """Quarantined epochs must return _nan_fit's UNflipped
+        descending eta_array paired with the unflipped profile, on
+        both paths."""
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        kw = dict(numsteps=2000, constraint=(1e9, 1e9 + 1))
+        dev = fit_arc_batch(sspecs, tdel, fdop, on_device=True, **kw)
+        host = fit_arc_batch(sspecs, tdel, fdop, on_device=False,
+                             **kw)
+        for d, h in zip(dev, host):
+            assert np.isnan(d.eta) and np.isnan(h.eta)
+            np.testing.assert_allclose(d.eta_array, h.eta_array,
+                                       rtol=1e-10)
+            np.testing.assert_allclose(d.profile, h.profile,
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_device_savgol_matches_scipy(self):
+        """The fixed-shape masked savgol (interior moving mean + edge
+        linear fits, fitarc_device.make_savgol_interp) against
+        scipy's mode='interp' on random valid prefixes."""
+        import jax.numpy as jnp
+        from scipy.signal import savgol_filter
+
+        from scintools_tpu.ops import fitarc_device as fd
+
+        rng = np.random.default_rng(21)
+        H = 64
+        for w in (5, 7):
+            smooth = fd.make_savgol_interp(w, H)
+            for L in (w + 2, 13, 40, 64):
+                q = rng.standard_normal(H)
+                got = np.asarray(smooth(jnp.asarray(q), L))[:L]
+                want = savgol_filter(q[:L], w, 1)
+                np.testing.assert_allclose(got, want, rtol=1e-5,
+                                           atol=1e-6)
+        assert fd.eta_grid(10)[0].shape == (5,)
+
+    def test_eta_crop_lengths_match_prep_profile(self, arc_epochs):
+        from scintools_tpu.ops.fitarc import (_prep_profile,
+                                              fit_arc_batch)  # noqa
+        from scintools_tpu.ops.fitarc_device import (
+            eta_crop_lengths, eta_grid)
+
+        numsteps = 2000
+        ef2, fdopnew = eta_grid(numsteps)
+        etafrac = np.sqrt(ef2)
+        rng = np.random.default_rng(5)
+        spec = rng.standard_normal(numsteps // 2)
+        for emin, emax in ((2e-5, 3e-3), (1e-4, 0.4), (1e-6, np.inf)):
+            _, eta_s = _prep_profile(np.flip(spec), etafrac, emin,
+                                     emax)
+            L = eta_crop_lengths(numsteps, emin, emax)[0]
+            assert L == len(eta_s)
+
+    def test_full_output_false_skips_diagnostics(self, arc_epochs):
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        lite = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                             full_output=False)
+        full = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000)
+        for lf, ff in zip(lite, full):
+            assert lf.eta == pytest.approx(ff.eta, rel=1e-12)
+            assert lf.profile is None and lf.eta_array is None
+            assert ff.profile is not None
+
+    def test_device_quarantines_empty_constraint(self, arc_epochs):
+        """A constraint window containing no η grid point NaNs that
+        epoch on device, mirroring the host path's caught
+        ValueError."""
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        fits = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                             constraint=(1e9, 1e9 + 1))
+        assert all(np.isnan(f.eta) for f in fits)
+
+    def test_device_quarantines_peak_on_first_point(self, arc_epochs):
+        """constraint admitting ONLY the first η grid point forces
+        ind=0: the host slice eta_array[-1:hi] is empty → ValueError →
+        NaN; the device path must quarantine identically (lo >= 0
+        gate), not report a confident curvature."""
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        # the default etamin of this geometry (fit_arc_batch:330)
+        emin = (tdel[1] - tdel[0]) * 3 / np.max(fdop) ** 2
+        # first grid step is (numsteps/2)/(numsteps/2-1))² ≈ 1.001 —
+        # ±0.05% brackets only ef2[0] = 1
+        kw = dict(numsteps=2000,
+                  constraint=(emin * 0.9995, emin * 1.0005))
+        dev = fit_arc_batch(sspecs, tdel, fdop, on_device=True, **kw)
+        host = fit_arc_batch(sspecs, tdel, fdop, on_device=False,
+                             **kw)
+        for d, h in zip(dev, host):
+            assert np.isnan(h.eta)
+            assert np.isnan(d.eta)
+
+    def test_log_parabola_routes_host(self, arc_epochs):
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        sspecs, tdel, fdop = arc_epochs
+        with pytest.raises(ValueError, match="host-only"):
+            fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                          log_parabola=True, on_device=True)
+        fits = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                             log_parabola=True)
+        ref = fit_arc(sspecs[0], tdel, fdop, numsteps=2000,
+                      log_parabola=True, backend="numpy")[0]
+        assert fits[0].eta == pytest.approx(ref.eta, rel=1e-6)
+
     def test_mesh_sharded_matches_unsharded(self, arc_epochs):
         import jax
 

@@ -84,6 +84,13 @@ def main():
                 # tunnel (observed live 2026-07-31: group 64 OOM'd
                 # and even trivial ops hung afterwards) — if the next
                 # group stalls, restart the sweep without the fat one.
+                # Only swallow genuine runtime/resource failures: a
+                # programming error (bad args, shape bug) must not
+                # masquerade as an OOM-skipped group.
+                runtime_err = "XlaRuntimeError" in type(e).__name__ \
+                    or "RESOURCE_EXHAUSTED" in str(e).upper()
+                if not runtime_err:
+                    raise
                 msg = (str(e).splitlines() or [""])[0][:80]
                 print(f"method={method:6s} group={group:3d}  FAILED "
                       f"({type(e).__name__}: {msg})")
