@@ -286,11 +286,10 @@ class Dynspec:
                 filled = inpaint_biharmonic(self.dyn, nanmask)
                 self.dyn[nanmask] = filled[nanmask]
         elif method == "median":
-            from scipy.signal import medfilt
-            arr = np.array(self.dyn)
-            arr[np.isnan(arr)] = np.mean(arr[is_valid(arr)])
-            med = medfilt(arr, kernel_size=kernel_size)
-            self.dyn[np.isnan(self.dyn)] = med[np.isnan(self.dyn)]
+            from .ops.inpaint import refill_median
+            self.dyn = refill_median(self.dyn,
+                                     kernel_size=kernel_size,
+                                     backend=self.backend)
         elif method in ("linear", "cubic", "nearest") and linear:
             self.dyn = interp_nan_2d(self.dyn, method=method)
         meanval = np.mean(self.dyn[is_valid(self.dyn)])

@@ -63,3 +63,54 @@ def inpaint_biharmonic(image, mask):
                    shape=(n, n)).tocsr()
     out[mask] = spsolve(A, b)
     return out
+
+
+def median_filter_2d(arr, kernel_size=5, backend=None):
+    """2-D median filter with ``scipy.signal.medfilt`` semantics
+    (zero padding, odd square kernel) — the refill 'median' method's
+    smoother (reference dynspec.py:3308-3315), formulated as a
+    fixed-shape neighbourhood sort so it runs on either backend (the
+    jax path is one jitted sort on device instead of the host scipy
+    loop).
+
+    ``kernel_size`` may be an int or an (kf, kt) pair of odd ints.
+    """
+    from ..backend import get_xp, resolve_backend
+
+    backend = resolve_backend(backend)
+    xp = get_xp(backend)
+    if np.isscalar(kernel_size):
+        kf = kt = int(kernel_size)
+    else:
+        kf, kt = (int(k) for k in kernel_size)
+    if kf % 2 == 0 or kt % 2 == 0:
+        raise ValueError("kernel_size must be odd (medfilt semantics)")
+    a = xp.asarray(arr)
+    H, W = np.shape(arr)
+    pf, pt = kf // 2, kt // 2
+    pad = xp.zeros((H + 2 * pf, W + 2 * pt), dtype=a.dtype)
+    if backend == "jax":
+        pad = pad.at[pf:pf + H, pt:pt + W].set(a)
+    else:
+        pad[pf:pf + H, pt:pt + W] = a
+    stack = xp.stack([pad[i:i + H, j:j + W]
+                      for i in range(kf) for j in range(kt)])
+    srt = xp.sort(stack, axis=0)
+    return srt[(kf * kt) // 2]
+
+
+def refill_median(dyn, kernel_size=5, backend=None):
+    """The reference's median refill (dynspec.py:3308-3315): replace
+    NaNs by the kernel median of the mean-filled array."""
+    arr = np.array(dyn, dtype=float)
+    nanmask = np.isnan(arr)
+    if not nanmask.any():
+        return arr
+    # finite-only mean (the façade's is_valid mask): a stray ±inf
+    # pixel must not poison every filled value
+    arr[nanmask] = np.mean(arr[np.isfinite(arr)])
+    med = np.asarray(median_filter_2d(arr, kernel_size,
+                                      backend=backend))
+    out = np.array(dyn, dtype=float)
+    out[nanmask] = med[nanmask]
+    return out

@@ -12,8 +12,9 @@ from .search import (single_search, single_search_thin,
                      multi_chunk_search, multi_chunk_search_thin,
                      fit_eig_peak, chi_par)
 from .retrieval import (single_chunk_retrieval, vlbi_chunk_retrieval,
-                        mosaic, refine_mosaic, gerchberg_saxton,
-                        calc_asymmetry, mask_func, err_string)
+                        vlbi_retrieval_batch, mosaic, refine_mosaic,
+                        gerchberg_saxton, calc_asymmetry, mask_func,
+                        err_string)
 from .plots import plot_func
 
 __all__ = [
@@ -24,7 +25,8 @@ __all__ = [
     "unit_checks", "single_search", "single_search_thin",
     "multi_chunk_search", "multi_chunk_search_thin",
     "make_thin_eval_fn", "fit_eig_peak", "chi_par",
-    "single_chunk_retrieval", "vlbi_chunk_retrieval", "mosaic",
+    "single_chunk_retrieval", "vlbi_chunk_retrieval",
+    "vlbi_retrieval_batch", "mosaic",
     "refine_mosaic", "gerchberg_saxton", "calc_asymmetry", "mask_func",
     "err_string", "plot_func",
 ]

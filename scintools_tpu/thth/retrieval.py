@@ -268,6 +268,212 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
     return retrieval
 
 
+def make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
+                           n_dish, npad=3):
+    """Build the jitted batched VLBI retrieval program
+    ``fn(dspecs_ri[B, P, 2, nf, nt], edges[n_edges], eta, tau_mask) →
+    E_ri[B, n_dish, 2, nf, nt]`` — the whole
+    ``vlbi_chunk_retrieval`` composite pipeline
+    (ththmod.py:1223-1387) as ONE device program per chunk batch,
+    where ``P = n_dish(n_dish+1)/2`` spectra arrive in the
+    reference's ordering [I1, V12, …, V1N, I2, V23, …, IN]. Spectra
+    cross the program boundary as stacked (real, imag) float planes
+    (cross-visibilities are complex; complex buffers cannot cross a
+    program boundary on the tunneled TPU — autos just carry a zero
+    imag plane).
+
+    Same masked fixed-shape reduced-map formulation as
+    :func:`make_chunk_retrieval_fn`; autos get mean-fill padding +
+    hermitian θ-θ symmetrisation, cross-visibilities zero-fill + the
+    raw (non-hermitian) gather. The composite block-hermitian matrix
+    keeps every per-dish block at full masked size — zero rows/cols
+    add null eigenvalues only, so its dominant eigenpair matches the
+    reference's cropped composite.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    times = np.arange(nt_chunk) * dt
+    freqs = np.arange(nf_chunk) * df
+    fd = fft_axis(times, pad=npad, scale=1e3)
+    tau = fft_axis(freqs, pad=npad, scale=1.0)
+    ntau, nfd = len(tau), len(fd)
+    dtau = np.diff(tau).mean()
+    dfd = np.diff(fd).mean()
+    n_th = n_edges - 1
+    P = (n_dish * (n_dish + 1)) // 2
+    # auto-spectrum positions in the pair list (reference formula,
+    # ththmod.py:1249-1251)
+    autos = ((n_dish * (n_dish + 1)) / 2
+             - np.cumsum(np.linspace(1, n_dish, n_dish)))
+    is_auto = np.isin(np.arange(P), autos)
+    tril_mask = jnp.asarray(np.tril(np.ones((n_th, n_th))) > 0)
+    anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
+
+    def retrieval(dspecs_ri, edges, eta, tau_mask):
+        with jax.default_matmul_precision("highest"):
+            return _body(dspecs_ri, edges, eta, tau_mask)
+
+    def _body(dspecs_ri, edges, eta, tau_mask):
+        B = dspecs_ri.shape[0]
+        dspecs = (dspecs_ri[:, :, 0]
+                  + 1j * dspecs_ri[:, :, 1])     # (B, P, nf, nt)
+        # --- pad: mean fill for autos, zero for crosses --------------
+        mu = jnp.mean(dspecs, axis=(2, 3), keepdims=True)
+        fill = jnp.where(jnp.asarray(is_auto)[None, :, None, None],
+                         mu, 0.0)
+        support = jnp.pad(jnp.ones((nf_chunk, nt_chunk)),
+                          ((0, npad * nf_chunk), (0, npad * nt_chunk)))
+        padded = jnp.where(
+            support[None, None] > 0,
+            jnp.pad(dspecs, ((0, 0), (0, 0), (0, npad * nf_chunk),
+                             (0, npad * nt_chunk))),
+            fill)
+        CS = jnp.fft.fftshift(jnp.fft.fft2(padded, axes=(2, 3)),
+                              axes=(2, 3))
+        CS = jnp.where(
+            (jnp.abs(jnp.asarray(tau)) >= tau_mask)[None, None, :,
+                                                    None],
+            CS, 0.0)
+
+        # --- per-pair θ-θ gather (shared geometry) -------------------
+        cents = (edges[1:] + edges[:-1]) / 2
+        cents = cents - cents[jnp.argmin(jnp.abs(cents))]
+        th1 = cents[None, :] * jnp.ones((n_th, 1))
+        th2 = th1.T
+        CS_c = jnp.transpose(CS, (2, 3, 0, 1))   # (ntau, nfd, B, P)
+        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau[0]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = jnp.floor(((th1 - th2) - fd[0] + dfd / 2)
+                           / dfd).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < ntau)
+                & (fd_inv < nfd) & (fd_inv >= -nfd))
+        vals = CS_c[jnp.where(pnts, tau_inv, 0), fd_inv % nfd]
+        thth = jnp.where(pnts[..., None, None], vals, 0.0)
+        thth = thth * (jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
+                       [..., None, None])
+        # hermitian symmetrisation for the autos only
+        # (ththmod.py:109-114; crosses keep the raw gather)
+        sym = jnp.where(tril_mask[..., None, None], 0.0, thth)
+        sym = sym + jnp.conj(jnp.transpose(sym, (1, 0, 2, 3)))
+        sym = jnp.where(anti_eye[..., None, None], 0.0, sym)
+        thth = jnp.where(jnp.asarray(is_auto)[None, None, None, :],
+                         sym, thth)
+        thth = jnp.nan_to_num(thth)
+        valid = ((cents ** 2 * eta < jnp.abs(tau).max())
+                 & (jnp.abs(cents) < jnp.abs(fd).max() / 2))
+        thth = (thth * valid[None, :, None, None]
+                * valid[:, None, None, None])
+        thth = jnp.transpose(thth, (2, 3, 0, 1))  # (B, P, n, n)
+
+        # --- composite block-hermitian matrix (ththmod.py:1352-1366)
+        N = n_dish * n_th
+        comp = jnp.zeros((B, N, N), dtype=CS.dtype)
+        for d1 in range(n_dish):
+            for d2 in range(n_dish - d1):
+                idx = int(((n_dish * (n_dish + 1)) // 2)
+                          - (((n_dish - d1) * (n_dish - d1 + 1)) // 2)
+                          + d2)
+                blk = thth[:, idx]
+                s1 = slice(d1 * n_th, (d1 + 1) * n_th)
+                s2 = slice((d1 + d2) * n_th, (d1 + d2 + 1) * n_th)
+                comp = comp.at[:, s1, s2].set(
+                    jnp.conj(jnp.transpose(blk, (0, 2, 1))))
+                comp = comp.at[:, s2, s1].set(blk)
+
+        # --- dominant eigenpair of the composite ---------------------
+        lam_all, V_all = jnp.linalg.eigh(comp)
+        w = jnp.abs(lam_all[:, -1])
+        V = V_all[:, :, -1]                       # (B, N)
+        V = (V.reshape(B, n_dish, n_th)
+             * valid[None, None, :])              # (B, D, n)
+
+        # --- per-dish wavefield rows at the cropped middle bin -------
+        n_red = jnp.sum(valid)
+        csum = jnp.cumsum(valid)
+        row_hot = (valid & (csum == n_red // 2 + 1)).astype(CS.dtype)
+        ththE = (row_hot[None, None, :, None]
+                 * (jnp.conj(V) * jnp.sqrt(w)[:, None, None])
+                 [:, :, None, :])                 # (B, D, n_row, n_col)
+
+        # --- inverse map (shared scatter geometry, per dish) ---------
+        fd_map = cents[None, :] - cents[:, None]
+        tau_map = eta * (cents[None, :] ** 2 - cents[:, None] ** 2)
+        wgt = ththE / jnp.sqrt(jnp.abs(2 * eta * fd_map.T))[None, None]
+        ix = jnp.floor((fd_map - (fd[0] - dfd / 2)) / dfd).astype(int)
+        iy = jnp.floor((tau_map - (tau[0] - dtau / 2))
+                       / dtau).astype(int)
+        ok = ((ix >= 0) & (ix < nfd) & (iy >= 0) & (iy < ntau)
+              & valid[None, :] & valid[:, None])
+        ix = jnp.where(ok, ix, 0).ravel()
+        iy = jnp.where(ok, iy, 0).ravel()
+        wv = jnp.where(ok[None, None], wgt, 0.0).reshape(B, n_dish, -1)
+        cnt = ok.astype(float).ravel()
+        acc = jnp.zeros((B, n_dish, nfd, ntau), dtype=CS.dtype)
+        acc = acc.at[:, :, ix, iy].add(wv)
+        norm = jnp.zeros((nfd, ntau)).at[ix, iy].add(cnt)
+        recov = jnp.nan_to_num(acc / norm[None, None])
+        recov = jnp.transpose(recov, (0, 1, 3, 2))  # (B, D, ntau, nfd)
+
+        E = jnp.fft.ifft2(jnp.fft.ifftshift(recov, axes=(2, 3)),
+                          axes=(2, 3))[:, :, :nf_chunk, :nt_chunk]
+        E = E * (nf_chunk * nt_chunk / 4)
+        E = jnp.nan_to_num(E)
+        return jnp.stack([E.real, E.imag], axis=2)
+
+    return retrieval
+
+
+def vlbi_retrieval_batch(dspecs, edges, eta, dt, df, n_dish, npad=3,
+                         tau_mask=0.0, mesh=None):
+    """Jitted batched VLBI retrieval: ``dspecs[B, P, nf, nt]``
+    (P = n_dish(n_dish+1)/2 spectra per chunk in the reference
+    ordering) → complex per-dish wavefields ``[B, n_dish, nf, nt]``
+    (host numpy). The device replacement for looping
+    :func:`vlbi_chunk_retrieval` over chunks (ththmod.py:1223-1387);
+    one compile per geometry, η/edges traced.
+
+    ``mesh``: optional — the chunk batch axis shards over every mesh
+    device (zero-padded to a device multiple and cropped after)."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    dspecs = np.asarray(dspecs)          # complex: crosses carry phase
+    B, P, nf_chunk, nt_chunk = dspecs.shape
+    dspecs = np.stack([dspecs.real.astype(float),
+                       dspecs.imag.astype(float)], axis=2)
+    if P != (n_dish * (n_dish + 1)) // 2:
+        raise ValueError(f"expected {(n_dish * (n_dish + 1)) // 2} "
+                         f"spectra per chunk for n_dish={n_dish}, "
+                         f"got {P}")
+    edges = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    eta = float(unit_checks(eta, "eta"))
+    ndev = (int(np.prod(list(mesh.shape.values())))
+            if mesh is not None else 1)
+
+    key = ("vlbi", nf_chunk, nt_chunk, float(dt), float(df),
+           len(edges), int(n_dish), int(npad))
+    fn = keyed_jit_cache(
+        _RETRIEVAL_JIT_CACHE, key,
+        lambda: make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df,
+                                       len(edges), n_dish, npad=npad))
+    pad = (-B) % ndev
+    d_in = np.concatenate([dspecs] + [dspecs[-1:]] * pad) \
+        if pad else dspecs
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as S
+
+        axes = tuple(mesh.shape)
+        d_dev = jax.device_put(
+            d_in, NamedSharding(mesh, S(axes, None, None, None,
+                                        None)))
+    else:
+        d_dev = jnp.asarray(d_in)
+    E_ri = np.asarray(fn(d_dev, jnp.asarray(edges), eta,
+                         float(tau_mask)))[:B]
+    return E_ri[:, :, 0] + 1j * E_ri[:, :, 1]
+
+
 _RETRIEVAL_JIT_CACHE = {}
 
 

@@ -1,0 +1,24 @@
+"""The executable J0437 end-to-end doc (examples/06) as a regression
+test: 8 real epochs through load → sort → crop/refill → acf1d →
+sspec → arc → θ-θ → wavefield, gated on its checked-in expected
+numbers."""
+
+import importlib.util
+import os
+
+import pytest
+
+DATA = "/root/reference/scintools/examples/data/J0437-4715"
+EXAMPLE = os.path.join(os.path.dirname(__file__), "..", "examples",
+                       "06_j0437_end_to_end.py")
+
+pytestmark = pytest.mark.skipif(not os.path.isdir(DATA),
+                                reason="J0437 sample data not mounted")
+
+
+def test_end_to_end_matches_expected():
+    spec = importlib.util.spec_from_file_location("ex06", EXAMPLE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rows, corr = mod.main()
+    mod.check(rows, corr)
