@@ -51,6 +51,22 @@ def single_chunk_retrieval(dspec, edges, time, freq, eta, idx_t=0,
     return model_E, idx_f, idx_t
 
 
+def vlbi_auto_positions(n_dish):
+    """Indices of the auto-spectra in the reference's VLBI pair
+    ordering [I1, V12, …, V1N, I2, V23, …, IN]
+    (ththmod.py:1249-1251). ONE definition for the host and device
+    composite paths."""
+    return ((n_dish * (n_dish + 1)) / 2
+            - np.cumsum(np.linspace(1, n_dish, n_dish)))
+
+
+def vlbi_pair_index(n_dish, d1, d2):
+    """Pair-list index of the (d1, d1+d2) station block in the
+    composite matrix (ththmod.py:1355-1360)."""
+    return int(((n_dish * (n_dish + 1)) // 2)
+               - (((n_dish - d1) * (n_dish - d1 + 1)) // 2) + d2)
+
+
 def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
                          idx_f=0, npad=3, n_dish=2, tau_mask=0.0,
                          verbose=False, backend=None):
@@ -73,8 +89,7 @@ def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
     fd = fft_axis(time, pad=npad, scale=1e3)
     tau = fft_axis(freq, pad=npad, scale=1.0)
 
-    dspec_args = (n_dish * (n_dish + 1)) / 2 - np.cumsum(
-        np.linspace(1, n_dish, n_dish))
+    dspec_args = vlbi_auto_positions(n_dish)
     from .search import pad_chunk
 
     thth_red = []
@@ -95,8 +110,7 @@ def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
     comp = np.zeros((size * n_dish, size * n_dish), dtype=complex)
     for d1 in range(n_dish):
         for d2 in range(n_dish - d1):
-            idx = int(((n_dish * (n_dish + 1)) // 2)
-                      - (((n_dish - d1) * (n_dish - d1 + 1)) // 2) + d2)
+            idx = vlbi_pair_index(n_dish, d1, d2)
             comp[d1 * size:(d1 + 1) * size,
                  (d1 + d2) * size:(d1 + d2 + 1) * size] = \
                 np.conj(thth_red[idx].T)
@@ -122,6 +136,78 @@ def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
 # --------------------------------------------------------------------------
 # Jitted batched retrieval (TPU path)
 # --------------------------------------------------------------------------
+#
+# The load-bearing index conventions (tau_inv > 0 boundary, fd_inv %
+# nfd wrap, csum == n_red//2 + 1 row selection, valid-only scatter
+# counts, nf·nt/4 scaling) live ONCE in the helpers below; the
+# single-dish and VLBI programs only compose them.
+
+
+def _thth_gather(CS_c, cents, eta, tau, fd, dtau, dfd, ntau, nfd,
+                 jnp):
+    """Raw weighted θ-θ gather (ththmod.py:56-106) with the θ axes
+    leading and any batch axes trailing: ``CS_c[ntau, nfd, ...] →
+    thth[n_th, n_th, ...]`` (no symmetrisation)."""
+    n_th = cents.shape[0]
+    th1 = cents[None, :] * jnp.ones((n_th, 1))
+    th2 = th1.T
+    tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau[0]
+                         + dtau / 2) / dtau).astype(int)
+    fd_inv = jnp.floor(((th1 - th2) - fd[0] + dfd / 2)
+                       / dfd).astype(int)
+    pnts = ((tau_inv > 0) & (tau_inv < ntau)
+            & (fd_inv < nfd) & (fd_inv >= -nfd))
+    vals = CS_c[jnp.where(pnts, tau_inv, 0), fd_inv % nfd]
+    extra = (1,) * (CS_c.ndim - 2)
+    thth = jnp.where(pnts.reshape(pnts.shape + extra), vals, 0.0)
+    return thth * (jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
+                   .reshape((n_th, n_th) + extra))
+
+
+def _hermitian_sym(thth, tril_mask, anti_eye, jnp):
+    """Hermitian θ-θ symmetrisation (ththmod.py:109-114) over the two
+    leading θ axes; batch axes trail."""
+    extra = (1,) * (thth.ndim - 2)
+    tl = tril_mask.reshape(tril_mask.shape + extra)
+    ae = anti_eye.reshape(anti_eye.shape + extra)
+    sym = jnp.where(tl, 0.0, thth)
+    sym = sym + jnp.conj(jnp.swapaxes(sym, 0, 1))
+    return jnp.where(ae, 0.0, sym)
+
+
+def _row_hot(valid, dtype, jnp):
+    """One-hot of the cropped path's middle θ bin: index ``n_red//2``
+    of the valid set (ththmod.py:1445-1449), located via the running
+    valid count."""
+    n_red = jnp.sum(valid)
+    csum = jnp.cumsum(valid)
+    return (valid & (csum == n_red // 2 + 1)).astype(dtype)
+
+
+def _scatter_inverse(ththE, cents, eta, valid, tau, fd, dtau, dfd,
+                     ntau, nfd, jnp):
+    """Inverse map: weighted scatter with valid×valid bin counts —
+    the cropped ``rev_map`` (ththmod.py:176-271, hermetian=False) on
+    masked fixed shapes. ``ththE[K, n_th, n_th] → recov[K, ntau,
+    nfd]`` (flatten any extra leading axes into K first)."""
+    K = ththE.shape[0]
+    fd_map = cents[None, :] - cents[:, None]
+    tau_map = eta * (cents[None, :] ** 2 - cents[:, None] ** 2)
+    wgt = ththE / jnp.sqrt(jnp.abs(2 * eta * fd_map.T))[None]
+    ix = jnp.floor((fd_map - (fd[0] - dfd / 2)) / dfd).astype(int)
+    iy = jnp.floor((tau_map - (tau[0] - dtau / 2)) / dtau).astype(int)
+    ok = ((ix >= 0) & (ix < nfd) & (iy >= 0) & (iy < ntau)
+          & valid[None, :] & valid[:, None])
+    ix = jnp.where(ok, ix, 0).ravel()
+    iy = jnp.where(ok, iy, 0).ravel()
+    wv = jnp.where(ok[None], wgt, 0.0).reshape(K, -1)
+    cnt = ok.astype(float).ravel()
+    acc = jnp.zeros((K, nfd, ntau), dtype=ththE.dtype)
+    acc = acc.at[:, ix, iy].add(wv)
+    norm = jnp.zeros((nfd, ntau)).at[ix, iy].add(cnt)
+    recov = jnp.nan_to_num(acc / norm[None])
+    return jnp.transpose(recov, (0, 2, 1))      # (K, ntau, nfd)
+
 
 def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
                             npad=3, method="eigh", iters=1024):
@@ -172,7 +258,6 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
             return _retrieval_body(chunks, edges, eta, tau_mask)
 
     def _retrieval_body(chunks, edges, eta, tau_mask):
-        B = chunks.shape[0]
         # --- pad (mean fill) → conjugate spectra (ththmod.py:777-786)
         mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)
         support = jnp.pad(jnp.ones((nf_chunk, nt_chunk)),
@@ -190,23 +275,10 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
         # --- θ-θ build, chunk-minor gather (shared η across the row)
         cents = (edges[1:] + edges[:-1]) / 2
         cents = cents - cents[jnp.argmin(jnp.abs(cents))]
-        th1 = cents[None, :] * jnp.ones((n_th, 1))
-        th2 = th1.T
         CS_c = jnp.transpose(CS, (1, 2, 0))          # (ntau, nfd, B)
-        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau[0]
-                             + dtau / 2) / dtau).astype(int)
-        fd_inv = jnp.floor(((th1 - th2) - fd[0] + dfd / 2)
-                           / dfd).astype(int)
-        pnts = ((tau_inv > 0) & (tau_inv < ntau)
-                & (fd_inv < nfd) & (fd_inv >= -nfd))
-        vals = CS_c[jnp.where(pnts, tau_inv, 0), fd_inv % nfd, :]
-        thth = jnp.where(pnts[..., None], vals, 0.0)
-        thth = thth * (jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
-                       [..., None])
-        # hermitian symmetrisation (ththmod.py:109-114)
-        thth = jnp.where(tril_mask[..., None], 0.0, thth)
-        thth = thth + jnp.conj(jnp.transpose(thth, (1, 0, 2)))
-        thth = jnp.where(anti_eye[..., None], 0.0, thth)
+        thth = _thth_gather(CS_c, cents, eta, tau, fd, dtau, dfd,
+                            ntau, nfd, jnp)
+        thth = _hermitian_sym(thth, tril_mask, anti_eye, jnp)
         thth = jnp.nan_to_num(thth)
         # reduced-map valid square (ththmod.py:151-155), as a mask
         valid = ((cents ** 2 * eta < jnp.abs(tau).max())
@@ -232,31 +304,14 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
         V = V * valid[None, :]
 
         # --- wavefield row at the cropped path's middle bin ----------
-        n_red = jnp.sum(valid)
-        csum = jnp.cumsum(valid)
-        row_hot = (valid & (csum == n_red // 2 + 1)).astype(CS.dtype)
+        row_hot = _row_hot(valid, CS.dtype, jnp)
         ththE = (row_hot[:, None]
                  * (jnp.conj(V) * jnp.sqrt(w)[:, None])[:, None, :])
         # (B, n_row, n_col)
 
-        # --- inverse map: weighted scatter, valid×valid counts only
-        # (ththmod.py:176-271 with hermetian=False)
-        fd_map = cents[None, :] - cents[:, None]
-        tau_map = eta * (cents[None, :] ** 2 - cents[:, None] ** 2)
-        wgt = ththE / jnp.sqrt(jnp.abs(2 * eta * fd_map.T))[None]
-        ix = jnp.floor((fd_map - (fd[0] - dfd / 2)) / dfd).astype(int)
-        iy = jnp.floor((tau_map - (tau[0] - dtau / 2)) / dtau).astype(int)
-        ok = ((ix >= 0) & (ix < nfd) & (iy >= 0) & (iy < ntau)
-              & valid[None, :] & valid[:, None])
-        ix = jnp.where(ok, ix, 0).ravel()
-        iy = jnp.where(ok, iy, 0).ravel()
-        wv = jnp.where(ok[None], wgt, 0.0).reshape(B, -1)
-        cnt = ok.astype(float).ravel()
-        acc = jnp.zeros((B, nfd, ntau), dtype=CS.dtype)
-        acc = acc.at[:, ix, iy].add(wv)
-        norm = jnp.zeros((nfd, ntau)).at[ix, iy].add(cnt)
-        recov = jnp.nan_to_num(acc / norm[None])
-        recov = jnp.transpose(recov, (0, 2, 1))      # (B, ntau, nfd)
+        # --- inverse map (shared masked rev_map scatter) -------------
+        recov = _scatter_inverse(ththE, cents, eta, valid, tau, fd,
+                                 dtau, dfd, ntau, nfd, jnp)
 
         # --- wavefield chunk (ththmod.py:1462-1468) ------------------
         E = jnp.fft.ifft2(jnp.fft.ifftshift(recov, axes=(1, 2)),
@@ -302,11 +357,7 @@ def make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
     dfd = np.diff(fd).mean()
     n_th = n_edges - 1
     P = (n_dish * (n_dish + 1)) // 2
-    # auto-spectrum positions in the pair list (reference formula,
-    # ththmod.py:1249-1251)
-    autos = ((n_dish * (n_dish + 1)) / 2
-             - np.cumsum(np.linspace(1, n_dish, n_dish)))
-    is_auto = np.isin(np.arange(P), autos)
+    is_auto = np.isin(np.arange(P), vlbi_auto_positions(n_dish))
     tril_mask = jnp.asarray(np.tril(np.ones((n_th, n_th))) > 0)
     anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
 
@@ -336,27 +387,15 @@ def make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
                                                     None],
             CS, 0.0)
 
-        # --- per-pair θ-θ gather (shared geometry) -------------------
+        # --- per-pair θ-θ gather (shared geometry helpers) -----------
         cents = (edges[1:] + edges[:-1]) / 2
         cents = cents - cents[jnp.argmin(jnp.abs(cents))]
-        th1 = cents[None, :] * jnp.ones((n_th, 1))
-        th2 = th1.T
         CS_c = jnp.transpose(CS, (2, 3, 0, 1))   # (ntau, nfd, B, P)
-        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau[0]
-                             + dtau / 2) / dtau).astype(int)
-        fd_inv = jnp.floor(((th1 - th2) - fd[0] + dfd / 2)
-                           / dfd).astype(int)
-        pnts = ((tau_inv > 0) & (tau_inv < ntau)
-                & (fd_inv < nfd) & (fd_inv >= -nfd))
-        vals = CS_c[jnp.where(pnts, tau_inv, 0), fd_inv % nfd]
-        thth = jnp.where(pnts[..., None, None], vals, 0.0)
-        thth = thth * (jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
-                       [..., None, None])
-        # hermitian symmetrisation for the autos only
-        # (ththmod.py:109-114; crosses keep the raw gather)
-        sym = jnp.where(tril_mask[..., None, None], 0.0, thth)
-        sym = sym + jnp.conj(jnp.transpose(sym, (1, 0, 2, 3)))
-        sym = jnp.where(anti_eye[..., None, None], 0.0, sym)
+        thth = _thth_gather(CS_c, cents, eta, tau, fd, dtau, dfd,
+                            ntau, nfd, jnp)
+        # hermitian symmetrisation for the autos only (crosses keep
+        # the raw gather)
+        sym = _hermitian_sym(thth, tril_mask, anti_eye, jnp)
         thth = jnp.where(jnp.asarray(is_auto)[None, None, None, :],
                          sym, thth)
         thth = jnp.nan_to_num(thth)
@@ -371,9 +410,7 @@ def make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
         comp = jnp.zeros((B, N, N), dtype=CS.dtype)
         for d1 in range(n_dish):
             for d2 in range(n_dish - d1):
-                idx = int(((n_dish * (n_dish + 1)) // 2)
-                          - (((n_dish - d1) * (n_dish - d1 + 1)) // 2)
-                          + d2)
+                idx = vlbi_pair_index(n_dish, d1, d2)
                 blk = thth[:, idx]
                 s1 = slice(d1 * n_th, (d1 + 1) * n_th)
                 s2 = slice((d1 + d2) * n_th, (d1 + d2 + 1) * n_th)
@@ -389,31 +426,17 @@ def make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
              * valid[None, None, :])              # (B, D, n)
 
         # --- per-dish wavefield rows at the cropped middle bin -------
-        n_red = jnp.sum(valid)
-        csum = jnp.cumsum(valid)
-        row_hot = (valid & (csum == n_red // 2 + 1)).astype(CS.dtype)
+        row_hot = _row_hot(valid, CS.dtype, jnp)
         ththE = (row_hot[None, None, :, None]
                  * (jnp.conj(V) * jnp.sqrt(w)[:, None, None])
                  [:, :, None, :])                 # (B, D, n_row, n_col)
 
-        # --- inverse map (shared scatter geometry, per dish) ---------
-        fd_map = cents[None, :] - cents[:, None]
-        tau_map = eta * (cents[None, :] ** 2 - cents[:, None] ** 2)
-        wgt = ththE / jnp.sqrt(jnp.abs(2 * eta * fd_map.T))[None, None]
-        ix = jnp.floor((fd_map - (fd[0] - dfd / 2)) / dfd).astype(int)
-        iy = jnp.floor((tau_map - (tau[0] - dtau / 2))
-                       / dtau).astype(int)
-        ok = ((ix >= 0) & (ix < nfd) & (iy >= 0) & (iy < ntau)
-              & valid[None, :] & valid[:, None])
-        ix = jnp.where(ok, ix, 0).ravel()
-        iy = jnp.where(ok, iy, 0).ravel()
-        wv = jnp.where(ok[None, None], wgt, 0.0).reshape(B, n_dish, -1)
-        cnt = ok.astype(float).ravel()
-        acc = jnp.zeros((B, n_dish, nfd, ntau), dtype=CS.dtype)
-        acc = acc.at[:, :, ix, iy].add(wv)
-        norm = jnp.zeros((nfd, ntau)).at[ix, iy].add(cnt)
-        recov = jnp.nan_to_num(acc / norm[None, None])
-        recov = jnp.transpose(recov, (0, 1, 3, 2))  # (B, D, ntau, nfd)
+        # --- inverse map (shared masked rev_map scatter, dish axis
+        # folded into the batch) --------------------------------------
+        recov = _scatter_inverse(
+            ththE.reshape(B * n_dish, n_th, n_th), cents, eta, valid,
+            tau, fd, dtau, dfd, ntau, nfd, jnp)
+        recov = recov.reshape(B, n_dish, ntau, nfd)
 
         E = jnp.fft.ifft2(jnp.fft.ifftshift(recov, axes=(2, 3)),
                           axes=(2, 3))[:, :, :nf_chunk, :nt_chunk]
