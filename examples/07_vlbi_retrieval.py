@@ -1,0 +1,106 @@
+"""Multi-station (VLBI) phase retrieval: per-dish wavefields from the
+composite θ-θ eigenproblem (reference ththmod.py:1223-1387).
+
+Two stations observe the same 1-D screen; each image picks up a
+station-dependent phase (a geometric baseline shift). The composite
+block-hermitian θ-θ built from [I1, V12, I2] (autos + the complex
+cross-visibility) yields BOTH per-dish wavefields from one dominant
+eigenvector — here run two ways:
+
+- the host composite path (``thth.vlbi_chunk_retrieval``, the numpy
+  oracle), and
+- the batched device program (``thth.vlbi_retrieval_batch``) — the
+  whole pipeline (pad → FFT → per-pair θ-θ → composite eigh →
+  per-dish inverse maps) as ONE jitted program over a chunk batch,
+  shardable over a device mesh.
+
+Run:  python examples/07_vlbi_retrieval.py               (~10 s CPU)
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    # honor a CPU pin reliably — the env var alone cannot stop an
+    # already-registered accelerator plugin from initialising (see
+    # force_cpu_platform's docstring)
+    from scintools_tpu.backend import force_cpu_platform
+
+    force_cpu_platform()
+
+ETA = 0.12                # s^3 curvature of the synthetic screen
+NT = NF = 64
+DT, DF, F0 = 30.0, 0.2, 1400.0
+
+
+def make_two_dish_wavefields(seed=4, baseline_slope=0.02):
+    """One screen, two stations: per-image phases differ by a linear
+    gradient in image index (the geometric delay of a baseline)."""
+    rng = np.random.default_rng(seed)
+    times = np.arange(NT) * DT
+    freqs = F0 + np.arange(NF) * DF
+    dfd_pad = 1e3 / (2 * NT * DT)            # padded-CS pixel, mHz
+    fd_k = np.arange(-10, 11) * dfd_pad
+    tau_k = ETA * fd_k ** 2
+    amps = ((0.05 + 0.3 * rng.random(len(fd_k)))
+            * np.exp(2j * np.pi * rng.random(len(fd_k))))
+    amps[len(fd_k) // 2] = 3.0               # unscattered image
+    psi2 = np.exp(2j * np.pi * baseline_slope * np.arange(len(fd_k)))
+    F, T = np.meshgrid(freqs - F0, times, indexing="ij")
+    E1 = np.zeros((NF, NT), dtype=complex)
+    E2 = np.zeros((NF, NT), dtype=complex)
+    for k, (a, td, fdk) in enumerate(zip(amps, tau_k, fd_k)):
+        ph = np.exp(2j * np.pi * (td * F + fdk * 1e-3 * T))
+        E1 += a * ph
+        E2 += a * psi2[k] * ph
+    edges = np.arange(-20.5, 21.5) * dfd_pad
+    return E1, E2, times, freqs, edges
+
+
+def corr(a, b):
+    return (np.abs(np.vdot(a, b))
+            / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-30))
+
+
+def main():
+    from scintools_tpu.thth import (vlbi_chunk_retrieval,
+                                    vlbi_retrieval_batch)
+
+    E1, E2, times, freqs, edges = make_two_dish_wavefields()
+    I1, I2 = np.abs(E1) ** 2, np.abs(E2) ** 2
+    V12 = E1 * np.conj(E2)
+
+    # host composite (the numpy oracle)
+    host_E, _, _ = vlbi_chunk_retrieval([I1, V12, I2], edges, times,
+                                        freqs, ETA, npad=1, n_dish=2,
+                                        backend="numpy")
+    # batched device program (B=4 identical chunks to show batching)
+    batch = np.stack([np.stack([I1, V12, I2])] * 4)
+    dev_E = vlbi_retrieval_batch(batch, edges, ETA, DT, DF, n_dish=2,
+                                 npad=1)
+
+    truth = [E1, E2]
+    print("dish  host-vs-truth  device-vs-truth  host-vs-device")
+    for d in range(2):
+        ct = corr(host_E[d], truth[d])
+        cd = corr(dev_E[0, d], truth[d])
+        ch = corr(host_E[d], dev_E[0, d])
+        print(f"  {d + 1}        {ct:.3f}           {cd:.3f}"
+              f"            {ch:.3f}")
+        assert ch > 0.99, "device path must match the host composite"
+        assert cd > 0.5, "retrieval must correlate with the truth"
+    # the station-2 wavefield must NOT be a copy of station 1's —
+    # the baseline phase separates them
+    c12 = corr(dev_E[0, 0] * np.conj(dev_E[0, 1]), E1 * np.conj(E2))
+    print(f"recovered vs true interferometric phase pattern: "
+          f"{c12:.3f}")
+    assert c12 > 0.5
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

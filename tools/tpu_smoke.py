@@ -111,7 +111,72 @@ def main():
     print(f"gerchberg_saxton cross-backend (phase-aligned): rel L2 "
           f"{rel_gs:.3e}")
     assert rel_gs < 5e-2, "GS wavefield diverges across backends"
+    smoke_round5_device_paths(ds_n)
     print("TPU smoke OK")
+
+
+def smoke_round5_device_paths(ds_n):
+    """Round-5 device programs on the real chip: the whole-fit survey
+    arc program (ops/fitarc_device.py — savgol/walk-out/parabola as
+    device math), the scattered-image cubic gather (ops/scatim.py),
+    and the batched VLBI composite retrieval. Each is gated against
+    its f64 host oracle on the SAME data."""
+    from scintools_tpu.ops.fitarc import fit_arc_batch
+    from scintools_tpu.ops.scatim import scattered_image_interp
+    from scintools_tpu.thth.retrieval import (vlbi_chunk_retrieval,
+                                              vlbi_retrieval_batch)
+
+    # --- survey arc fit: J0437 sspec, device vs host tail ------------
+    ds_n.calc_sspec(prewhite=False, lamsteps=False, window="hanning",
+                    window_frac=0.1)
+    sspecs = np.stack([np.asarray(ds_n.sspec, float)] * 2)
+    tdel = np.asarray(ds_n.tdel)
+    fdop = np.asarray(ds_n.fdop)
+    dev = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                        on_device=True)[0]
+    host = fit_arc_batch(sspecs, tdel, fdop, numsteps=2000,
+                         on_device=False)[0]
+    rel_arc = abs(dev.eta - host.eta) / abs(host.eta)
+    print(f"device arc fit: eta={dev.eta:.5g} vs host {host.eta:.5g} "
+          f"(rel {rel_arc:.2e})")
+    assert rel_arc < 1e-3, "device arc-fit tail diverges from host"
+
+    # --- scattered image: device gather vs host gather ---------------
+    lin = 10 ** (sspecs[0] / 10)
+    ny, nx = 33, 65
+    fx = np.linspace(-fdop.max(), fdop.max(), nx)
+    fy = np.linspace(0, fdop.max(), ny)
+    FX, FY = np.meshgrid(fx, fy)
+    eta_si = float(tdel[-1] / fdop.max() ** 2)
+    tq = (FX ** 2 + FY ** 2) * eta_si
+    im_j = np.asarray(scattered_image_interp(lin, tdel, fdop, tq, FX,
+                                             backend="jax"))
+    im_n = scattered_image_interp(lin, tdel, fdop, tq, FX,
+                                  backend="numpy")
+    scale = np.abs(im_n).max()
+    rel_si = float(np.max(np.abs(im_j - im_n)) / scale)
+    print(f"scattered image: device vs host max rel {rel_si:.2e}")
+    assert rel_si < 1e-3, "scattered-image gather diverges"
+
+    # --- VLBI composite: batched device vs host ----------------------
+    dyn = np.asarray(ds_n.dyn, float)[:64, :64]
+    times = np.asarray(ds_n.times)[:64]
+    freqs = np.asarray(ds_n.freqs)[:64]
+    dfd_pad = 1e3 / (2 * 64 * (times[1] - times[0]))
+    edges = np.arange(-16.5, 17.5) * dfd_pad
+    eta_v = float(ds_n.ththeta)
+    host_E, _, _ = vlbi_chunk_retrieval([dyn, dyn + 0j, dyn], edges,
+                                        times, freqs, eta_v, npad=1,
+                                        n_dish=2, backend="numpy")
+    dev_E = vlbi_retrieval_batch(
+        np.stack([np.stack([dyn, dyn + 0j, dyn])]), edges, eta_v,
+        float(times[1] - times[0]), float(freqs[1] - freqs[0]),
+        n_dish=2, npad=1)
+    c = abs(np.vdot(host_E[0], dev_E[0, 0])) / (
+        np.linalg.norm(host_E[0]) * np.linalg.norm(dev_E[0, 0])
+        + 1e-30)
+    print(f"vlbi composite: device-vs-host correlation {c:.6f}")
+    assert c > 0.99, "VLBI batched retrieval diverges from host"
 
 
 if __name__ == "__main__":
