@@ -452,6 +452,61 @@ class TestFitArcBatch:
                       log_parabola=True, backend="numpy")[0]
         assert fits[0].eta == pytest.approx(ref.eta, rel=1e-6)
 
+    @pytest.mark.parametrize("seed", [101, 202, 303, 404])
+    def test_device_vs_host_randomized_geometry(self, seed):
+        """Fuzz the device fit tail against the f64 host oracle over
+        random geometries and fit parameters — the walk-out/crop/
+        savgol index quirks must agree everywhere, not just on the
+        fixture geometry."""
+        import sys
+        sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+        from bench import make_arc_dynspec
+        from scintools_tpu.dynspec import BasicDyn, Dynspec
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        rng = np.random.default_rng(seed)
+        nt = int(rng.choice([64, 96, 128]))
+        nf = int(rng.choice([64, 128]))
+        dt = float(rng.uniform(1.0, 4.0))
+        df = float(rng.uniform(0.03, 0.08))
+        eta_true = float(rng.uniform(2e-4, 1e-3))
+        numsteps = int(rng.choice([800, 1500, 2602]))
+        nsmooth = int(rng.choice([5, 7]))
+        cutmid = int(rng.choice([0, 3, 5]))
+        startbin = int(rng.choice([1, 3]))
+        noise_error = bool(rng.choice([True, False]))
+        B = 3
+        sspecs, tdel, fdop = [], None, None
+        for b in range(B):
+            dyn = make_arc_dynspec(nt, nf, dt, df, 1400.0, eta_true,
+                                   n_images=24, seed=seed + b)
+            bd = BasicDyn(dyn, name=f"f{b}",
+                          times=np.arange(nt) * dt,
+                          freqs=1400.0 + np.arange(nf) * df,
+                          dt=dt, df=df)
+            ds = Dynspec(dyn=bd, process=False, verbose=False,
+                         backend="numpy")
+            ds.calc_sspec(prewhite=False, lamsteps=False,
+                          window="hanning", window_frac=0.1)
+            sspecs.append(np.asarray(ds.sspec, float))
+            tdel, fdop = np.asarray(ds.tdel), np.asarray(ds.fdop)
+        kw = dict(numsteps=numsteps, nsmooth=nsmooth, cutmid=cutmid,
+                  startbin=startbin, noise_error=noise_error)
+        if cutmid == 0:
+            # the shared reference default etamax divides by cutmid
+            # (dynspec.py:1140 quirk) — give the fuzz a real bound
+            kw["etamax"] = float(tdel[-1] / (fdop[1] - fdop[0]) ** 2)
+        dev = fit_arc_batch(np.stack(sspecs), tdel, fdop,
+                            on_device=True, **kw)
+        host = fit_arc_batch(np.stack(sspecs), tdel, fdop,
+                             on_device=False, **kw)
+        for d, h in zip(dev, host):
+            assert np.isnan(d.eta) == np.isnan(h.eta)
+            if np.isfinite(h.eta):
+                assert d.eta == pytest.approx(h.eta, rel=1e-3)
+                assert d.etaerr == pytest.approx(h.etaerr, rel=1e-2)
+                assert d.noise == pytest.approx(h.noise, rel=1e-3)
+
     def test_mesh_sharded_matches_unsharded(self, arc_epochs):
         import jax
 
