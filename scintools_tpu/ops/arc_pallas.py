@@ -1,0 +1,141 @@
+"""Pallas TPU kernel: the arc-normalised profile without HBM tents.
+
+The XLA arc-profile program (ops/normsspec.py:make_arc_profile_batch_fn)
+formulates each delay row's linear interpolation as a tent-kernel
+matmul. That rides the MXU, but XLA materialises every (numsteps, nc)
+tent slab in HBM — for a 128-epoch survey batch at numsteps=2000 that
+is ~16 GB of HBM traffic for ~16 GFLOP of work: bandwidth-bound, and
+the dominant cost of the whole survey arc fit on chip.
+
+This kernel keeps the tent entirely in VMEM:
+
+- grid over (epoch, delay row); each program loads ONE masked sspec
+  row (a few KB), builds its tent tile in VMEM, contracts value and
+  NaN-weight in one 2-row matmul, and accumulates the masked
+  row-mean numerator/denominator in VMEM scratch;
+- the profile leaves the kernel once per epoch (the last row writes
+  num/den), so HBM traffic is rows-in + profiles-out (~tens of MB
+  per batch instead of ~16 GB).
+
+Semantics are pinned to the XLA path bit-for-bit-modulo-f32: same
+clipped index arithmetic, endpoint clamping, local NaN poisoning via
+the tent-weighted bad mask, support mask on the UNclipped query, and
+0.0 fill for fully-masked bins (tests/test_arc_pallas.py).
+
+Opt-in: ``SCINTOOLS_ARC_PALLAS=1`` (or ``pallas=True`` to
+``make_arc_profile_batch_fn``); ``interpret=True`` runs on CPU for
+tests. The q axis is padded to a lane multiple with far-out queries
+whose support mask is always False.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_PAD_Q = 1e30          # padded-query sentinel: |xq| > fmax always
+
+
+def pad_to_multiple(n, m=128):
+    return int(-(-n // m) * m)
+
+
+def arc_profile_pallas_enabled():
+    """True when the opt-in env knob asks for the Pallas profile
+    kernel (the caller still checks the backend can run Mosaic)."""
+    return os.environ.get("SCINTOOLS_ARC_PALLAS", "") == "1"
+
+
+def make_arc_profile_pallas_fn(tdel_c, fdop, fdopnew, interpret=False):
+    """Build ``fn(s_masked[B, R, ncp], good[B, R, ncp], scales[B, R])
+    → profiles[B, Qp]`` where ``scales[b, r] = sqrt(tdel_c[r]/eta_b)``
+    and ncp/Qp are the 128-padded column/query counts. The caller
+    pre-masks NaNs (s_masked has 0 where NaN, ``good`` carries the
+    finite mask) and crops the output to the true query count."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tdel_c = np.asarray(tdel_c, dtype=float)
+    fdop = np.asarray(fdop, dtype=float)
+    fdopnew = np.asarray(fdopnew, dtype=float)
+    R = len(tdel_c)
+    nc = len(fdop)
+    ncp = pad_to_multiple(nc)
+    Q = len(fdopnew)
+    Qp = pad_to_multiple(Q)
+    f0 = float(fdop[0])
+    dfd = float(np.mean(np.diff(fdop)))
+    fmax = float(np.max(np.abs(fdop)))
+    fq_pad = np.full(Qp, _PAD_Q)
+    fq_pad[:Q] = fdopnew
+
+    def kernel(scale_ref, fq_ref, s_ref, g_ref, out_ref, num_scr,
+               den_scr):
+        r = pl.program_id(1)
+        sc = scale_ref[0, 0]
+        fq = fq_ref[...]                       # (1, Qp)
+        row = s_ref[0]                         # (1, ncp)
+        bad = 1.0 - g_ref[0]
+        xq = fq * sc
+        pos = jnp.clip((xq - f0) / dfd, 0.0, nc - 1.0)
+        # tent built column-major so the contraction is
+        # (2, ncp) @ (ncp, Qp) and everything stays in (row, lane)
+        # orientation — no sublane↔lane transposes for Mosaic
+        k = jax.lax.broadcasted_iota(jnp.float32, (ncp, Qp), 0)
+        tent = jnp.maximum(0.0, 1.0 - jnp.abs(pos - k))
+        lhs = jnp.concatenate([row, bad], axis=0)      # (2, ncp)
+        # precision=HIGHEST: same reason as the XLA tent matmul
+        # (normsspec.py) — default MXU bf16 operand rounding would
+        # eat into the <1% η parity budget
+        out2 = jnp.dot(lhs, tent,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+        val = out2[0:1, :]
+        nanw = out2[1:2, :]
+        ok = ((jnp.abs(xq) <= fmax) & (nanw <= 0.0)) \
+            .astype(jnp.float32)
+
+        @pl.when(r == 0)
+        def _init():
+            num_scr[:] = jnp.zeros_like(num_scr)
+            den_scr[:] = jnp.zeros_like(den_scr)
+
+        num_scr[:] = num_scr[:] + val * ok
+        den_scr[:] = den_scr[:] + ok
+
+        @pl.when(r == R - 1)
+        def _emit():
+            den = den_scr[:]
+            prof = jnp.where(den > 0, num_scr[:] / den, 0.0)
+            out_ref[0] = jnp.broadcast_to(prof, (8, Qp))
+
+    def fn(s_masked, good, scales):
+        B = s_masked.shape[0]
+        out = pl.pallas_call(
+            kernel,
+            grid=(B, R),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda b, r: (b, r),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, Qp), lambda b, r: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, ncp), lambda b, r: (b, r, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, ncp), lambda b, r: (b, r, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 8, Qp), lambda b, r: (b, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B, 8, Qp), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, Qp), jnp.float32),
+                            pltpu.VMEM((1, Qp), jnp.float32)],
+            interpret=interpret,
+        )(scales.astype(jnp.float32),
+          jnp.asarray(fq_pad, jnp.float32)[None, :],
+          s_masked.astype(jnp.float32), good.astype(jnp.float32))
+        return out[:, 0, :Q]
+
+    return fn

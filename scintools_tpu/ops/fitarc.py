@@ -371,9 +371,10 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
     fit_key = (int(nsmooth), float(low_power_diff),
                float(high_power_diff), tuple(map(float, constraint)),
                bool(noise_error)) if on_device else None
+    from .arc_pallas import arc_profile_pallas_enabled
     key = (yaxis.tobytes(), fdop.tobytes(), float(delmax),
            int(startbin), int(cutmid), int(numsteps), mesh_key,
-           fit_key)
+           fit_key, arc_profile_pallas_enabled())
     entry = _ARC_PROFILE_CACHE.get(key)
     if entry is None:
         if len(_ARC_PROFILE_CACHE) >= 8:

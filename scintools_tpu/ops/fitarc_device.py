@@ -104,7 +104,8 @@ def make_savgol_interp(nsmooth, H):
 def make_arc_fit_batch_fn(tdel, fdop, delmax=None, startbin=3, cutmid=3,
                           numsteps=10000, nsmooth=5,
                           low_power_diff=-1.0, high_power_diff=-0.5,
-                          constraint=(0.0, np.inf), noise_error=True):
+                          constraint=(0.0, np.inf), noise_error=True,
+                          pallas=None):
     """Build the jitted whole-fit program.
 
     Returns ``fn(sspecs[B, ntdel, nfdop], etamins[B], Ls[B]) →
@@ -136,7 +137,7 @@ def make_arc_fit_batch_fn(tdel, fdop, delmax=None, startbin=3, cutmid=3,
 
     profile_fn = make_arc_profile_batch_fn(
         tdel, fdop, delmax=delmax, startbin=startbin, cutmid=cutmid,
-        numsteps=numsteps, fold=True)
+        numsteps=numsteps, fold=True, pallas=pallas)
 
     ef2, _ = eta_grid(numsteps)
     c0, c1 = float(constraint[0]), float(constraint[1])

@@ -94,7 +94,7 @@ def scaled_row_interp(sspec, fdop, tdel, eta, fdopnew, backend=None):
 
 def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
                               cutmid=0, numsteps=10000, maxnormfac=1,
-                              fold=False):
+                              fold=False, pallas=None):
     """Batched arc-normalised Doppler profile: ONE jitted program
     computing, for every epoch of a same-geometry survey batch, the
     delay-scrunched normalised profile that ``fit_arc`` peak-fits
@@ -115,6 +115,12 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
     INSIDE the program (fit_arc's folding, dynspec.py:1166-1180) and
     the output is ``[B, numsteps//2]`` over the fdopnew ≥ 0 bins —
     halving the device→host fetch, which matters on a tunneled link.
+
+    ``pallas`` selects the VMEM-resident tent kernel
+    (ops/arc_pallas.py — same semantics, ~1000× less HBM traffic
+    than the XLA tent slabs; uniform Doppler grids only). Default
+    (None): on when ``SCINTOOLS_ARC_PALLAS=1``; runs in interpret
+    mode off-TPU so tests exercise the identical kernel.
     """
     jax = get_jax()
     import jax.numpy as jnp
@@ -208,7 +214,42 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
         # must see the identical profile
         return jnp.where(den > 0, num / den, 0.0)
 
-    base = jax.vmap(one if uniform else one_any_grid)
+    explicit_pallas = pallas is True
+    if pallas is None:
+        from .arc_pallas import arc_profile_pallas_enabled
+
+        pallas = arc_profile_pallas_enabled()
+    if pallas and not uniform:
+        if explicit_pallas:
+            raise ValueError(
+                "pallas=True needs a uniform Doppler grid (the tent "
+                "kernel assumes index arithmetic) — this axis is "
+                "non-uniform")
+        pallas = False               # env knob: quiet XLA fallback
+    if pallas:
+        from .arc_pallas import (make_arc_profile_pallas_fn,
+                                 pad_to_multiple)
+
+        interp = jax.default_backend() != "tpu"
+        kfn = make_arc_profile_pallas_fn(tdel_c, fdop, fdopnew,
+                                         interpret=interp)
+        ncp = pad_to_multiple(nc_src)
+
+        def base(sspecs, etas):
+            s = sspecs[:, startbin:ind, :]
+            if cut_sl is not None:
+                s = s.at[:, :, cut_sl[0]:cut_sl[1]].set(jnp.nan)
+            good = ~jnp.isnan(s)
+            s_m = jnp.where(good, s, 0.0)
+            padc = ncp - nc_src
+            if padc:
+                s_m = jnp.pad(s_m, ((0, 0), (0, 0), (0, padc)))
+                good = jnp.pad(good, ((0, 0), (0, 0), (0, padc)))
+            scales = jnp.sqrt(jnp.asarray(tdel_c)[None, :]
+                              / etas[:, None])
+            return kfn(s_m, good.astype(jnp.float32), scales)
+    else:
+        base = jax.vmap(one if uniform else one_any_grid)
     if not fold:
         return jax.jit(base)
     pos = fdopnew >= 0

@@ -88,9 +88,12 @@ def make_arc_profile_sharded(mesh, tdel, fdop, delmax=None,
 
     from ..ops.normsspec import make_arc_profile_batch_fn
 
+    # pallas=False: no GSPMD partitioning rule for a pallas_call —
+    # sharded programs keep the XLA tent base
     fn = make_arc_profile_batch_fn(tdel, fdop, delmax=delmax,
                                    startbin=startbin, cutmid=cutmid,
-                                   numsteps=numsteps, fold=fold)
+                                   numsteps=numsteps, fold=fold,
+                                   pallas=False)
     sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     ndev = int(np.prod(list(mesh.shape.values())))
     return jax.jit(fn, in_shardings=(sh, sh),
@@ -113,12 +116,15 @@ def make_arc_fit_sharded(mesh, tdel, fdop, delmax=None, startbin=3,
 
     from ..ops.fitarc_device import make_arc_fit_batch_fn
 
+    # pallas=False: a pallas_call has no GSPMD partitioning rule, so
+    # the epoch-sharded program must use the XLA tent base regardless
+    # of the SCINTOOLS_ARC_PALLAS knob
     fn = make_arc_fit_batch_fn(
         tdel, fdop, delmax=delmax, startbin=startbin, cutmid=cutmid,
         numsteps=numsteps, nsmooth=nsmooth,
         low_power_diff=low_power_diff,
         high_power_diff=high_power_diff, constraint=constraint,
-        noise_error=noise_error)
+        noise_error=noise_error, pallas=False)
     sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     ndev = int(np.prod(list(mesh.shape.values())))
     return jax.jit(fn, in_shardings=(sh, sh, sh),

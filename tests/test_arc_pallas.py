@@ -1,0 +1,125 @@
+"""The VMEM-resident arc-profile Pallas kernel (ops/arc_pallas.py)
+against the XLA tent-matmul base — identical semantics (clipping,
+NaN poisoning, support mask, 0.0 fill), radically less HBM traffic.
+Runs in interpret mode on CPU; the real-chip gate is
+tools/tpu_smoke.py."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.ops.normsspec import make_arc_profile_batch_fn
+
+
+def _arc_batch(B=3, ntdel=40, nfdop=96, seed=5):
+    rng = np.random.default_rng(seed)
+    tdel = np.linspace(0.0, 12.0, ntdel)
+    fdop = np.linspace(-30.0, 30.0, nfdop)
+    sspecs = 20.0 + 5.0 * rng.standard_normal((B, ntdel, nfdop))
+    # NaN stripes like real zapped channels
+    sspecs[:, :, nfdop // 2 - 1:nfdop // 2 + 1] = np.nan
+    sspecs[0, 5, 10:14] = np.nan
+    return sspecs, tdel, fdop
+
+
+class TestArcProfilePallas:
+    @pytest.mark.parametrize("fold", [False, True])
+    def test_matches_xla_base(self, fold):
+        sspecs, tdel, fdop = _arc_batch()
+        kw = dict(startbin=2, cutmid=3, numsteps=300, fold=fold)
+        etas = np.array([0.01, 0.02, 0.005])
+        ref = np.asarray(make_arc_profile_batch_fn(
+            tdel, fdop, pallas=False, **kw)(sspecs, etas))
+        got = np.asarray(make_arc_profile_batch_fn(
+            tdel, fdop, pallas=True, **kw)(sspecs, etas))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_matches_xla_base_nonpadded_geometry(self):
+        """Column count already a lane multiple + odd query count."""
+        sspecs, tdel, fdop = _arc_batch(ntdel=24, nfdop=128)
+        kw = dict(startbin=1, cutmid=0, numsteps=130)
+        etas = np.array([0.008, 0.03, 0.015])
+        ref = np.asarray(make_arc_profile_batch_fn(
+            tdel, fdop, pallas=False, **kw)(sspecs, etas))
+        got = np.asarray(make_arc_profile_batch_fn(
+            tdel, fdop, pallas=True, **kw)(sspecs, etas))
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_explicit_pallas_nonuniform_raises(self):
+        sspecs, tdel, fdop = _arc_batch()
+        fdop_nu = fdop * (1 + 0.05 * np.linspace(-1, 1,
+                                                 len(fdop)) ** 2)
+        with pytest.raises(ValueError, match="uniform"):
+            make_arc_profile_batch_fn(tdel, fdop_nu, pallas=True,
+                                      numsteps=200)
+
+    def test_mesh_path_forces_xla_base(self, monkeypatch):
+        """With the env knob set, the epoch-sharded survey arc fit
+        must still compile and run (a pallas_call has no GSPMD
+        partitioning rule — the sharded builders pin pallas=False)."""
+        import jax
+
+        from scintools_tpu import parallel as par
+        from scintools_tpu.ops.fitarc import fit_arc_batch
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device mesh")
+        import sys
+        sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+        from bench import make_arc_dynspec
+        from scintools_tpu.dynspec import BasicDyn, Dynspec
+
+        nt = nf = 128
+        dyn = make_arc_dynspec(nt, nf, 2.0, 0.05, 1400.0, 5e-4,
+                               n_images=32, seed=50)
+        bd = BasicDyn(dyn, name="p", times=np.arange(nt) * 2.0,
+                      freqs=1400.0 + np.arange(nf) * 0.05, dt=2.0,
+                      df=0.05)
+        ds = Dynspec(dyn=bd, process=False, verbose=False,
+                     backend="numpy")
+        ds.calc_sspec(prewhite=False, lamsteps=False,
+                      window="hanning", window_frac=0.1)
+        sspec = np.asarray(ds.sspec, float)
+        tdel, fdop = np.asarray(ds.tdel), np.asarray(ds.fdop)
+        plain = fit_arc_batch(np.stack([sspec] * 2), tdel, fdop,
+                              numsteps=2000)
+        monkeypatch.setenv("SCINTOOLS_ARC_PALLAS", "1")
+        mesh = par.make_mesh(8)
+        sharded = fit_arc_batch(np.stack([sspec] * 2), tdel, fdop,
+                                numsteps=2000, mesh=mesh)
+        assert sharded[0].eta == pytest.approx(plain[0].eta,
+                                               rel=1e-6)
+
+    def test_fit_arc_batch_env_knob(self, monkeypatch):
+        """The env knob routes the whole device arc fit through the
+        kernel and still matches the serial oracle."""
+        import sys
+        sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+        from bench import make_arc_dynspec
+        from scintools_tpu.dynspec import BasicDyn, Dynspec
+        from scintools_tpu.ops.fitarc import fit_arc, fit_arc_batch
+
+        nt = nf = 128
+        dyn = make_arc_dynspec(nt, nf, 2.0, 0.05, 1400.0, 5e-4,
+                               n_images=32, seed=50)
+        bd = BasicDyn(dyn, name="p", times=np.arange(nt) * 2.0,
+                      freqs=1400.0 + np.arange(nf) * 0.05, dt=2.0,
+                      df=0.05)
+        ds = Dynspec(dyn=bd, process=False, verbose=False,
+                     backend="numpy")
+        ds.calc_sspec(prewhite=False, lamsteps=False,
+                      window="hanning", window_frac=0.1)
+        sspec = np.asarray(ds.sspec, float)
+        tdel, fdop = np.asarray(ds.tdel), np.asarray(ds.fdop)
+        plain = fit_arc_batch(np.stack([sspec, sspec]), tdel, fdop,
+                              numsteps=2000)
+        monkeypatch.setenv("SCINTOOLS_ARC_PALLAS", "1")
+        fits = fit_arc_batch(np.stack([sspec, sspec]), tdel, fdop,
+                             numsteps=2000)
+        assert np.isfinite(plain[0].eta), "fixture must fit cleanly"
+        assert fits[0].eta == pytest.approx(plain[0].eta, rel=1e-4)
+        assert fits[0].etaerr == pytest.approx(plain[0].etaerr,
+                                               rel=1e-3)
+        ref = fit_arc(sspec, tdel, fdop, numsteps=2000,
+                      backend="numpy")[0]
+        assert fits[0].eta == pytest.approx(ref.eta, rel=1e-3)

@@ -158,6 +158,43 @@ def smoke_round5_device_paths(ds_n):
     print(f"scattered image: device vs host max rel {rel_si:.2e}")
     assert rel_si < 1e-3, "scattered-image gather diverges"
 
+    # --- OPT-IN arc-profile Pallas kernel (ops/arc_pallas.py):
+    # compile + parity + timing on the real chip, NON-FATAL — this
+    # decides whether SCINTOOLS_ARC_PALLAS=1 becomes the default ----
+    try:
+        import time as _t
+
+        import jax.numpy as jnp
+
+        from scintools_tpu.ops.normsspec import (
+            make_arc_profile_batch_fn)
+
+        kwp = dict(startbin=3, cutmid=3, numsteps=2000, fold=True)
+        etas2 = np.full(2, 2e-5)
+        f_xla = make_arc_profile_batch_fn(tdel, fdop, pallas=False,
+                                          **kwp)
+        f_plk = make_arc_profile_batch_fn(tdel, fdop, pallas=True,
+                                          **kwp)
+        sd = jnp.asarray(sspecs, jnp.float32)
+        ed = jnp.asarray(etas2)
+        a = np.asarray(f_xla(sd, ed))        # compile + run
+        b = np.asarray(f_plk(sd, ed))
+        perr = float(np.max(np.abs(a - b))
+                     / (np.max(np.abs(a)) + 1e-30))
+        t0 = _t.perf_counter()
+        np.asarray(f_xla(sd, ed + 1e-9))
+        t_x = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        np.asarray(f_plk(sd, ed + 1e-9))
+        t_p = _t.perf_counter() - t0
+        print(f"arc-profile pallas kernel: max rel diff {perr:.2e}, "
+              f"xla {t_x:.3f}s vs pallas {t_p:.3f}s "
+              f"[opt-in SCINTOOLS_ARC_PALLAS=1]")
+        assert perr < 1e-4
+    except Exception as e:                   # noqa: BLE001
+        print(f"arc-profile pallas kernel: FAILED ({e}) — leave "
+              "SCINTOOLS_ARC_PALLAS unset")
+
     # --- VLBI composite: batched device vs host ----------------------
     dyn = np.asarray(ds_n.dyn, float)[:64, :64]
     times = np.asarray(ds_n.times)[:64]
