@@ -31,9 +31,10 @@ DATA = "/root/reference/scintools/examples/data/J0437-4715"
 # bit-reproducible oracle) on the checked-in data, 2026-07-31.
 # Gates: numpy backend — strict relative (5% tau/dnu, 10%
 # curvatures); jax backend — tau/dnu additionally allow the fit's
-# own 3·stderr (capped at 50%), since a different optimiser on a
-# barely-constrained real epoch converges inside the reported
-# uncertainty but not to the identical minimum (see check()).
+# own 3·stderr (capped at 50%): the jax backend computes the ACF in
+# f32 on device, and on a barely-constrained real epoch (dnu
+# approaching the band width) the same least-squares then lands
+# inside the reported uncertainty but not on the identical minimum.
 EXPECTED = {
     "n_good": 8,
     # per-epoch (file-ordered): scint timescale [s], bandwidth [MHz],
@@ -110,12 +111,12 @@ def check(rows, corr):
     """Gate every epoch against the checked-in expectations.
 
     The expected values are the NUMPY backend's (bit-reproducible
-    oracle). ``backend='jax'`` runs a different optimiser for the
-    acf1d fit (jitted LM vs scipy least-squares); on real epochs
-    where a parameter is barely constrained (dnu approaching the
-    band width) the two minima legitimately differ by more than a
-    fixed percentage but stay inside the fit's own reported
-    uncertainty — so tau/dnu gate on max(rel tol, 3·stderr).
+    oracle). ``backend='jax'`` computes the ACF in f32 on device;
+    on real epochs where a parameter is barely constrained (dnu
+    approaching the band width) the same least-squares fit on that
+    slightly-different ACF legitimately lands more than a fixed
+    percentage away while staying inside the fit's own reported
+    uncertainty — so tau/dnu gate on max(rel tol, capped 3·stderr).
     """
     jax_backend = os.environ.get("SCINTOOLS_BACKEND") == "jax"
     assert len(rows) == EXPECTED["n_good"], \
