@@ -20,3 +20,18 @@ def test_example_05_asserts_numerically():
     # the recovery section actually ran and printed its comparisons
     assert "tau_d: fit" in text
     assert "dt x3 relabel" in text
+
+
+def test_example_07_vlbi_asserts_numerically():
+    """The two-station VLBI retrieval example must PASS its
+    host-vs-device and truth-correlation asserts (the script pins
+    the CPU platform itself when JAX_PLATFORMS=cpu is set)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(EXAMPLES, "07_vlbi_retrieval.py")],
+        capture_output=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stderr.decode()[-1500:]
+    text = out.stdout.decode()
+    assert "host-vs-device" in text
+    assert text.strip().endswith("ok")
