@@ -79,6 +79,26 @@ class TestCubicInterp2d:
             scattered_image_interp(lin, bad, fdop, np.zeros((2, 2)),
                                    np.zeros((2, 2)), backend="numpy")
 
+    @pytest.mark.parametrize("seed", [31, 57, 83])
+    def test_random_geometry_backend_parity(self, seed):
+        """Random grid shapes/extents and random (partly out-of-grid)
+        queries: numpy and jax paths of the kernel must agree."""
+        rng = np.random.default_rng(seed)
+        nr = int(rng.integers(17, 200))
+        nc = int(rng.integers(17, 200))
+        tdel = np.linspace(0.0, float(rng.uniform(5, 40)), nr)
+        fdop = np.linspace(-float(rng.uniform(10, 50)),
+                           float(rng.uniform(10, 50)), nc)
+        lin = rng.standard_normal((nr, nc))
+        ny, nx = int(rng.integers(3, 40)), int(rng.integers(3, 40))
+        tq = rng.uniform(tdel[0] - 2, tdel[-1] + 2, (ny, nx))
+        fq = rng.uniform(fdop[0] - 2, fdop[-1] + 2, (ny, nx))
+        a = scattered_image_interp(lin, tdel, fdop, tq, fq,
+                                   backend="numpy")
+        b = np.asarray(scattered_image_interp(lin, tdel, fdop, tq,
+                                              fq, backend="jax"))
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-5)
+
     def test_row_slab_matches_direct_16pt(self, smooth_grid):
         """The weight-matmul form against a direct 4x4-neighbourhood
         cubic-convolution sum (independent oracle)."""
