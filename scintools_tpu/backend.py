@@ -80,6 +80,22 @@ def _maybe_enable_compilation_cache(jax):
             pass
 
 
+def compilation_cache_dir():
+    """The active persistent-XLA-compilation-cache directory, or None
+    when disabled (``SCINTOOLS_XLA_CACHE=0`` / jax unavailable /
+    wiring failed). The cache is what lets the geometry-keyed θ-θ
+    search programs (``thth.core.keyed_jit_cache``) survive process
+    restarts: a fresh process pays the retrace but loads the compiled
+    executable from disk instead of recompiling — see
+    docs/performance.md ("Fused search pipeline"). Touching this
+    accessor wires the cache (it loads jax)."""
+    try:
+        jax = get_jax()
+        return jax.config.jax_compilation_cache_dir or None
+    except Exception:
+        return None
+
+
 def set_default_backend(backend):
     """Set the process-wide default backend ('numpy' or 'jax')."""
     global _DEFAULT_BACKEND

@@ -1542,7 +1542,11 @@ class Dynspec:
         grid runs in ONE jitted program with the chunk axis sharded
         over ``mesh`` (reference pool.map: dynspec.py:1715-1719).
         Covers all procs — the thin two-curvature search included
-        (make_thth_thin_grid_search_sharded)."""
+        (make_thth_thin_grid_search_sharded). The single-curvature
+        procs route through the FUSED grid program (raw chunks in,
+        on-device FFT + eigen curve + closed-form peak fit out —
+        parallel/survey.py:make_fused_grid_search_sharded); the thin
+        proc keeps host-precomputed conjugate spectra."""
         import jax.numpy as jnp
 
         from . import parallel as par
@@ -1551,6 +1555,9 @@ class Dynspec:
                                   fit_eig_peak)
 
         thin = self.thetatheta_proc == "thin"
+        if not thin:
+            return self._fit_thetatheta_sharded_fused(
+                mesh, verbose=verbose)
         cs_list, edges_list, etas_list, meta = [], [], [], []
         arclet_list = []
         tau = fd = None
@@ -1642,6 +1649,81 @@ class Dynspec:
         if verbose:
             ok = np.isfinite(self.eta_evo)
             print(f"Sharded chunk grid: {int(ok.sum())}/{B} "
+                  f"chunk fits on {ndev} devices")
+
+    def _fit_thetatheta_sharded_fused(self, mesh, verbose=False):
+        """Fused SPMD chunk grid for the single-curvature procs: the
+        RAW chunk stack is the only host→device transfer — pad, fft2,
+        θ-θ gather, eigen curve and the closed-form parabola peak fit
+        all run inside the one chunk-sharded program
+        (parallel/survey.py:make_fused_grid_search_sharded), replacing
+        the per-chunk host ``chunk_conjugate_spectrum`` FFTs and the
+        per-chunk scipy ``fit_eig_peak`` of the staged sharded path."""
+        import jax.numpy as jnp
+
+        from . import parallel as par
+        from .thth.core import fft_axis
+
+        chunks, edges_list, etas_list, meta = [], [], [], []
+        tau = fd = None
+        for cf in range(self.ncf_fit):
+            for ct in range(self.nct_fit):
+                dspec2, freq2, time2 = self._chunk(cf, ct, fit=True)
+                chunks.append(np.asarray(dspec2, dtype=np.float32))
+                if tau is None:
+                    fd = fft_axis(np.asarray(time2, dtype=float),
+                                  pad=self.npad, scale=1e3)
+                    tau = fft_axis(np.asarray(freq2, dtype=float),
+                                   pad=self.npad, scale=1.0)
+                etas_list.append(
+                    np.logspace(np.log10(self.eta_min),
+                                np.log10(self.eta_max), self.neta)
+                    * (self.fref / freq2.mean()) ** 2)
+                edges_list.append(self.edges
+                                  * (freq2.mean() / self.fref))
+                meta.append((cf, ct, float(freq2.mean()),
+                             float(time2.mean())))
+
+        B = len(chunks)
+        nf_c, nt_c = chunks[0].shape
+        ndev = int(np.prod(list(mesh.shape.values())))
+        pad = (-B) % ndev
+        for _ in range(pad):            # dummy chunks keep B | ndev
+            chunks.append(chunks[0])
+            etas_list.append(etas_list[0])
+            edges_list.append(edges_list[0])
+
+        mesh_key = (tuple(d.id for d in np.ravel(mesh.devices)),
+                    tuple(mesh.axis_names),
+                    tuple(mesh.shape.values()))
+        coher = self.thetatheta_proc != "incoherent"
+        key = ("fused", tau.tobytes(), fd.tobytes(), len(self.edges),
+               mesh_key, (nf_c, nt_c), int(self.npad), coher,
+               float(self.thth_tau_mask), float(self.fw))
+        fn = _SHARDED_GRID_CACHE.get(key)
+        if fn is None:
+            if len(_SHARDED_GRID_CACHE) >= 8:
+                _SHARDED_GRID_CACHE.pop(
+                    next(iter(_SHARDED_GRID_CACHE)))
+            fn = par.make_fused_grid_search_sharded(
+                mesh, tau, fd, len(self.edges), nf_c, nt_c,
+                npad=self.npad, coher=coher,
+                tau_mask=self.thth_tau_mask, fw=self.fw)
+            _SHARDED_GRID_CACHE[key] = fn
+        _, eta, sig, _ = fn(jnp.asarray(np.stack(chunks)),
+                            jnp.asarray(np.stack(edges_list)),
+                            jnp.asarray(np.stack(etas_list)))
+        eta = np.asarray(eta)[:B]
+        sig = np.asarray(sig)[:B]
+
+        for i, (cf, ct, f_m, t_m) in enumerate(meta):
+            self.eta_evo[cf, ct] = eta[i]
+            self.eta_evo_err[cf, ct] = sig[i]
+            self.f0s[cf] = f_m
+            self.t0s[ct] = t_m
+        if verbose:
+            ok = np.isfinite(self.eta_evo)
+            print(f"Fused sharded chunk grid: {int(ok.sum())}/{B} "
                   f"chunk fits on {ndev} devices")
 
     def thetatheta_chunks(self, verbose=False, pool=None, memmap=False,

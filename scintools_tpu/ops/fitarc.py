@@ -435,7 +435,16 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
 
         emax_in = np.concatenate([etamax_b] + [etamax_b[-1:]] * pad) \
             if pad else etamax_b
-        Ls = eta_crop_lengths(numsteps, e_in, emax_in)
+        # −inf dB pixels (10·log10(0)) would make the host path's
+        # finite mask reshape the η grid per epoch — a data-dependent
+        # shape the device program cannot follow. Flag those epochs so
+        # eta_crop_lengths zeroes their length and the device fit
+        # NaN-quarantines them (fitarc_device module docstring).
+        fin_b = np.isfinite(sspecs).all(axis=(1, 2))
+        fin_in = np.concatenate([fin_b] + [fin_b[-1:]] * pad) \
+            if pad else fin_b
+        Ls = eta_crop_lengths(numsteps, e_in, emax_in,
+                              profile_finite=fin_in)
         packed, folded_dev = fn(s_dev, jnp.asarray(e_in),
                                 jnp.asarray(Ls))
         out = np.asarray(packed)[:B]     # ONE tiny fetch: [B, 10]

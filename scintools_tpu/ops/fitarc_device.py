@@ -17,11 +17,18 @@ Semantics are pinned to the host path index-for-index:
   uniform moving mean (the order-1 Savitzky–Golay centre weight);
   the first/last ``window//2`` points come from a linear LS fit over
   the first/last ``window`` valid points (scipy's edge polyfit).
-- the peak walk-outs replicate the reference's while-loops — including
-  their quirks (the scan starts at ``ind±2``; the noise walk's left
-  scan stops at index 2 and over-counts by one; a fully-walked-out
-  left edge lands on index -1, which python wraps to the last valid
-  element) — see _fit_one below.
+- the peak walk-outs replicate the HOST path's while-loops
+  (ops/fitarc.py:_peak_parabola) — including their quirks (the scan
+  starts at ``ind±2``; the noise walk's left scan stops at index 2
+  and over-counts by one; a fully-walked-out left edge lands on
+  index -1, which python wraps to the last valid element) — see
+  _fit_one below. NOTE one deliberate host-pinned deviation from the
+  reference: the reference's LEFT power walk loops ``while power >
+  threshold and ind + ind1 < len(smoothed) - 1`` (dynspec.py:
+  1216-1218) — bounding the left scan by the RIGHT edge, so a peak
+  near the start can walk to negative indices and python-wrap — while
+  the host path here (and therefore this program) bounds it at the
+  array start (``ind - i1 > 0``). See docs/migrating.md.
 - the parabola fit reproduces ``fit_parabola``
   (fit/models.py:221-233 → reference scint_models.py:300-328):
   x is scaled by 1000/ptp, the deg-2 LS solve runs in centred
@@ -31,8 +38,17 @@ Semantics are pinned to the host path index-for-index:
 
 The profile crop length per epoch (the host path's ``_prep_profile``
 η-range selection — a pure function of etamin/etamax and the fdop
-grid, since the folded profile is always finite) is computed on host
-by :func:`eta_crop_lengths` and passed in as a traced int per epoch.
+grid *when the folded profile is finite*) is computed on host by
+:func:`eta_crop_lengths` and passed in as a traced int per epoch.
+When an epoch's secondary spectrum carries non-finite pixels (−inf
+dB from ``10·log10(0)``), the host path's finite mask would change
+the η grid point-by-point — a data-dependent shape the fixed-shape
+device program cannot follow. Such epochs are NaN-QUARANTINED
+instead: ``eta_crop_lengths`` forces their length to 0 (via the
+``profile_finite`` argument, wired by ``ops.fitarc.fit_arc_batch``),
+so the device fit returns NaN η rather than silently disagreeing
+with the host about which η each sample belongs to. See
+docs/migrating.md.
 """
 
 from __future__ import annotations
@@ -55,15 +71,31 @@ def eta_grid(numsteps):
     return np.flip(etafrac) ** 2, fdopnew
 
 
-def eta_crop_lengths(numsteps, etamins, etamaxs):
+def eta_crop_lengths(numsteps, etamins, etamaxs, profile_finite=None):
     """Per-epoch valid-prefix length L of the flipped folded profile:
     the count of ``etamin·etafrac² < etamax`` — evaluated with the
-    identical float expression the host crop uses."""
+    identical float expression the host crop uses.
+
+    This length is only the host crop when the folded profile is
+    all-finite (``_prep_profile`` masks non-finite points BEFORE the
+    η crop, which would change the grid shape per epoch).
+    ``profile_finite`` — per-epoch bool (or scalar), e.g.
+    ``np.isfinite(sspecs).all(axis=(1, 2))`` — marks epochs whose
+    profile is guaranteed finite; epochs flagged False get L = 0, so
+    the device fit NaN-quarantines them (module docstring) instead of
+    fitting against a silently different η grid than the host would.
+    """
     ef2, _ = eta_grid(numsteps)
     etamins = np.atleast_1d(np.asarray(etamins, dtype=float))
     etamaxs = np.atleast_1d(np.asarray(etamaxs, dtype=float))
-    return (etamins[:, None] * ef2[None, :]
-            < etamaxs[:, None]).sum(axis=1).astype(np.int32)
+    L = (etamins[:, None] * ef2[None, :]
+         < etamaxs[:, None]).sum(axis=1).astype(np.int32)
+    if profile_finite is not None:
+        ok = np.broadcast_to(
+            np.atleast_1d(np.asarray(profile_finite, dtype=bool)),
+            L.shape)
+        L = np.where(ok, L, 0).astype(np.int32)
+    return L
 
 
 def make_savgol_interp(nsmooth, H):
@@ -167,11 +199,14 @@ def make_arc_fit_batch_fn(tdel, fdop, delmax=None, startbin=3, cutmid=3,
         ind = jnp.argmin(jnp.where(valid, jnp.abs(sm - max_in), BIG))
         max_power = sm[ind]
 
-        # power walk-outs (dynspec.py:1215-1228): the while-loops scan
-        # smoothed[ind-2], ind-3, … (resp. ind+2, ind+3, …) until the
-        # first value at or below threshold; the boundary stops at
-        # index 0 (resp. L-1). Loop never entered when ind < 2 (resp.
-        # ind+1 >= L-1): i stays 1.
+        # power walk-outs (host path ops/fitarc.py:_peak_parabola —
+        # NOT the raw reference, whose left loop is bounded by the
+        # right edge `ind + ind1 < len-1`; module docstring +
+        # docs/migrating.md): the while-loops scan smoothed[ind-2],
+        # ind-3, … (resp. ind+2, ind+3, …) until the first value at
+        # or below threshold; the boundary stops at index 0 (resp.
+        # L-1). Loop never entered when ind < 2 (resp. ind+1 >= L-1):
+        # i stays 1.
         t_lo = max_power + low_power_diff
         t_hi = max_power + high_power_diff
         if low_power_diff < 0:           # loop never entered otherwise

@@ -84,6 +84,49 @@ def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
     return sec
 
 
+def pad_chunk_batch(dspecs, npad, xp=np):
+    """Mean-pad a batch of θ-θ chunks: ``(B, nf, nt) →
+    (B, (1+npad)·nf, (1+npad)·nt)``, each chunk padded with its own
+    mean (the per-chunk counterpart of ``thth.search.pad_chunk`` with
+    ``fill='mean'``).
+
+    Written as one static-shape expression — zero-pad the
+    mean-subtracted chunk and add the mean back, equal to
+    constant-padding with the chunk mean up to one float rounding of
+    the data region — so it jits/vmaps and shards over the chunk
+    batch. ``xp=jnp`` works on traced values.
+    """
+    dspecs = xp.asarray(dspecs)
+    _, nf, nt = dspecs.shape
+    mu = xp.mean(dspecs, axis=(1, 2), keepdims=True)
+    return xp.pad(dspecs - mu,
+                  ((0, 0), (0, npad * nf), (0, npad * nt))) + mu
+
+
+def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
+                                   xp=np):
+    """Batched device-capable chunk conjugate spectrum: per-chunk mean
+    pad → ``fft2`` → ``fftshift`` (the θ-θ search's
+    ``chunk_conjugate_spectrum`` for a whole same-geometry chunk stack
+    with static shapes, /root/reference/scintools/ththmod.py:772-787).
+
+    ``dspecs[B, nf, nt]`` real → ``CS[B, (1+npad)nf, (1+npad)nt]``
+    complex. ``tau_keep`` is an optional host-computed bool mask over
+    the (fftshifted) delay axis — rows with ``|tau| < tau_mask`` are
+    zeroed, matching the host path's ``CS[|tau| < tau_mask] = 0``.
+    The fused search path (thth/batch.py:make_fused_search_fn) calls
+    this with ``xp=jnp`` inside one jitted program, so raw chunks are
+    the only host→device transfer.
+    """
+    CS = xp.fft.fftshift(xp.fft.fft2(pad_chunk_batch(dspecs, npad,
+                                                     xp=xp)),
+                         axes=(-2, -1))
+    if tau_keep is not None:
+        CS = xp.where(xp.asarray(tau_keep)[None, :, None], CS,
+                      xp.zeros((), dtype=CS.dtype))
+    return CS
+
+
 def secondary_spectrum(dyn, dt, df, window="hanning", window_frac=0.1,
                        prewhite=False, halve=True, dlam=None, db=True,
                        backend=None):

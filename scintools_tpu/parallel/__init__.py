@@ -4,13 +4,15 @@ the sharded survey pipeline (the TPU replacement for the reference's
 scintools/dynspec.py:1669-1671)."""
 
 from .mesh import (make_mesh, device_count, DATA_AXIS, SEQ_AXIS,
-                   data_sharding, batch_freq_sharding, replicated)
+                   data_sharding, batch_freq_sharding,
+                   chunk_shardings, replicated)
 from .fft import (make_fft2_sharded, make_gs_sharded,
                   make_sspec_power_sharded)
 from .survey import (make_survey_step, make_eta_search_sharded,
                      make_arc_profile_sharded, make_arc_fit_sharded,
                      make_thth_grid_search_sharded,
-                     make_thth_thin_grid_search_sharded)
+                     make_thth_thin_grid_search_sharded,
+                     make_fused_grid_search_sharded)
 
 __all__ = [
     "make_mesh", "device_count", "DATA_AXIS", "SEQ_AXIS",
@@ -21,4 +23,5 @@ __all__ = [
     "make_arc_profile_sharded", "make_arc_fit_sharded",
     "make_thth_grid_search_sharded",
     "make_thth_thin_grid_search_sharded",
+    "make_fused_grid_search_sharded", "chunk_shardings",
 ]

@@ -74,6 +74,16 @@ def batch_freq_sharding(mesh):
     return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
 
 
+def chunk_shardings(mesh, ndims):
+    """Tuple of :func:`data_sharding` layouts, one per array of a
+    fused chunk-search program's argument/output tree: every array
+    carries the chunk batch on its leading axis, fanned out over all
+    devices ('data' × 'seq' combined). ``ndims`` lists each array's
+    rank, e.g. ``chunk_shardings(mesh, (3, 2, 2))`` for
+    ``(dspecs[B, nf, nt], edges[B, n], etas[B, neta])``."""
+    return tuple(data_sharding(mesh, ndim=n) for n in ndims)
+
+
 def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -23,7 +23,8 @@ from __future__ import annotations
 
 
 from ..backend import get_jax
-from .mesh import DATA_AXIS, SEQ_AXIS, batch_freq_sharding, replicated
+from .mesh import (DATA_AXIS, SEQ_AXIS, batch_freq_sharding,
+                   chunk_shardings, replicated)
 from .fft import make_sspec_power_sharded, make_fft2_sharded
 from ..ops.windows import get_window
 from ..thth.core import make_eval_fn
@@ -49,6 +50,45 @@ def make_thth_grid_search_sharded(mesh, tau, fd, n_edges, iters=64):
     chunk_sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     return jax.jit(fn, in_shardings=(chunk_sh, chunk_sh, chunk_sh),
                    out_shardings=chunk_sh)
+
+
+def make_fused_grid_search_sharded(mesh, tau, fd, n_edges, nf, nt,
+                                   npad=3, coher=True, tau_mask=0.0,
+                                   fw=0.1, iters=64):
+    """FUSED whole θ-θ chunk grid sharded over the device mesh:
+    ``fn(dspecs[B, nf, nt], edges[B, n_edges], etas[B, neta]) →
+    (eigs[B, neta], eta[B], eta_sig[B], popt[B, 3])`` with the chunk
+    axis B split across every device.
+
+    Unlike :func:`make_thth_grid_search_sharded` (which takes
+    host-precomputed conjugate spectra), this takes the RAW
+    dynamic-spectrum chunk stack: per-chunk mean-pad → fft2 → masked
+    θ-θ gather → eigen curve → closed-form parabola peak fit all run
+    inside the one SPMD program (thth/batch.py:make_fused_grid_eval_fn
+    + thth/peakfit.py), so a multi-epoch survey ships one raw-chunk
+    buffer per call and gets back 5 floats per chunk plus the curves —
+    no per-chunk host FFT, no per-chunk scipy fit, and the donated
+    chunk stack's HBM is recycled into the θ-θ batch. Used by
+    ``Dynspec.fit_thetatheta(mesh=...)``. B must be divisible by the
+    mesh device count (pad with dummy chunks; their fits are dropped).
+    """
+    jax = get_jax()
+
+    from ..thth.batch import make_fused_grid_eval_fn
+
+    fn = make_fused_grid_eval_fn(tau, fd, n_edges, nf, nt, npad=npad,
+                                 coher=coher, tau_mask=tau_mask,
+                                 fw=fw, iters=iters)
+    kwargs = {}
+    if jax.default_backend() != "cpu":
+        # chunk-stack donation: its HBM is recycled into the θ-θ
+        # batch. Skipped on CPU (virtual meshes), where XLA cannot
+        # alias it and warns on every compile.
+        kwargs["donate_argnums"] = (0,)
+    return jax.jit(fn,
+                   in_shardings=chunk_shardings(mesh, (3, 2, 2)),
+                   out_shardings=chunk_shardings(mesh, (2, 1, 1, 2)),
+                   **kwargs)
 
 
 def make_thth_thin_grid_search_sharded(mesh, tau, fd, n_edges,

@@ -7,7 +7,10 @@ from .core import (thth_map, thth_redmap, rev_map, modeler, eval_calc,
                    two_curve_map, singularvalue_calc, min_edges,
                    arc_edges, len_arc, ext_find, fft_axis, cs_to_ri,
                    unit_checks)
-from .batch import make_multi_eval_fn, make_thin_eval_fn
+from .batch import (make_multi_eval_fn, make_thin_eval_fn,
+                    make_fused_search_fn, make_fused_thin_search_fn,
+                    make_fused_grid_eval_fn)
+from .peakfit import fit_eig_peak_device, fit_eig_peak_batch_device
 from .search import (single_search, single_search_thin,
                      multi_chunk_search, multi_chunk_search_thin,
                      fit_eig_peak, chi_par)
@@ -25,6 +28,9 @@ __all__ = [
     "unit_checks", "single_search", "single_search_thin",
     "multi_chunk_search", "multi_chunk_search_thin",
     "make_thin_eval_fn", "fit_eig_peak", "chi_par",
+    "make_fused_search_fn", "make_fused_thin_search_fn",
+    "make_fused_grid_eval_fn", "fit_eig_peak_device",
+    "fit_eig_peak_batch_device",
     "single_chunk_retrieval", "vlbi_chunk_retrieval",
     "vlbi_retrieval_batch", "mosaic",
     "refine_mosaic", "gerchberg_saxton", "calc_asymmetry", "mask_func",

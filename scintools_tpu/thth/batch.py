@@ -49,6 +49,12 @@ def make_multi_eval_fn(tau, fd, edges, iters=200, method="auto",
     one geometry and ``eigs`` is (B, neta).
 
     method 'power' runs the vmapped power iteration (CPU-safe);
+    'warm' runs the same shifted power iteration as a ``lax.scan``
+    along the η axis that carries the dominant eigenvector between
+    consecutive η values (the XLA analogue of the Pallas warm-start
+    kernel: ``warm_iters`` iterations per η instead of ``iters`` from
+    a cold seed — adjacent η matrices differ only slightly, so the
+    previous eigenvector is a near-converged start);
     'pallas' (or 'auto' on TPU) runs the warm-start Pallas kernel.
     """
     jax = get_jax()
@@ -124,6 +130,49 @@ def make_multi_eval_fn(tau, fd, edges, iters=200, method="auto",
 
             eigs = jax.vmap(jax.vmap(one))(flat)    # (neta, B)
             return jnp.transpose(eigs)
+
+        return fn
+
+    if method == "warm":
+        def fn(CS_ri, etas):
+            thth = build_batch(CS_ri, etas)         # (neta, n, n, B)
+            A_all = jnp.transpose(thth, (0, 3, 1, 2))  # (neta, B, n, n)
+            n = A_all.shape[-1]
+
+            def matvec(A, v):                       # (B,n,n)·(B,n)
+                return jnp.einsum("bij,bj->bi", A, v)
+
+            def power_steps(A, v, shift, k):
+                def body(_, v):
+                    w = matvec(A, v) + shift[:, None] * v
+                    nrm = jnp.sqrt(jnp.sum(jnp.abs(w) ** 2, axis=1,
+                                           keepdims=True))
+                    return w / (nrm + 1e-30)
+
+                return jax.lax.fori_loop(0, k, body, v)
+
+            def gershgorin(A):                      # (B,)
+                return jnp.max(jnp.sum(jnp.abs(A), axis=2), axis=1)
+
+            # cold start on the first η only (the scan revisits it
+            # with warm_iters, which costs one cheap extra step)
+            v0 = A_all[0][:, n // 2, :]
+            nrm0 = jnp.sqrt(jnp.sum(jnp.abs(v0) ** 2, axis=1,
+                                    keepdims=True))
+            v0 = jnp.where(nrm0 > 0, v0 / (nrm0 + 1e-30),
+                           jnp.ones_like(v0) / np.sqrt(n))
+            v0 = power_steps(A_all[0], v0, gershgorin(A_all[0]),
+                             iters)
+
+            def step(v, A):
+                v = power_steps(A, v, gershgorin(A), warm_iters)
+                Av = matvec(A, v)
+                num = jnp.real(jnp.sum(jnp.conj(v) * Av, axis=1))
+                den = jnp.real(jnp.sum(jnp.conj(v) * v, axis=1))
+                return v, jnp.abs(num / (den + 1e-30))
+
+            _, lam = jax.lax.scan(step, v0, A_all)  # (neta, B)
+            return jnp.transpose(lam)
 
         return fn
 
@@ -388,5 +437,167 @@ def make_thin_eval_fn(tau, fd, edges, edges_arclet, center_cut,
 
         sig = jax.vmap(jax.vmap(one))(gram)     # (neta, B)
         return jnp.transpose(sig * scale[:, :, 0, 0])
+
+    return fn
+
+
+def resolve_fused_method(method, n_edges):
+    """'auto' for the FUSED search path: the VMEM Pallas kernel on
+    TPU (when the padded matrix fits), else the η-scan warm-start
+    power iteration. NOTE the staged ``make_multi_eval_fn`` resolves
+    'auto' to the cold 'power' iteration off-TPU for back-compat with
+    its callers; the fused path is new code and defaults to the
+    ~(iters/warm_iters)× cheaper warm scan."""
+    if method != "auto":
+        return method
+    from .pallas_eig import pallas_available, pad_to_multiple
+
+    n_th = int(n_edges) - 1
+    if pallas_available() and pad_to_multiple(n_th) <= 768:
+        return "pallas"
+    return "warm"
+
+
+def _chunk_cs_to_ri(dspecs, npad, tau_keep, power, coher):
+    """Traced helper shared by the fused builders: raw chunk stack →
+    packed (real, imag) conjugate spectra, all on device.
+    ``power`` selects the incoherent base: |CS| for the single-curve
+    search, |CS|² for the thin-screen search (reference
+    ththmod.py:741-746 vs :586-590)."""
+    import jax.numpy as jnp
+
+    from ..ops.sspec import chunk_conjugate_spectrum_batch
+
+    CS = chunk_conjugate_spectrum_batch(dspecs, npad=npad,
+                                        tau_keep=tau_keep, xp=jnp)
+    if not coher:
+        CS = jnp.abs(CS) ** 2 if power else jnp.abs(CS)
+    return jnp.stack([jnp.real(CS), jnp.imag(CS)],
+                     axis=1).astype(jnp.float32)
+
+
+def _tau_keep_mask(tau, tau_mask):
+    tau_a = np.asarray(unit_checks(tau, "tau"), dtype=float)
+    if not tau_mask:
+        return tau_a, None
+    return tau_a, np.abs(tau_a) >= float(unit_checks(tau_mask))
+
+
+def make_fused_search_fn(tau, fd, edges, nf, nt, npad=3, coher=True,
+                         tau_mask=0.0, fw=0.1, iters=200,
+                         method="auto", squarings=10, warm_iters=None,
+                         interpret=False):
+    """The WHOLE per-row curvature search as one device program:
+    ``fn(dspecs[B, nf, nt] float, etas[neta]) → (eigs[B, neta],
+    eta[B], eta_sig[B], popt[B, 3])``.
+
+    Fuses per-chunk mean-pad → fft2 conjugate spectrum
+    (ops/sspec.py:chunk_conjugate_spectrum_batch) → masked θ-θ gather
+    → batched eigen curve (:func:`make_multi_eval_fn`) → closed-form
+    parabola peak fit (thth/peakfit.py), with no intermediate host
+    materialisation: the raw chunk stack is the single host→device
+    transfer per call and the fetched outputs are the (B, neta) curve
+    plus 5 scalars per chunk. Replaces the staged path's per-chunk
+    host numpy FFT + per-chunk scipy ``curve_fit``
+    (thth/search.py:multi_chunk_search, the reference's pool.map over
+    ``single_search``, dynspec.py:1715-1719).
+
+    Geometry (tau/fd/edges, chunk shape, npad, tau_mask, fw) is baked
+    in host-side — cache the jitted program per geometry via
+    ``keyed_jit_cache``. 'auto' method → :func:`resolve_fused_method`.
+    """
+    get_jax()
+
+    tau_a, tau_keep = _tau_keep_mask(tau, tau_mask)
+    if len(tau_a) != (npad + 1) * nf:
+        raise ValueError(
+            f"tau length {len(tau_a)} != (npad+1)*nf = "
+            f"{(npad + 1) * nf} — tau/fd must be the fft_axis of the "
+            "chunk axes at this npad")
+    method = resolve_fused_method(method, len(np.asarray(edges)))
+    if warm_iters is None:
+        # per-method tuned defaults: the VMEM Pallas kernel restarts
+        # from Rayleigh residuals and was swept to 24 on the chip;
+        # the XLA η-scan has no restarts and wants 64 (measured: on
+        # par with the cold 200-iteration power method)
+        warm_iters = 64 if method == "warm" else 24
+    multi = make_multi_eval_fn(tau, fd, edges, iters=iters,
+                               method=method, squarings=squarings,
+                               warm_iters=warm_iters,
+                               interpret=interpret)
+
+    from .peakfit import fit_eig_peak_batch_device
+
+    def fn(dspecs, etas):
+        cs_ri = _chunk_cs_to_ri(dspecs, npad, tau_keep, power=False,
+                                coher=coher)
+        eigs = multi(cs_ri, etas)
+        eta, sig, popt = fit_eig_peak_batch_device(etas, eigs, fw=fw)
+        return eigs, eta, sig, popt
+
+    return fn
+
+
+def make_fused_thin_search_fn(tau, fd, edges, edges_arclet, center_cut,
+                              nf, nt, npad=3, coher=True, tau_mask=0.0,
+                              fw=0.1, iters=200):
+    """Thin-screen counterpart of :func:`make_fused_search_fn`:
+    ``fn(dspecs[B, nf, nt], etas) → (sigs[B, neta], eta[B],
+    eta_sig[B], popt[B, 3])`` — raw chunks in, two-curvature singular
+    values + closed-form peak fit out, one program
+    (thth/search.py:multi_chunk_search_thin's staged host FFT +
+    scipy fit, fused)."""
+    get_jax()
+
+    tau_a, tau_keep = _tau_keep_mask(tau, tau_mask)
+    if len(tau_a) != (npad + 1) * nf:
+        raise ValueError(
+            f"tau length {len(tau_a)} != (npad+1)*nf = "
+            f"{(npad + 1) * nf}")
+    thin = make_thin_eval_fn(tau, fd, edges, edges_arclet, center_cut,
+                             iters=iters)
+
+    from .peakfit import fit_eig_peak_batch_device
+
+    def fn(dspecs, etas):
+        cs_ri = _chunk_cs_to_ri(dspecs, npad, tau_keep, power=True,
+                                coher=coher)
+        sigs = thin(cs_ri, etas)
+        eta, sig, popt = fit_eig_peak_batch_device(etas, sigs, fw=fw)
+        return sigs, eta, sig, popt
+
+    return fn
+
+
+def make_fused_grid_eval_fn(tau, fd, n_edges, nf, nt, npad=3,
+                            coher=True, tau_mask=0.0, fw=0.1,
+                            iters=200):
+    """Fused whole-chunk-grid search with per-chunk TRACED geometry:
+    ``fn(dspecs[B, nf, nt], edges[B, n_edges], etas[B, neta]) →
+    (eigs[B, neta], eta[B], eta_sig[B], popt[B, 3])``.
+
+    The traced-geometry counterpart of :func:`make_fused_search_fn`
+    (per-row frequency rescales give every chunk its own edges/η —
+    :func:`make_grid_eval_fn`), so the ENTIRE (ncf × nct) chunk grid
+    of ``fit_thetatheta`` is one program whose chunk axis shards over
+    a device mesh — raw chunks are the only transfer
+    (parallel/survey.py:make_fused_grid_search_sharded)."""
+    get_jax()
+
+    tau_a, tau_keep = _tau_keep_mask(tau, tau_mask)
+    if len(tau_a) != (npad + 1) * nf:
+        raise ValueError(
+            f"tau length {len(tau_a)} != (npad+1)*nf = "
+            f"{(npad + 1) * nf}")
+    grid = make_grid_eval_fn(tau, fd, n_edges, iters=iters)
+
+    from .peakfit import fit_eig_peak_batch_device
+
+    def fn(dspecs, edges_b, etas_b):
+        cs_ri = _chunk_cs_to_ri(dspecs, npad, tau_keep, power=False,
+                                coher=coher)
+        eigs = grid(cs_ri, edges_b, etas_b)
+        eta, sig, popt = fit_eig_peak_batch_device(etas_b, eigs, fw=fw)
+        return eigs, eta, sig, popt
 
     return fn

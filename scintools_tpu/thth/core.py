@@ -332,12 +332,24 @@ def make_eval_fn(tau, fd, edges, iters=200, method="power", squarings=10,
 # closure per call would retrace every chunk; key the compiled kernel
 # on the geometry instead (fit_thetatheta reuses one geometry across
 # all time-chunks of a frequency row).
-def keyed_jit_cache(cache, key, builder, maxsize=32):
+def keyed_jit_cache(cache, key, builder, maxsize=32,
+                    donate_argnums=None):
     """FIFO-bounded cache of jitted kernels keyed on geometry bytes.
-    Shared by the per-chunk and chunk-batched search paths."""
+    Shared by the per-chunk and chunk-batched search paths.
+
+    ``donate_argnums`` is forwarded to ``jax.jit`` — the fused search
+    donates its chunk-stack buffer (argument 0) so XLA may reuse that
+    HBM for the θ-θ batch instead of holding the raw chunks alive
+    for the whole program. Compiled programs additionally persist
+    across *processes* via the XLA compilation cache wired by
+    ``backend._maybe_enable_compilation_cache`` (same-geometry reruns
+    skip the compile, not just the retrace)."""
     fn = cache.get(key)
     if fn is None:
-        fn = get_jax().jit(builder())
+        kwargs = {}
+        if donate_argnums is not None:
+            kwargs["donate_argnums"] = donate_argnums
+        fn = get_jax().jit(builder(), **kwargs)
         if len(cache) >= maxsize:
             cache.pop(next(iter(cache)))
         cache[key] = fn

@@ -1,0 +1,123 @@
+"""Closed-form batched parabola peak fit for the η-curvature search.
+
+Device counterpart of ``thth.search.fit_eig_peak`` (reference
+ththmod.py:813-852): the staged path fetches every chunk's
+eigenvalue-vs-η curve and runs one ``scipy.optimize.curve_fit`` per
+chunk on host. The model ``A·(x-x0)² + C`` is an exact
+reparameterisation of a quadratic ``a2·x² + a1·x + a0`` that is
+*linear* in its coefficients, so the least-squares optimum curve_fit
+iterates toward has a closed form: one NaN-masked 3×3 normal-equation
+solve per chunk, vmapped over the batch. That lets the whole
+search — conjugate spectra, θ-θ eigen curves, and the peak fit —
+compile as one device program with no per-chunk host round trips
+(thth/batch.py:make_fused_search_fn).
+
+Numerical scheme (f32-safe): the window points are mapped to
+``u = (η - η_pk)/(fw·η_pk) ∈ (-1, 1)`` and the eigenvalues centred on
+their window mean, so the normal equations are O(1)-conditioned; the
+coefficients are mapped back to the (A, x0, C) parameterisation
+afterwards. Semantics mirror ``fit_eig_peak`` point-for-point: peak =
+first argmax over NaN-stripped values, window ``|η - η_pk| <
+fw·η_pk``, NaN out when fewer than 3 finite or 3 window points, and
+``eta_sig = sqrt(std(residuals)/|A|)`` with the population std.
+Divergence (documented): where scipy's LM fails to *converge* on a
+pathological window the host path returns NaN from the raised fit
+error. The closed form always produces the LS parabola, so the same
+refusals are reproduced by a vertex-locality gate (see ``ok`` below):
+vertices farther than 2× the window half-width from the peak — the
+near-degenerate regime where LM wanders — are NaN'd. Concave-up
+windows whose vertex stays local are returned on both paths (the
+host has no forward-parabola check). The parity gate is
+tests/test_fused_search.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+
+
+def fit_eig_peak_device(etas, eigs, fw=0.1):
+    """Single-curve traced-safe peak fit: ``(etas[neta], eigs[neta])
+    → (eta, eta_sig, popt[3])`` with ``popt = (A, x0, C)`` matching
+    ``fit_eig_peak(..., full=True)``'s coefficients. NaN-masked; NaN
+    outputs mark a curve the host path would refuse to fit."""
+    get_jax()
+    import jax.numpy as jnp
+
+    eigs = jnp.asarray(eigs)
+    etas = jnp.asarray(etas, dtype=eigs.dtype)
+    finite = jnp.isfinite(eigs)
+    n_fin = jnp.sum(finite)
+    BIG = jnp.asarray(np.inf, eigs.dtype)
+
+    # peak = first index of the max over finite entries (the host's
+    # ``etas[eigs == eigs.max()][0]`` after the NaN strip)
+    e_pk = etas[jnp.argmax(jnp.where(finite, eigs, -BIG))]
+    sel = finite & (jnp.abs(etas - e_pk) < fw * e_pk)
+    n_sel = jnp.sum(sel)
+    nf_ = jnp.maximum(n_sel, 1).astype(eigs.dtype)
+
+    # scaled/centred coordinates: u ∈ (-1, 1), y centred on the window
+    # mean — in f32 the raw η³-scale normal equations would be noise
+    s = fw * e_pk
+    u = jnp.where(sel, (etas - e_pk) / s, 0.0)
+    ym = jnp.sum(jnp.where(sel, eigs, 0.0)) / nf_
+    y = jnp.where(sel, eigs - ym, 0.0)
+    u2 = u * u
+    S1 = jnp.sum(u)
+    S2 = jnp.sum(u2)
+    S3 = jnp.sum(u2 * u)
+    S4 = jnp.sum(u2 * u2)
+    G = jnp.array([[S4, S3, S2], [S3, S2, S1], [S2, S1, nf_]])
+    r = jnp.array([jnp.sum(u2 * y), jnp.sum(u * y), jnp.sum(y)])
+    c = jnp.linalg.solve(G, r)
+    c2, c1, c0 = c[0], c[1], c[2]
+
+    # back to the chi_par parameterisation: y ≈ c2·u² + c1·u + c0,
+    # u = (x - e_pk)/s  ⇒  A = c2/s², x0 = e_pk - s·c1/(2c2),
+    # C = ym + c0 - c1²/(4c2)
+    A = c2 / (s * s)
+    x0 = e_pk - s * c1 / (2.0 * c2)
+    C = ym + c0 - c1 * c1 / (4.0 * c2)
+
+    # eta_sig = sqrt(std(residuals)/|A|), population std over the
+    # window (fit_eig_peak, ththmod.py:849-851)
+    fitv = c2 * u2 + c1 * u + c0
+    res = jnp.where(sel, y - fitv, 0.0)
+    r_mu = jnp.sum(res) / nf_
+    r_var = jnp.sum(jnp.where(sel, (res - r_mu) ** 2, 0.0)) / nf_
+    sig = jnp.sqrt(jnp.sqrt(r_var) / jnp.abs(A))
+
+    # vertex-locality gate: the closed form always "converges", so a
+    # window where scipy's LM diverges or raises comes back here as a
+    # near-degenerate parabola whose vertex sits far outside the fit
+    # window (observed: x0 = -0.013 on a window around 2e-3). Those
+    # are NaN'd — the host path NaNs them via the curve_fit
+    # exception, and a finite garbage η would poison the global η(f)
+    # fit in ways an explicit NaN cannot. A vertex within 2× the
+    # window half-width is kept, matching curve_fit's convergent
+    # region (it converges from the data-driven p0 there — including
+    # on concave-up windows, whose vertex the host also returns).
+    ok = ((n_fin >= 3) & (n_sel >= 3) & jnp.isfinite(x0)
+          & jnp.isfinite(A) & (jnp.abs(x0 - e_pk) < 2.0 * s))
+    nan = jnp.asarray(np.nan, eigs.dtype)
+    popt = jnp.where(ok, jnp.stack([A, x0, C]), nan)
+    return jnp.where(ok, x0, nan), jnp.where(ok, sig, nan), popt
+
+
+def fit_eig_peak_batch_device(etas, eigs, fw=0.1):
+    """Batched closed-form peak fit: ``eigs[B, neta]`` with ``etas``
+    either shared ``(neta,)`` or per-chunk ``(B, neta)`` →
+    ``(eta[B], eta_sig[B], popt[B, 3])``. Pure function of traced
+    values — compose it into a fused device program."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    eigs = jnp.asarray(eigs)
+    etas = jnp.asarray(etas)
+    one = lambda e, g: fit_eig_peak_device(e, g, fw=fw)  # noqa: E731
+    if etas.ndim == 1:
+        return jax.vmap(one, in_axes=(None, 0))(etas, eigs)
+    return jax.vmap(one)(etas, eigs)

@@ -6,6 +6,16 @@ chunks out over an MPI/multiprocessing pool and loops η in python; here
 each chunk's η curve is one batched device kernel
 (:func:`eval_calc_batch`) and chunks batch via vmap/shard_map
 (see parallel/).
+
+The jax path of the multi-chunk searches is FUSED end-to-end: the
+stacked raw dynamic-spectrum chunks are the single host→device
+transfer, and pad → mean-fill → fft2 conjugate spectrum → masked θ-θ
+gather → eigen curve → closed-form parabola peak fit run as one
+geometry-keyed jitted program (thth/batch.py:make_fused_search_fn,
+thth/peakfit.py) with the chunk-stack buffer donated. The staged path
+(per-chunk host FFT + per-chunk scipy ``curve_fit``) remains as the
+numpy-backend route, the single-chunk route, and the ``fused=False``
+parity oracle — see docs/performance.md ("Fused search pipeline").
 """
 
 from __future__ import annotations
@@ -154,6 +164,13 @@ def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
 
 _MULTI_JIT_CACHE = {}
 
+# cache-introspection counters: ``builder_calls`` increments once per
+# keyed_jit_cache MISS (a new fused program built+compiled). The
+# tier-1 retrace guard (tests/test_fused_search.py) asserts repeated
+# same-geometry searches leave it unchanged — a silent per-call
+# retrace is exactly the regression that made the staged path slow.
+FUSED_CACHE_STATS = {"builder_calls": 0}
+
 
 def _jitted_multi_eval(tau, fd, edges, method):
     from .batch import make_multi_eval_fn
@@ -166,17 +183,93 @@ def _jitted_multi_eval(tau, fd, edges, method):
         maxsize=16)
 
 
+def _jitted_fused_eval(tau, fd, edges, shape, npad, coher, tau_mask,
+                       fw, method):
+    from .batch import make_fused_search_fn
+    from .core import keyed_jit_cache
+
+    nf, nt = shape
+    key = ("fused", tau.tobytes(), fd.tobytes(), edges.tobytes(),
+           (int(nf), int(nt)), int(npad), bool(coher),
+           float(tau_mask), float(fw), method)
+
+    def build():
+        FUSED_CACHE_STATS["builder_calls"] += 1
+        return make_fused_search_fn(tau, fd, edges, nf, nt, npad=npad,
+                                    coher=coher, tau_mask=tau_mask,
+                                    fw=fw, method=method)
+
+    # donate the chunk stack: it is consumed by the pad+fft front end,
+    # so XLA may reuse its HBM for the θ-θ batch
+    return keyed_jit_cache(_MULTI_JIT_CACHE, key, build, maxsize=16,
+                           donate_argnums=_chunk_donation())
+
+
+def _chunk_donation():
+    """Donate the chunk-stack buffer to the fused program on
+    accelerators (its HBM is recycled into the θ-θ batch). Skipped on
+    CPU, where XLA cannot alias it into the complex intermediates and
+    warns 'donated buffers were not usable' on every compile."""
+    from ..backend import get_jax
+
+    return (0,) if get_jax().default_backend() != "cpu" else None
+
+
+def _stack_chunks(dspecs):
+    return np.stack([np.asarray(unit_checks(d), dtype=np.float32)
+                     for d in dspecs])
+
+
+def _fused_results(fn, stack, etas, freq, times):
+    """Run a fused search program and unpack its device outputs into
+    per-chunk :class:`ChunkSearchResult` (NaN strip + popt gating on
+    host — pure numpy on a few kB, no scipy)."""
+    import jax.numpy as jnp
+
+    eigs, eta, sig, popt = fn(jnp.asarray(stack), jnp.asarray(etas))
+    eigs = np.asarray(eigs)
+    eta = np.asarray(eta)
+    sig = np.asarray(sig)
+    popt = np.asarray(popt)
+    freq_m = float(np.asarray(unit_checks(freq, "freq"),
+                              dtype=float).mean())
+    out = []
+    for b, t in enumerate(times):
+        ok = np.isfinite(eigs[b])
+        t_a = np.asarray(unit_checks(t, "time"), dtype=float)
+        out.append(ChunkSearchResult(
+            eta=float(eta[b]), eta_sig=float(sig[b]),
+            freq_mean=freq_m, time_mean=float(t_a.mean()),
+            eigs=eigs[b][ok].astype(float),
+            etas=np.asarray(etas, dtype=float)[ok],
+            popt=(popt[b].astype(float) if np.isfinite(eta[b])
+                  else None)))
+    return out
+
+
 def multi_chunk_search(dspecs, freq, times, etas, edges, fw=0.1, npad=3,
                        coher=True, tau_mask=0.0, backend=None,
-                       method="auto"):
+                       method="auto", fused=True):
     """Curvature search on a batch of same-geometry chunks in one
     device program.
 
     Replaces the reference's pool.map over per-chunk `single_search`
     calls (dynspec.py:1715-1719) for chunks sharing (freq, dt, shape)
-    — e.g. all time-chunks of one frequency row. The batched kernel
-    amortises the η-grid gather across the chunk batch and warm-starts
-    the eigensolver along η (thth/batch.py).
+    — e.g. all time-chunks of one frequency row. On the jax backend
+    the DEFAULT path is fully fused (``fused=True``): pad →
+    mean-fill → fft2 conjugate spectrum → masked θ-θ gather → batched
+    eigen curve → closed-form parabola peak fit, one jitted program
+    per chunk geometry (cached across calls), with the stacked raw
+    chunks as the single host→device transfer and the chunk buffer
+    donated. No per-chunk host FFT and no per-chunk scipy
+    ``curve_fit`` remain on this path (thth/batch.py:
+    make_fused_search_fn, thth/peakfit.py).
+
+    ``fused=False`` keeps the STAGED path (host numpy FFT per chunk +
+    device eigen curve + scipy peak fit per chunk) — the parity
+    oracle for the fused program and the reference-precision (f64
+    FFT) fallback. The numpy backend and single-chunk calls always
+    take the staged per-chunk route.
 
     dspecs : list of (nf, nt) chunk arrays; times : list of per-chunk
     time axes (same spacing). Returns a list of ChunkSearchResult.
@@ -188,7 +281,31 @@ def multi_chunk_search(dspecs, freq, times, etas, edges, fw=0.1, npad=3,
                               coher=coher, tau_mask=tau_mask,
                               backend=backend)
                 for d, t in zip(dspecs, times)]
+    if not fused:
+        return _multi_chunk_search_staged(
+            dspecs, freq, times, etas, edges, fw=fw, npad=npad,
+            coher=coher, tau_mask=tau_mask, method=method)
 
+    stack = _stack_chunks(dspecs)
+    _, nf, nt = stack.shape
+    time0 = np.asarray(unit_checks(times[0], "time"), dtype=float)
+    freq_a = np.asarray(unit_checks(freq, "freq"), dtype=float)
+    fd = fft_axis(time0, pad=npad, scale=1e3)
+    tau = fft_axis(freq_a, pad=npad, scale=1.0)
+    edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+    fn = _jitted_fused_eval(tau, fd, edges_a, (nf, nt), npad, coher,
+                            float(unit_checks(tau_mask) or 0.0), fw,
+                            method)
+    return _fused_results(fn, stack, etas, freq, times)
+
+
+def _multi_chunk_search_staged(dspecs, freq, times, etas, edges,
+                               fw=0.1, npad=3, coher=True,
+                               tau_mask=0.0, method="auto"):
+    """The pre-fusion jax path: per-chunk host FFT → batched device
+    eigen curve → per-chunk scipy peak fit. Kept as the fused
+    program's parity oracle (tests/test_fused_search.py) and an
+    explicit f64-FFT fallback via ``fused=False``."""
     import jax.numpy as jnp
 
     from .core import cs_to_ri
@@ -233,6 +350,27 @@ def _jitted_thin_eval(tau, fd, edges, edges_arclet, center_cut):
         maxsize=16)
 
 
+def _jitted_fused_thin_eval(tau, fd, edges, edges_arclet, center_cut,
+                            shape, npad, coher, tau_mask, fw):
+    from .batch import make_fused_thin_search_fn
+    from .core import keyed_jit_cache
+
+    nf, nt = shape
+    key = ("fused_thin", tau.tobytes(), fd.tobytes(), edges.tobytes(),
+           edges_arclet.tobytes(), float(center_cut),
+           (int(nf), int(nt)), int(npad), bool(coher),
+           float(tau_mask), float(fw))
+
+    def build():
+        FUSED_CACHE_STATS["builder_calls"] += 1
+        return make_fused_thin_search_fn(
+            tau, fd, edges, edges_arclet, center_cut, nf, nt,
+            npad=npad, coher=coher, tau_mask=tau_mask, fw=fw)
+
+    return keyed_jit_cache(_MULTI_JIT_CACHE, key, build, maxsize=16,
+                           donate_argnums=_chunk_donation())
+
+
 def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
                        centerCut, fw=0.1, npad=3, coher=True,
                        tau_mask=0.0, verbose=False, backend=None):
@@ -256,13 +394,35 @@ def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,
 
 def multi_chunk_search_thin(dspecs, freq, times, etas, edges,
                             edgesArclet, centerCut, fw=0.1, npad=3,
-                            coher=True, tau_mask=0.0, backend=None):
+                            coher=True, tau_mask=0.0, backend=None,
+                            fused=True):
     """Thin-screen search on a batch of same-geometry chunks in one
     device program (the thin counterpart of
     :func:`multi_chunk_search`; reference pool fan-out
-    dynspec.py:1715-1719 over ththmod.py:516)."""
+    dynspec.py:1715-1719 over ththmod.py:516). On jax the default
+    ``fused=True`` path runs pad → fft2 → two-curve θ-θ → Gram
+    singular values → closed-form peak fit as ONE jitted program with
+    the stacked raw chunks as the single transfer; ``fused=False``
+    keeps the staged host-FFT + scipy-peak-fit path (parity
+    oracle)."""
     backend = resolve_backend(backend)
     etas = np.asarray(unit_checks(etas, "etas"), dtype=float)
+
+    if backend != "numpy" and fused:
+        stack = _stack_chunks(dspecs)
+        _, nf, nt = stack.shape
+        time0 = np.asarray(unit_checks(times[0], "time"), dtype=float)
+        freq_a = np.asarray(unit_checks(freq, "freq"), dtype=float)
+        fd = fft_axis(time0, pad=npad, scale=1e3)
+        tau = fft_axis(freq_a, pad=npad, scale=1.0)
+        edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
+        arclet_a = np.asarray(unit_checks(edgesArclet, "edges_arclet"),
+                              dtype=float)
+        fn = _jitted_fused_thin_eval(
+            tau, fd, edges_a, arclet_a,
+            float(unit_checks(centerCut, "center_cut")), (nf, nt),
+            npad, coher, float(unit_checks(tau_mask) or 0.0), fw)
+        return _fused_results(fn, stack, etas, freq, times)
 
     if backend == "numpy":
         out = []
