@@ -342,7 +342,7 @@ def bench_sspec_thth(jax, jnp):
                                        backend="jax")
         chunks = d.reshape(ncf, cf, nct, ct).transpose(0, 2, 1, 3) \
             .reshape(ncf * nct, cf, ct).astype(jnp.float32)
-        eigs, eta, sig, _ = fused_core(chunks, e)
+        eigs, eta, sig, _, _ok = fused_core(chunks, e)
         return sec, eigs, jnp.stack([eta, sig], axis=1)
 
     fvariants = [(jnp.asarray(d, dtype=jnp.float32), e_j)
@@ -979,6 +979,85 @@ def bench_survey_arc(jax, jnp):
     return out
 
 
+def bench_robust_survey(jax, jnp):
+    """Config #6 (robustness, ISSUE 2): the fault-tolerant journaled
+    survey runner over 16 small epochs with 2 fault-injected (NaN
+    pixels / −inf dB) and the first healthy epoch forced down the
+    fallback ladder to the numpy tier. Records the per-run
+    quarantine/fallback counts next to the throughput so a regression
+    in the robustness layer (quarantine leaking, ladder not reached,
+    resume reprocessing) shows up in the bench artifact, and times the
+    journal-resume pass (all 16 epochs served from the journal)."""
+    import shutil
+    import tempfile
+
+    from scintools_tpu.io import MalformedInputError
+    from scintools_tpu.robust import (guards, run_survey,
+                                      tier_failure_hook, TIER_FUSED,
+                                      TIER_STAGED)
+    from scintools_tpu.thth.search import (chunk_geometry,
+                                           multi_chunk_search)
+
+    cw, npad = 32, 1
+    freqs, times, tau, fd, edges = chunk_geometry(
+        nf=cw, nt=cw, npad=npad, n_edges=24)
+    etas = np.linspace(5e-4, 4e-3, 32)
+    n_epochs = 16
+
+    epochs = []
+    for i in range(n_epochs):
+        dyn = make_arc_dynspec(2 * cw, 2 * cw, 2.0, 0.05, 1400.0,
+                               2e-3, n_images=24, seed=100 + i)
+        epochs.append((f"epoch{i:02d}", dyn.astype(np.float32)))
+    from scintools_tpu.robust.faults import (inject_nan_pixels,
+                                             inject_neginf_db)
+
+    epochs[3] = (epochs[3][0], inject_nan_pixels(epochs[3][1],
+                                                 frac=0.05, seed=3))
+    epochs[11] = (epochs[11][0], inject_neginf_db(epochs[11][1]))
+
+    def process(dyn, tier=None):
+        if not np.isfinite(dyn).all():
+            raise MalformedInputError("<synthetic>",
+                                      "non-finite epoch")
+        chunks = [dyn[:cw, :cw], dyn[:cw, cw:], dyn[cw:, :cw],
+                  dyn[cw:, cw:]]
+        chunks = [c - c.mean() for c in chunks]
+        backend = "numpy" if tier == "numpy" else "jax"
+        res = multi_chunk_search(chunks, freqs, [times] * 4, etas,
+                                 edges, npad=npad, backend=backend,
+                                 fused=(tier != TIER_STAGED))
+        return {"eta_median": float(np.nanmedian(
+            [r.eta for r in res])),
+            "n_healthy": int(sum(r.ok == guards.OK for r in res))}
+
+    workdir = tempfile.mkdtemp(prefix="bench_robust_")
+    try:
+        # first healthy epoch falls fused→staged→numpy: 4 injected
+        # failures at retries=1 covers both jax tiers exactly once
+        t0 = time.time()
+        with tier_failure_hook([TIER_FUSED, TIER_STAGED],
+                               max_failures=4):
+            out = run_survey(epochs, process, workdir)
+        t_run = time.time() - t0
+        t0 = time.time()
+        resumed = run_survey(epochs, process, workdir)
+        t_resume = time.time() - t0
+        s = out["summary"]
+        return {
+            "epochs": n_epochs,
+            "jax_s": round(t_run, 3),
+            "epochs_per_sec": round(n_epochs / t_run, 2),
+            "quarantined": s["n_quarantined"],
+            "fallback_counts": dict(s["tier_counts"]),
+            "retries": s["retries"],
+            "resume_s": round(t_resume, 3),
+            "resumed": resumed["summary"]["n_resumed"],
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_sim_batch(jax, jnp):
     """Config #4: 64 Kolmogorov screens → dynspec → sspec, vmapped
     (ref scint_sim.py:169-236). numpy runs the same 64 screens
@@ -1170,6 +1249,7 @@ _EST_S = {
     "survey":        {"acc": 150, "cpu": 120},
     "survey_arc":    {"acc": 180, "cpu": 90},
     "sim_batch":     {"acc": 60,  "cpu": 90},
+    "robust":        {"acc": 60,  "cpu": 60},
     "acf_fit":       {"acc": 60,  "cpu": 60},
     "acf2d":         {"acc": 150, "cpu": 180},
     "scatim":        {"acc": 60,  "cpu": 60},
@@ -1297,6 +1377,7 @@ def main():
         ("survey", bench_survey),
         ("survey_arc", bench_survey_arc),
         ("sim_batch", bench_sim_batch),
+        ("robust", bench_robust_survey),
         ("acf_fit", bench_acf_fit),
         ("acf2d", bench_acf2d_fit),
         ("scatim", bench_scattered_image),

@@ -76,7 +76,10 @@ def _maybe_enable_compilation_cache(jax):
         os.environ[env_key] = str(val)
         try:
             jax.config.update(flag, val)
-        except Exception:
+        except (AttributeError, TypeError, ValueError, RuntimeError):
+            # older jax without this flag (raises AttributeError or
+            # RuntimeError depending on version) — the env var above
+            # still applies where supported; the cache is best-effort
             pass
 
 

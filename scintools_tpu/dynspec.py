@@ -1407,6 +1407,11 @@ class Dynspec:
             self.prep_thetatheta(verbose=verbose)
         self.eta_evo = np.zeros((self.ncf_fit, self.nct_fit))
         self.eta_evo_err = np.zeros((self.ncf_fit, self.nct_fit))
+        # per-chunk health bitmask (robust/guards.py): 0 = healthy,
+        # input/CS bits mark quarantined epochs, curve/peak-fit bits
+        # explain refusals that were previously silent NaNs
+        self.eta_evo_ok = np.zeros((self.ncf_fit, self.nct_fit),
+                                   dtype=int)
         self.f0s = np.zeros(self.ncf_fit)
         self.t0s = np.zeros(self.nct_fit)
         if mesh is not None and self.backend != "numpy":
@@ -1442,6 +1447,7 @@ class Dynspec:
                 for ct, res in enumerate(results):
                     self.eta_evo[cf, ct] = res.eta
                     self.eta_evo_err[cf, ct] = res.eta_sig
+                    self.eta_evo_ok[cf, ct] = res.ok
                     self.f0s[cf] = res.freq_mean
                     self.t0s[ct] = res.time_mean
                 ok = np.isfinite(self.eta_evo[cf])
@@ -1491,6 +1497,7 @@ class Dynspec:
                 cf, ct = divmod(i, self.nct_fit)
                 self.eta_evo[cf, ct] = res.eta
                 self.eta_evo_err[cf, ct] = res.eta_sig
+                self.eta_evo_ok[cf, ct] = res.ok
                 self.f0s[cf] = res.freq_mean
                 self.t0s[ct] = res.time_mean
         else:
@@ -1500,8 +1507,24 @@ class Dynspec:
                                                  verbose=verbose)
                     self.eta_evo[cf, ct] = res.eta
                     self.eta_evo_err[cf, ct] = res.eta_sig
+                    self.eta_evo_ok[cf, ct] = res.ok
                     self.f0s[cf] = res.freq_mean
                     self.t0s[ct] = res.time_mean
+
+        from .robust.guards import BAD_CS, BAD_INPUT
+        from .utils import slog
+
+        n_quar = int(np.sum((self.eta_evo_ok
+                             & (BAD_INPUT | BAD_CS)) != 0))
+        n_refused = int(np.sum((self.eta_evo_ok != 0)
+                               & ((self.eta_evo_ok
+                                   & (BAD_INPUT | BAD_CS)) == 0)))
+        slog.log_event("thetatheta.health",
+                       chunks=int(self.eta_evo_ok.size),
+                       quarantined=n_quar, refused=n_refused)
+        if verbose and n_quar:
+            print(f"fit_thetatheta: {n_quar} chunk(s) quarantined "
+                  "(non-finite input/CS power; see eta_evo_ok)")
 
         f0s = self.f0s[:, None]
         # zero per-chunk errors (degenerate parabola fits on noise
@@ -1639,11 +1662,18 @@ class Dynspec:
                                  jnp.asarray(np.stack(edges_list)),
                                  jnp.asarray(np.stack(etas_list))))[:B]
 
+        from .robust import guards
+
         for i, (cf, ct, f_m, t_m) in enumerate(meta):
-            eta_fit, eta_sig = fit_eig_peak(etas_list[i], eigs[i],
-                                            fw=self.fw)
+            eta_fit, eta_sig, popt, _, _ = fit_eig_peak(
+                etas_list[i], eigs[i], fw=self.fw, full=True)
             self.eta_evo[cf, ct] = eta_fit
             self.eta_evo_err[cf, ct] = eta_sig
+            fit_ok = popt is not None and np.isfinite(eta_fit)
+            self.eta_evo_ok[cf, ct] = int(guards.health_code(
+                curve_ok=guards.curve_health(
+                    np.asarray(eigs[i], dtype=float)[None]),
+                fit_ok=np.asarray([bool(fit_ok)]))[0])
             self.f0s[cf] = f_m
             self.t0s[ct] = t_m
         if verbose:
@@ -1710,15 +1740,17 @@ class Dynspec:
                 npad=self.npad, coher=coher,
                 tau_mask=self.thth_tau_mask, fw=self.fw)
             _SHARDED_GRID_CACHE[key] = fn
-        _, eta, sig, _ = fn(jnp.asarray(np.stack(chunks)),
-                            jnp.asarray(np.stack(edges_list)),
-                            jnp.asarray(np.stack(etas_list)))
+        _, eta, sig, _, ok = fn(jnp.asarray(np.stack(chunks)),
+                                jnp.asarray(np.stack(edges_list)),
+                                jnp.asarray(np.stack(etas_list)))
         eta = np.asarray(eta)[:B]
         sig = np.asarray(sig)[:B]
+        ok = np.asarray(ok)[:B]
 
         for i, (cf, ct, f_m, t_m) in enumerate(meta):
             self.eta_evo[cf, ct] = eta[i]
             self.eta_evo_err[cf, ct] = sig[i]
+            self.eta_evo_ok[cf, ct] = int(ok[i])
             self.f0s[cf] = f_m
             self.t0s[ct] = t_m
         if verbose:
@@ -2162,7 +2194,18 @@ def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,
             if verbose:
                 print(f"{i + 1}/{len(dynfiles)}\t"
                       f"{os.path.split(dynfile)[1]}")
-            dyn = Dynspec(filename=dynfile, verbose=False, process=False)
+            try:
+                dyn = Dynspec(filename=dynfile, verbose=False,
+                              process=False)
+            except (OSError, ValueError, IndexError, KeyError) as e:
+                # survey mode: a malformed/truncated file is one
+                # rejected epoch with a structured record, never an
+                # uncaught exception that kills the whole sort
+                # (io/psrflux.py:MalformedInputError semantics)
+                _reject(bad_files, dynfile,
+                        f" malformed: {type(e).__name__}: "
+                        f"{str(e)[:120]}")
+                continue
             if dyn.freq > max_freq or dyn.freq < min_freq:
                 msg = (f"freq<{min_freq} " if dyn.freq < min_freq
                        else f"freq>{max_freq}")

@@ -1,10 +1,12 @@
 """I/O: psrflux dynamic spectra, tempo2 .par files, results CSV,
-FITS."""
+FITS. Survey-mode loaders (``survey=True``) raise the epoch-skipping
+:class:`MalformedInputError` on corrupt files; result writers are
+atomic (temp + rename)."""
 
-from .psrflux import load_psrflux, write_psrflux
+from .psrflux import load_psrflux, write_psrflux, MalformedInputError
 from .parfile import read_par, pars_to_params
 from .results import write_results, read_results, float_array_from_dict
 
-__all__ = ["load_psrflux", "write_psrflux", "read_par",
-           "pars_to_params", "write_results", "read_results",
-           "float_array_from_dict"]
+__all__ = ["load_psrflux", "write_psrflux", "MalformedInputError",
+           "read_par", "pars_to_params", "write_results",
+           "read_results", "float_array_from_dict"]

@@ -39,8 +39,21 @@ def _parse_header(fh):
                     header[key] = val.strip("' ")
 
 
-def read_fits_image(path):
-    """Read the primary-HDU image of a simple FITS file → ndarray."""
+def read_fits_image(path, survey=False):
+    """Read the primary-HDU image of a simple FITS file → ndarray.
+
+    ``survey=True`` maps any parse failure (truncated header or data,
+    unsupported BITPIX, missing NAXIS cards) to the epoch-skipping
+    :class:`~scintools_tpu.io.psrflux.MalformedInputError` so a
+    survey loop quarantines the file instead of dying on an opaque
+    KeyError/ValueError."""
+    if survey:
+        from .psrflux import MalformedInputError
+
+        try:
+            return read_fits_image(path, survey=False)
+        except (OSError, ValueError, KeyError, IndexError) as e:
+            raise MalformedInputError(path, repr(e)) from e
     with open(path, "rb") as fh:
         header = _parse_header(fh)
         bitpix = header["BITPIX"]

@@ -15,6 +15,23 @@ from dataclasses import dataclass, field, replace
 import numpy as np
 
 
+class MalformedInputError(ValueError):
+    """A dynamic-spectrum input file that cannot be parsed (truncated,
+    wrong format, inconsistent shape). In survey mode this is the
+    *epoch-skipping* error: the robust runner (robust/runner.py)
+    quarantines the epoch with a structured record and moves on, and
+    the fallback ladder does not descend tiers for it (no tier can
+    fix a corrupt file). Carries the filename and the parse-stage
+    detail."""
+
+    def __init__(self, filename, detail):
+        self.filename = os.fspath(filename) if filename else None
+        self.detail = str(detail)
+        super().__init__(
+            f"malformed dynamic-spectrum input {self.filename!r}: "
+            f"{self.detail} — epoch should be skipped in survey mode")
+
+
 @dataclass
 class RawDynSpec:
     """Plain container for a loaded dynamic spectrum (host numpy arrays).
@@ -67,8 +84,23 @@ class RawDynSpec:
         return out
 
 
-def load_psrflux(filename, mjd=None):
-    """Parse a psrflux file → RawDynSpec. Mirrors dynspec.py:169-218."""
+def load_psrflux(filename, mjd=None, survey=False):
+    """Parse a psrflux file → RawDynSpec. Mirrors dynspec.py:169-218.
+
+    ``survey=True`` converts any parse failure (truncated file, wrong
+    column count, inconsistent nsub×nchan shape, non-numeric rows)
+    into :class:`MalformedInputError` — the clear, epoch-skipping
+    error the robust survey runner quarantines on — instead of
+    whatever numpy/reshape exception the corruption happens to
+    trigger. The default (non-survey) path keeps raw exceptions for
+    interactive debugging."""
+    if survey:
+        try:
+            return load_psrflux(filename, mjd=mjd, survey=False)
+        except MalformedInputError:
+            raise
+        except (OSError, ValueError, IndexError, KeyError) as e:
+            raise MalformedInputError(filename, repr(e)) from e
     head = []
     file_mjd = None
     with open(filename, "r") as fh:
@@ -112,28 +144,33 @@ def load_psrflux(filename, mjd=None):
 
 def write_psrflux(ds, filename, note=None):
     """Write RawDynSpec (or any object with the same attrs) to a psrflux
-    file, with provenance header (dynspec.py:330-376 semantics)."""
-    with open(filename, "w") as fn:
-        # header text matches the reference byte-for-byte
-        # (tests/test_golden_reference.py pins the written file), so
-        # files produced here are indistinguishable downstream
-        fn.write("# Scintools-modified dynamic spectrum "
-                 "in psrflux format\n")
-        fn.write("# Created using write_file method in Dynspec class\n")
-        if note is not None:
-            fn.write(f"# Note: {note}\n")
-        fn.write(f"# MJD0: {ds.mjd}\n")
-        fn.write("# Original header begins below:\n")
-        has_isub = False
-        for line in ds.header:
-            fn.write(f"# {line} \n")
-            if "isub" in line:
-                has_isub = True
-        if not has_isub:
-            fn.write("# isub ichan time(min) freq(MHz) flux flux_err\n")
-        for i, ti in enumerate(np.asarray(ds.times) / 60):
-            for j, fi in enumerate(ds.freqs):
-                fn.write(f"{i} {j} {ti} {fi} {ds.dyn[j, i]} {0}\n")
+    file, with provenance header (dynspec.py:330-376 semantics).
+    Written atomically (temp + rename) so an interrupted survey never
+    leaves a half-epoch file that poisons a later :func:`load_psrflux`.
+    """
+    # header text matches the reference byte-for-byte
+    # (tests/test_golden_reference.py pins the written file), so
+    # files produced here are indistinguishable downstream
+    lines = ["# Scintools-modified dynamic spectrum "
+             "in psrflux format",
+             "# Created using write_file method in Dynspec class"]
+    if note is not None:
+        lines.append(f"# Note: {note}")
+    lines.append(f"# MJD0: {ds.mjd}")
+    lines.append("# Original header begins below:")
+    has_isub = False
+    for line in ds.header:
+        lines.append(f"# {line} ")
+        if "isub" in line:
+            has_isub = True
+    if not has_isub:
+        lines.append("# isub ichan time(min) freq(MHz) flux flux_err")
+    for i, ti in enumerate(np.asarray(ds.times) / 60):
+        for j, fi in enumerate(ds.freqs):
+            lines.append(f"{i} {j} {ti} {fi} {ds.dyn[j, i]} {0}")
+    from ..parallel.checkpoint import atomic_write_bytes
+
+    atomic_write_bytes(filename, ("\n".join(lines) + "\n").encode())
 
 
 def concatenate_time(ds1, ds2):

@@ -46,7 +46,13 @@ _ATTR_FOR_COL = {
 
 def write_results(filename, dyn=None):
     """Append a results row, writing the header if the file is new
-    (scint_utils.py:103-202)."""
+    (scint_utils.py:103-202).
+
+    The write is ATOMIC (full-content temp + rename,
+    parallel/checkpoint.py:atomic_write_bytes): a survey killed
+    mid-append leaves either the previous intact CSV or the new one,
+    never a torn row that poisons every later ``read_results`` of the
+    accumulated survey output."""
     header = "name,mjd,freq,bw,tobs,dt,df"
     row = (f"{dyn.name},{dyn.mjd},{dyn.freq},{dyn.bw},{dyn.tobs},"
            f"{dyn.dt},{dyn.df}")
@@ -59,10 +65,15 @@ def write_results(filename, dyn=None):
             a = _ATTR_FOR_COL.get(col, col)
             vals.append(str(getattr(dyn, a, None)))
         row += "," + ",".join(vals)
-    with open(filename, "a+") as outfile:
-        if os.stat(filename).st_size == 0:
-            outfile.write(header + "\n")
-        outfile.write(row + "\n")
+    from ..parallel.checkpoint import atomic_write_bytes
+
+    existing = b""
+    if os.path.exists(filename) and os.stat(filename).st_size > 0:
+        with open(filename, "rb") as fh:
+            existing = fh.read()
+    if not existing:
+        existing = (header + "\n").encode()
+    atomic_write_bytes(filename, existing + (row + "\n").encode())
 
 
 def read_results(filename):

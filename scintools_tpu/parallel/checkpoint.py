@@ -15,9 +15,106 @@ arrays/scalars plus a small metadata dict.
 
 from __future__ import annotations
 
+import json
 import os
+import warnings
+import zlib
 
 import numpy as np
+
+
+def atomic_write_bytes(path, data):
+    """Write ``data`` to ``path`` via write-temp-then-rename in the
+    same directory (``os.replace`` is atomic on POSIX), fsyncing the
+    temp file first — a reader (or a resume after SIGKILL) sees
+    either the old file or the complete new one, never a torn
+    write."""
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path, obj):
+    """Atomic JSON dump (see :func:`atomic_write_bytes`)."""
+    atomic_write_bytes(path, json.dumps(obj).encode())
+
+
+def _line_crc(payload):
+    """CRC32 of a journal record's JSON payload (sans the crc field
+    itself), as zero-padded hex."""
+    return f"{zlib.crc32(payload.encode()):08x}"
+
+
+class EpochJournal:
+    """Append-only per-epoch completion journal (JSONL + CRC32).
+
+    One line per completed epoch: ``{"epoch": id, ..., "crc": hex}``
+    where ``crc`` covers the rest of the record. Appends are flushed
+    and fsynced, so a SIGKILL loses at most the in-flight epoch; the
+    reader skips a torn/corrupt tail line (and warns) instead of
+    refusing the whole journal. A resumed survey takes every journaled
+    record verbatim — re-running only unfinished epochs — which is
+    what makes an interrupted run's results identical to an
+    uninterrupted one (tests/test_robust.py pins this).
+
+    >>> j = EpochJournal(dir / "journal.jsonl")
+    >>> done = j.records()                    # {} on fresh start
+    >>> for epoch in epochs:
+    ...     if epoch.id in done:
+    ...         continue                      # resume: trust journal
+    ...     j.append(epoch.id, result=process(epoch))
+    """
+
+    def __init__(self, path):
+        self.path = os.fspath(path)
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+
+    def append(self, epoch, **fields):
+        """Durably journal one completed epoch (flush + fsync)."""
+        rec = {"epoch": epoch, **fields}
+        payload = json.dumps(rec, default=str)
+        line = json.dumps({**rec, "crc": _line_crc(payload)},
+                          default=str)
+        with open(self.path, "a") as fh:
+            fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def records(self):
+        """``{epoch_id: record}`` for every intact journaled line.
+        Corrupt/torn lines are skipped with a warning; a missing file
+        is an empty journal."""
+        out = {}
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    crc = rec.pop("crc")
+                    if crc != _line_crc(json.dumps(rec, default=str)):
+                        raise ValueError("crc mismatch")
+                except (ValueError, KeyError, TypeError) as e:
+                    warnings.warn(
+                        f"journal {self.path}: skipping corrupt line "
+                        f"{i + 1} ({e})", stacklevel=2)
+                    continue
+                out[rec["epoch"]] = rec
+        return out
+
+    def __contains__(self, epoch):
+        return epoch in self.records()
+
+    def __len__(self):
+        return len(self.records())
 
 
 class SurveyCheckpointer:
@@ -47,12 +144,58 @@ class SurveyCheckpointer:
         """Step of the newest checkpoint, or None."""
         return self._mgr.latest_step()
 
+    # ---- integrity stamps -------------------------------------------
+    # orbax writes each step atomically (tmp dir + rename), but it
+    # cannot detect post-write corruption: bit rot, a partial rsync,
+    # or an operator truncating a file leaves a step that loads as
+    # garbage or crashes restore. Each save is therefore stamped with
+    # a CRC32 + size manifest of every file in the step dir (written
+    # atomically OUTSIDE the step dir, so orbax's own layout is
+    # untouched); restore verifies the stamp before trusting a step.
+
+    def _stamp_path(self, step):
+        return os.path.join(self._dir, "stamps", f"{int(step)}.json")
+
+    def _step_manifest(self, step):
+        root = os.path.join(self._dir, str(int(step)))
+        files = {}
+        for base, _, names in sorted(os.walk(root)):
+            for name in sorted(names):
+                p = os.path.join(base, name)
+                with open(p, "rb") as fh:
+                    data = fh.read()
+                files[os.path.relpath(p, root)] = {
+                    "bytes": len(data),
+                    "crc": f"{zlib.crc32(data):08x}"}
+        return {"step": int(step), "files": files}
+
+    def _write_stamp(self, step):
+        os.makedirs(os.path.join(self._dir, "stamps"), exist_ok=True)
+        atomic_write_json(self._stamp_path(step),
+                          self._step_manifest(step))
+
+    def verify_stamp(self, step):
+        """Check the CRC/size stamp of ``step``'s files. Returns True
+        (intact), False (mismatch/corrupt), or None (no stamp — a
+        pre-stamp checkpoint; treated as trusted for back-compat)."""
+        path = self._stamp_path(step)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as fh:
+                stamp = json.load(fh)
+            return (stamp.get("files")
+                    == self._step_manifest(step)["files"])
+        except (OSError, ValueError):
+            return False
+
     def save(self, step, state, force=True):
         import orbax.checkpoint as ocp
 
         self._mgr.save(int(step), args=ocp.args.StandardSave(state),
                        force=force)
         self._mgr.wait_until_finished()
+        self._write_stamp(step)
 
     def maybe_save(self, step, state):
         """Save when ``step`` hits the cadence; returns True if saved."""
@@ -61,20 +204,64 @@ class SurveyCheckpointer:
             return True
         return False
 
-    def restore(self, step=None, template=None):
-        """Restore the pytree at ``step`` (default: newest). With
-        ``template`` the restored leaves adopt its structure/dtypes."""
+    def _restore_one(self, step, template):
         import orbax.checkpoint as ocp
 
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {self._dir}")
         if template is not None:
             return self._mgr.restore(
                 int(step),
                 args=ocp.args.StandardRestore(template))
         return self._mgr.restore(int(step))
+
+    def restore(self, step=None, template=None):
+        """Restore the pytree at ``step`` (default: newest). With
+        ``template`` the restored leaves adopt its structure/dtypes.
+
+        When the NEWEST checkpoint is corrupt (stamp mismatch or a
+        restore error — e.g. a file truncated after the process died),
+        restore falls back to the next-older step with a warning
+        instead of crashing the resume: losing ``every`` epochs of
+        progress beats losing the run. An explicitly requested
+        ``step`` never falls back."""
+        explicit = step is not None
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        candidates = ([int(step)] if explicit else
+                      sorted((int(s) for s in self._mgr.all_steps()
+                              if int(s) <= int(step)), reverse=True))
+        last_exc = None
+        for s in candidates:
+            if self.verify_stamp(s) is False:
+                last_exc = ValueError(
+                    f"checkpoint step {s} failed its CRC stamp")
+            else:
+                try:
+                    return self._restore_one(s, template)
+                except Exception as e:  # noqa: BLE001 — see fallback
+                    last_exc = e
+            if not explicit:
+                from ..utils import slog
+
+                warnings.warn(
+                    f"checkpoint step {s} in {self._dir} is corrupt "
+                    f"({last_exc}); falling back to the previous "
+                    "step", stacklevel=2)
+                slog.log_failure("checkpoint.corrupt", stage="restore",
+                                 error=last_exc, step=s)
+        raise last_exc if explicit else FileNotFoundError(
+            f"no intact checkpoint in {self._dir} "
+            f"(last error: {last_exc})")
+
+    def restore_or_none(self, step=None, template=None):
+        """Like :func:`restore` but returns None when no (intact)
+        checkpoint exists — the fresh-start branch of a resume loop
+        without exception plumbing."""
+        try:
+            return self.restore(step=step, template=template)
+        except FileNotFoundError:
+            return None
 
     def close(self):
         self._mgr.close()

@@ -57,8 +57,10 @@ def make_fused_grid_search_sharded(mesh, tau, fd, n_edges, nf, nt,
                                    fw=0.1, iters=64):
     """FUSED whole θ-θ chunk grid sharded over the device mesh:
     ``fn(dspecs[B, nf, nt], edges[B, n_edges], etas[B, neta]) →
-    (eigs[B, neta], eta[B], eta_sig[B], popt[B, 3])`` with the chunk
-    axis B split across every device.
+    (eigs[B, neta], eta[B], eta_sig[B], popt[B, 3], ok[B])`` with the
+    chunk axis B split across every device; ``ok`` is the per-chunk
+    int32 health bitmask (robust/guards.py) — corrupt epochs are
+    quarantined in-batch, their lanes NaN'd, the rest untouched.
 
     Unlike :func:`make_thth_grid_search_sharded` (which takes
     host-precomputed conjugate spectra), this takes the RAW
@@ -87,7 +89,8 @@ def make_fused_grid_search_sharded(mesh, tau, fd, n_edges, nf, nt,
         kwargs["donate_argnums"] = (0,)
     return jax.jit(fn,
                    in_shardings=chunk_shardings(mesh, (3, 2, 2)),
-                   out_shardings=chunk_shardings(mesh, (2, 1, 1, 2)),
+                   out_shardings=chunk_shardings(mesh,
+                                                 (2, 1, 1, 2, 1)),
                    **kwargs)
 
 

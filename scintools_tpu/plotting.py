@@ -15,7 +15,8 @@ def _mpl():
             _mpl, "_interactive"):
         try:
             matplotlib.use("Agg", force=False)
-        except Exception:
+        except (ImportError, ValueError):
+            # backend already initialised interactively — keep it
             pass
     import matplotlib.pyplot as plt
     return plt

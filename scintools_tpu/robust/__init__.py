@@ -1,0 +1,51 @@
+"""Fault tolerance for survey-scale runs.
+
+A production survey (thousands of dynamic-spectrum epochs sharded over
+a mesh) must survive the failure modes the reference's pool fan-out
+cannot: one non-finite epoch poisoning a batch, a malformed input file
+killing the run, a compile/OOM error on one geometry, or the whole
+process dying mid-survey. This package is that layer (survey-scale GPU
+pulsar searches hold up in production only because per-candidate
+failures are isolated and runs are restartable — Dimoudi et al. 2017,
+arXiv:1711.10855; Adámek & Armour 2018, arXiv:1804.05335):
+
+- :mod:`.guards` — device-side health flags: every chunk of a fused
+  θ-θ program gets an ``ok`` bitmask (non-finite input, non-finite CS
+  power, degenerate eigen curve, refused peak fit) so bad epochs are
+  quarantined in-batch instead of silently fitting garbage;
+- :mod:`.ladder` — tiered per-epoch fallback (fused jax → staged jax
+  oracle → numpy reference) with bounded retries and batch-halving on
+  transient compile/OOM errors, every transition a structured slog
+  record;
+- :mod:`.faults` — the deterministic fault-injection harness the
+  robustness tests drive (NaN pixels, −inf dB epochs, truncated chunk
+  stacks, simulated per-tier failures via a monkeypatchable hook);
+- :mod:`.runner` — the journaled survey runner: per-epoch quarantine,
+  ladder dispatch, and resume from the completion journal
+  (parallel/checkpoint.py:EpochJournal) so a SIGKILL mid-run loses at
+  most the in-flight epoch.
+
+See docs/robustness.md for the failure model and resume workflow.
+"""
+
+from .guards import (OK, BAD_INPUT, BAD_CS, BAD_CURVE, BAD_PEAKFIT,
+                     describe_health, chunk_finite_ok, sanitize_chunks,
+                     curve_health, health_code)
+from .ladder import (TIER_FUSED, TIER_STAGED, TIER_NUMPY, LadderError,
+                     is_transient, run_ladder, thth_search_ladder)
+from .faults import (inject_nan_pixels, inject_neginf_db,
+                     truncate_chunk_stack, corrupt_file_tail,
+                     tier_failure_hook, maybe_fail)
+from .runner import EpochOutcome, run_survey
+from ..parallel.checkpoint import EpochJournal
+
+__all__ = [
+    "OK", "BAD_INPUT", "BAD_CS", "BAD_CURVE", "BAD_PEAKFIT",
+    "describe_health", "chunk_finite_ok", "sanitize_chunks",
+    "curve_health", "health_code",
+    "TIER_FUSED", "TIER_STAGED", "TIER_NUMPY", "LadderError",
+    "is_transient", "run_ladder", "thth_search_ladder",
+    "inject_nan_pixels", "inject_neginf_db", "truncate_chunk_stack",
+    "corrupt_file_tail", "tier_failure_hook", "maybe_fail",
+    "EpochOutcome", "run_survey", "EpochJournal",
+]

@@ -1,0 +1,110 @@
+"""Device-side per-chunk health flags for the fused θ-θ programs.
+
+The fused search (thth/batch.py) runs a whole chunk batch as one
+vmapped device program. The lanes are mathematically independent, but
+before this module a corrupt epoch failed *silently*: a NaN chunk was
+zeroed by the gather's ``nan_to_num``, a −inf dB epoch turned into a
+finite-but-meaningless eigen curve, and a singular peak-fit system
+produced NaN with no machine-readable cause. Every fused program now
+also returns an ``ok[B]`` int32 bitmask per chunk (0 = healthy), built
+from traced-safe reductions that add two cheap per-lane ``all``\\ s and
+change nothing for healthy lanes:
+
+====================  =====  ==============================================
+flag                  bit    meaning
+====================  =====  ==============================================
+``BAD_INPUT``         1      raw chunk had non-finite pixels (NaN / ±inf)
+``BAD_CS``            2      conjugate-spectrum power went non-finite
+``BAD_CURVE``         4      eigen curve degenerate (<3 finite, or flat)
+``BAD_PEAKFIT``       8      peak fit refused (singular 3×3 normal
+                             equations, <3 window points, vertex gate)
+====================  =====  ==============================================
+
+Quarantine semantics: lanes with input-level corruption (``BAD_INPUT``
+or ``BAD_CS``) get their fitted ``(eta, eta_sig, popt)`` forced to NaN
+inside the program — a finite-looking fit of a corrupt epoch must
+never reach the global η(f) fit. ``BAD_CURVE``/``BAD_PEAKFIT`` are
+*diagnostic*: the peak fit's own refusal gates already NaN those
+outputs exactly where the host path would (tests/test_fused_search.py
+pins that parity), so the bits only say *why*. Non-finite input pixels
+are zeroed (:func:`sanitize_chunks`) before the FFT so a single NaN
+cannot grow into an all-NaN CS whose downstream cost is paid by every
+consumer of the batch.
+
+Host-side counterparts of the same bits are computed by the staged and
+numpy search paths (thth/search.py) so a
+:class:`~scintools_tpu.thth.search.ChunkSearchResult` carries the same
+``ok`` code on every tier of the fallback ladder (robust/ladder.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+OK = 0
+BAD_INPUT = 1
+BAD_CS = 2
+BAD_CURVE = 4
+BAD_PEAKFIT = 8
+
+_NAMES = {BAD_INPUT: "input_nonfinite", BAD_CS: "cs_nonfinite",
+          BAD_CURVE: "curve_degenerate", BAD_PEAKFIT: "peakfit_refused"}
+
+
+def describe_health(code):
+    """Human/slog-readable decode of an ``ok`` bitmask: ``0 → ['ok']``,
+    ``5 → ['input_nonfinite', 'curve_degenerate']``."""
+    code = int(code)
+    if code == OK:
+        return ["ok"]
+    return [name for bit, name in sorted(_NAMES.items())
+            if code & bit]
+
+
+def chunk_finite_ok(arrs, xp=np):
+    """Per-chunk all-finite reduction: ``arrs[B, ...] → ok[B]`` bool.
+    Traced-safe (pass ``xp=jnp`` inside a program)."""
+    a = xp.asarray(arrs)
+    return xp.all(xp.isfinite(a), axis=tuple(range(1, a.ndim)))
+
+
+def sanitize_chunks(arrs, xp=np):
+    """Zero non-finite pixels so one corrupt lane cannot blow up the
+    batched FFT (NaN·0 = NaN spreads through every fft2 output of its
+    own lane; ±inf additionally overflows the f32 accumulator). The
+    lane is already condemned by its ``BAD_INPUT`` bit — the zeros
+    just make its downstream cost bounded and deterministic."""
+    a = xp.asarray(arrs)
+    return xp.where(xp.isfinite(a), a, xp.zeros((), dtype=a.dtype))
+
+
+def curve_health(eigs, xp=np):
+    """Per-chunk eigen-curve health: ``eigs[B, neta] → ok[B]`` bool.
+    A curve is degenerate when fewer than 3 finite points survive (the
+    peak fit's own minimum) or when it is flat (max == min over finite
+    points — an all-zero θ-θ batch from a blanked chunk), which would
+    make the 3×3 normal equations singular."""
+    e = xp.asarray(eigs)
+    finite = xp.isfinite(e)
+    n_fin = xp.sum(finite, axis=1)
+    big = xp.asarray(np.inf, e.dtype)
+    hi = xp.max(xp.where(finite, e, -big), axis=1)
+    lo = xp.min(xp.where(finite, e, big), axis=1)
+    return (n_fin >= 3) & (hi > lo)
+
+
+def health_code(input_ok=None, cs_ok=None, curve_ok=None, fit_ok=None,
+                xp=np):
+    """Combine per-chunk boolean health flags into the int32 bitmask
+    (``None`` stages contribute nothing). All arguments are ``[B]``
+    bool arrays (traced-safe)."""
+    code = None
+    for ok, bit in ((input_ok, BAD_INPUT), (cs_ok, BAD_CS),
+                    (curve_ok, BAD_CURVE), (fit_ok, BAD_PEAKFIT)):
+        if ok is None:
+            continue
+        term = xp.where(xp.asarray(ok), 0, bit).astype("int32")
+        code = term if code is None else code | term
+    if code is None:
+        raise ValueError("health_code needs at least one stage flag")
+    return code

@@ -1,0 +1,190 @@
+"""Tiered per-epoch fallback ladder for the survey path.
+
+One epoch's θ-θ search can fail three distinct ways, each wanting a
+different response:
+
+1. **transient environment errors** — an XLA compile failure or OOM
+   (``RuntimeError``) on one geometry. Response: bounded retries,
+   then *batch-halving* (an OOM on a B-chunk stack often clears at
+   B/2), then the next tier.
+2. **tier-specific bugs/limits** — the fused program rejects a
+   geometry the staged path handles. Response: drop a tier. The
+   ladder is fused jax → staged jax (``fused=False`` parity oracle)
+   → numpy reference path, i.e. each tier is strictly simpler and
+   closer to the reference semantics than the one above it.
+3. **corrupt data** — non-finite inputs, malformed files. No tier
+   can fix those: the device guards (robust/guards.py) NaN the epoch
+   and the runner quarantines it; the ladder does NOT descend (the
+   numpy path would just burn minutes refusing identically).
+
+Every transition emits one structured slog failure record with the
+canonical fields (epoch id, stage, error class, tier, retry count —
+utils/slog.py:log_failure), so a run summary is a grep. The
+fault-injection hook (robust/faults.py:maybe_fail) is consulted
+before every attempt, which is how the tests drive tiers to fail
+deterministically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import faults
+from ..utils import slog
+
+TIER_FUSED = "jax_fused"
+TIER_STAGED = "jax_staged"
+TIER_NUMPY = "numpy"
+
+# substrings marking a RuntimeError as a transient environment fault
+# (XLA compile/OOM/tunnel) — worth retrying and batch-halving. JAX
+# raises XlaRuntimeError (a RuntimeError subclass) with these codes.
+_TRANSIENT_MARKERS = ("resource_exhausted", "out of memory", "oom",
+                      "compile", "compilation", "deadline_exceeded",
+                      "unavailable", "internal:", "injected fault")
+
+
+class LadderError(RuntimeError):
+    """Every tier of the fallback ladder failed for one epoch. Carries
+    the per-attempt records so the caller can quarantine with a full
+    explanation instead of a bare traceback."""
+
+    def __init__(self, epoch, stage, attempts):
+        self.epoch = epoch
+        self.stage = stage
+        self.attempts = list(attempts)
+        last = attempts[-1] if attempts else None
+        super().__init__(
+            f"all {len({a['tier'] for a in attempts})} tiers failed "
+            f"for epoch {epoch!r} (stage {stage!r}); last: "
+            f"{last['error_class'] if last else '?'}: "
+            f"{last['error'] if last else '?'}")
+
+
+def _is_fatal(exc):
+    """Errors no tier can fix (corrupt/malformed input): the ladder
+    aborts instead of burning the slower tiers on the same file."""
+    from ..io import MalformedInputError
+
+    return isinstance(exc, MalformedInputError)
+
+
+def is_transient(exc):
+    """True for RuntimeErrors that look like transient environment
+    faults (compile/OOM/tunnel) — the class the ladder retries and
+    batch-halves. Everything else (ValueError from bad geometry,
+    MalformedInputError from a bad file, ...) fails the tier at
+    once."""
+    if not isinstance(exc, RuntimeError):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+@dataclass
+class LadderReport:
+    """What it took to produce one epoch's result."""
+
+    tier: str = ""            # tier that finally succeeded
+    retries: int = 0          # total failed attempts across tiers
+    halved: bool = False      # batch-halving was needed
+    attempts: list = field(default_factory=list)  # failure records
+
+
+def _record(report, epoch, stage, tier, exc, retry):
+    rec = {"epoch": epoch, "stage": stage, "tier": tier,
+           "error_class": type(exc).__name__,
+           "error": str(exc)[:300], "retry": retry}
+    report.attempts.append(rec)
+    report.retries += 1
+    slog.log_failure("robust.fallback", epoch=epoch, stage=stage,
+                     error=exc, tier=tier, retry=retry)
+
+
+def run_ladder(tiers, epoch=None, stage="search", retries=1,
+               report=None):
+    """Run ``tiers`` — an ordered list of ``(name, callable)`` — until
+    one succeeds. Transient failures (:func:`is_transient`) are
+    retried up to ``retries`` extra times on the SAME tier before
+    descending; non-transient failures descend immediately. Returns
+    ``(value, LadderReport)``; raises :class:`LadderError` when every
+    tier is exhausted."""
+    report = report or LadderReport()
+    for name, fn in tiers:
+        attempt = 0
+        while True:
+            try:
+                faults.maybe_fail(name, epoch=epoch, stage=stage)
+                value = fn()
+            except Exception as exc:  # noqa: BLE001 — ladder boundary
+                _record(report, epoch, stage, name, exc, attempt)
+                if _is_fatal(exc):
+                    raise LadderError(epoch, stage, report.attempts)
+                if is_transient(exc) and attempt < int(retries):
+                    attempt += 1
+                    continue
+                break  # next tier
+            report.tier = name
+            return value, report
+    raise LadderError(epoch, stage, report.attempts)
+
+
+def _halved(fn_batch, dspecs, times, depth=3):
+    """Run ``fn_batch(dspecs, times)`` with recursive batch-halving on
+    transient errors: an OOM on B chunks often clears at B/2 (half
+    the θ-θ batch resident per program). Depth-bounded; re-raises
+    when halving bottoms out at single chunks."""
+    try:
+        return fn_batch(dspecs, times)
+    except Exception as exc:  # noqa: BLE001 — halving boundary
+        if not is_transient(exc) or depth <= 0 or len(dspecs) <= 1:
+            raise
+        mid = len(dspecs) // 2
+        left = _halved(fn_batch, dspecs[:mid], times[:mid],
+                       depth=depth - 1)
+        right = _halved(fn_batch, dspecs[mid:], times[mid:],
+                        depth=depth - 1)
+        return list(left) + list(right)
+
+
+def thth_search_ladder(dspecs, freq, times, etas, edges, fw=0.1,
+                       npad=3, coher=True, tau_mask=0.0, epoch=None,
+                       retries=1, halve=True, tiers=None):
+    """The θ-θ chunk-batch search behind the full fallback ladder:
+    fused jax program → staged jax (``fused=False`` oracle) → numpy
+    reference path, with bounded retries and batch-halving on
+    transient compile/OOM RuntimeErrors. Same signature semantics as
+    ``thth.search.multi_chunk_search``; returns
+    ``(results, LadderReport)`` where ``results`` is the usual list of
+    ``ChunkSearchResult``. ``tiers`` restricts the ladder (default:
+    all three, in order)."""
+    from ..thth.search import multi_chunk_search
+
+    kw = dict(fw=fw, npad=npad, coher=coher, tau_mask=tau_mask)
+
+    def batch_fn(fused, backend):
+        def run(ds, ts):
+            return multi_chunk_search(list(ds), freq, list(ts), etas,
+                                      edges, backend=backend,
+                                      fused=fused, **kw)
+
+        return run
+
+    def tier_call(fused, backend):
+        fn = batch_fn(fused, backend)
+        if halve:
+            return lambda: _halved(fn, list(dspecs), list(times))
+        return lambda: fn(list(dspecs), list(times))
+
+    all_tiers = [
+        (TIER_FUSED, tier_call(True, "jax")),
+        (TIER_STAGED, tier_call(False, "jax")),
+        (TIER_NUMPY, tier_call(True, "numpy")),
+    ]
+    if tiers is not None:
+        want = list(tiers)
+        all_tiers = [t for t in all_tiers if t[0] in want]
+    return run_ladder(all_tiers, epoch=epoch, stage="thth_search",
+                      retries=retries)

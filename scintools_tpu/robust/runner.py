@@ -1,0 +1,182 @@
+"""Fault-tolerant, journaled survey runner.
+
+The production shape of a survey is "for each of ~10³ epochs: load →
+search → fit → append results". The naive loop dies with the first
+malformed file, poisons its batch with the first non-finite epoch, and
+loses everything to a preemption. This runner wraps the loop with the
+three robustness layers of this package:
+
+- **per-epoch quarantine** — an epoch whose loader raises
+  :class:`~scintools_tpu.io.MalformedInputError`, whose every
+  fallback tier fails, or whose result a validator rejects is recorded
+  as quarantined (structured slog record + journal line) and the
+  survey moves on. Healthy epochs are never touched by a bad
+  neighbour: each epoch is processed independently and journaled
+  results are bitwise what ``process`` returned.
+- **tiered fallback** — ``process(payload, tier=...)`` is dispatched
+  through the ladder (robust/ladder.py): fused jax → staged jax →
+  numpy, bounded retries on transient compile/OOM errors, every
+  transition one slog failure record.
+- **journaled resume** — every completed epoch is one fsynced
+  CRC-stamped JSONL line (parallel/checkpoint.py:EpochJournal). A
+  rerun after SIGKILL takes journaled records verbatim and processes
+  only unfinished epochs, so the resumed run's results are identical
+  to an uninterrupted run (tests/test_robust.py pins this, including
+  a real SIGKILL).
+
+Use :class:`~scintools_tpu.parallel.checkpoint.SurveyCheckpointer`
+alongside when the loop also carries large array state; the journal
+covers the per-epoch scalar results and progress cursor.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field
+
+from . import ladder as _ladder
+from ..parallel.checkpoint import EpochJournal
+from ..utils import slog
+
+_DEFAULT_TIERS = (_ladder.TIER_FUSED, _ladder.TIER_STAGED,
+                  _ladder.TIER_NUMPY)
+
+
+@dataclass
+class EpochOutcome:
+    """One epoch's fate: ``status`` is 'ok', 'quarantined', or
+    'resumed' (taken verbatim from the journal)."""
+
+    epoch: object
+    status: str
+    tier: str = ""
+    retries: int = 0
+    error: str = ""
+    error_class: str = ""
+    result: dict = field(default_factory=dict)
+
+
+def _is_malformed(exc):
+    from ..io import MalformedInputError
+
+    return isinstance(exc, MalformedInputError)
+
+
+def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
+               retries=1, validate=None, journal_name="journal.jsonl",
+               resume=True):
+    """Process ``epochs`` — an iterable of ``(epoch_id, payload)`` —
+    fault-tolerantly, journaling each completion to
+    ``workdir/journal_name``.
+
+    ``process(payload, tier=<name>)`` produces one epoch's result as
+    a dict of JSON-able scalars; it is attempted through the fallback
+    ``tiers`` in order (bounded ``retries`` on transient
+    compile/OOM RuntimeErrors per tier, robust/ladder.py semantics).
+    A :class:`~scintools_tpu.io.MalformedInputError` quarantines the
+    epoch immediately (no tier can fix a corrupt file); exhaustion of
+    every tier quarantines it with the full attempt trail. A
+    ``validate(result) -> bool`` hook (optional) rejects a tier's
+    result — e.g. require the device health bitmask be clean — and
+    sends the epoch down to the next tier.
+
+    Returns ``{"results": {epoch_id: result_dict},
+    "outcomes": [EpochOutcome...], "summary": {...}}`` where summary
+    counts ok/quarantined/resumed epochs, per-tier completions, and
+    total retries. With ``resume=True`` (default), epochs already in
+    the journal are not reprocessed — their journaled results are
+    returned verbatim."""
+    os.makedirs(workdir, exist_ok=True)
+    journal = EpochJournal(os.path.join(workdir, journal_name))
+    done = journal.records() if resume else {}
+
+    outcomes = []
+    results = {}
+    tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
+             "n_resumed": 0, "retries": 0,
+             "tier_counts": {t: 0 for t in tiers}}
+    epochs = list(epochs)
+    with slog.span("survey.robust_run", n_epochs=len(epochs),
+                   workdir=os.fspath(workdir)):
+        for epoch_id, payload in epochs:
+            tally["n_epochs"] += 1
+            key = str(epoch_id)
+            if key in done:
+                rec = done[key]
+                out = EpochOutcome(
+                    epoch=epoch_id, status="resumed",
+                    tier=rec.get("tier", ""),
+                    result=rec.get("result") or {})
+                if rec.get("status") == "quarantined":
+                    tally["n_quarantined"] += 1
+                    out.error = rec.get("error", "")
+                    out.error_class = rec.get("error_class", "")
+                else:
+                    results[key] = out.result
+                tally["n_resumed"] += 1
+                outcomes.append(out)
+                continue
+            out = _run_one(epoch_id, payload, process, tiers, retries,
+                           validate)
+            tally["retries"] += out.retries
+            if out.status == "ok":
+                tally["n_ok"] += 1
+                tally["tier_counts"][out.tier] = \
+                    tally["tier_counts"].get(out.tier, 0) + 1
+                results[key] = out.result
+                journal.append(key, status="ok", tier=out.tier,
+                               retries=out.retries, result=out.result)
+            else:
+                tally["n_quarantined"] += 1
+                journal.append(key, status="quarantined",
+                               tier=out.tier, retries=out.retries,
+                               error=out.error,
+                               error_class=out.error_class)
+            outcomes.append(out)
+        slog.log_event("survey.robust_summary", **{
+            k: v for k, v in tally.items() if k != "tier_counts"},
+            tier_counts=dict(tally["tier_counts"]))
+    return {"results": results, "outcomes": outcomes,
+            "summary": tally}
+
+
+def _run_one(epoch_id, payload, process, tiers, retries, validate):
+    """Dispatch one epoch through the ladder; never raises."""
+
+    def tier_fn(name):
+        def run():
+            result = process(payload, tier=name)
+            if validate is not None and not validate(result):
+                raise ValueError(
+                    f"validator rejected tier {name} result for "
+                    f"epoch {epoch_id!r}")
+            return result
+
+        return run
+
+    try:
+        value, report = _ladder.run_ladder(
+            [(t, tier_fn(t)) for t in tiers], epoch=epoch_id,
+            stage="process", retries=retries)
+    except _ladder.LadderError as exc:
+        slog.log_failure("robust.quarantine", epoch=epoch_id,
+                         stage="process", error=exc,
+                         tier=exc.attempts[-1]["tier"]
+                         if exc.attempts else None,
+                         retry=len(exc.attempts))
+        last = exc.attempts[-1] if exc.attempts else {}
+        # a malformed input shows up as the same error on every tier;
+        # collapse the trail to the first record's class
+        return EpochOutcome(
+            epoch=epoch_id, status="quarantined",
+            retries=len(exc.attempts),
+            error=last.get("error", str(exc)),
+            error_class=last.get("error_class", "LadderError"))
+    return EpochOutcome(epoch=epoch_id, status="ok", tier=report.tier,
+                        retries=report.retries, result=dict(value))
+
+
+def outcome_dicts(outcomes):
+    """JSON-able view of a list of :class:`EpochOutcome` (for result
+    files / bench records)."""
+    return [asdict(o) for o in outcomes]

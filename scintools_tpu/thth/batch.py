@@ -460,20 +460,30 @@ def resolve_fused_method(method, n_edges):
 
 def _chunk_cs_to_ri(dspecs, npad, tau_keep, power, coher):
     """Traced helper shared by the fused builders: raw chunk stack →
-    packed (real, imag) conjugate spectra, all on device.
+    packed (real, imag) conjugate spectra, all on device, plus the
+    per-chunk input/CS health flags (robust/guards.py).
     ``power`` selects the incoherent base: |CS| for the single-curve
     search, |CS|² for the thin-screen search (reference
-    ththmod.py:741-746 vs :586-590)."""
+    ththmod.py:741-746 vs :586-590). Non-finite input pixels are
+    flagged and zeroed BEFORE the FFT — one NaN pixel otherwise turns
+    its whole lane's conjugate spectrum to NaN, and a −inf dB epoch
+    overflows the f32 accumulator — so a corrupt epoch is quarantined
+    by its flag instead of poisoning its own lane unboundedly.
+    Returns ``(cs_ri[B, 2, ntau, nfd], in_ok[B], cs_ok[B])``."""
     import jax.numpy as jnp
 
     from ..ops.sspec import chunk_conjugate_spectrum_batch
+    from ..robust import guards
 
+    in_ok = guards.chunk_finite_ok(dspecs, xp=jnp)
+    dspecs = guards.sanitize_chunks(dspecs, xp=jnp)
     CS = chunk_conjugate_spectrum_batch(dspecs, npad=npad,
                                         tau_keep=tau_keep, xp=jnp)
     if not coher:
         CS = jnp.abs(CS) ** 2 if power else jnp.abs(CS)
-    return jnp.stack([jnp.real(CS), jnp.imag(CS)],
-                     axis=1).astype(jnp.float32)
+    cs_ri = jnp.stack([jnp.real(CS), jnp.imag(CS)],
+                      axis=1).astype(jnp.float32)
+    return cs_ri, in_ok, guards.chunk_finite_ok(cs_ri, xp=jnp)
 
 
 def _tau_keep_mask(tau, tau_mask):
@@ -483,13 +493,40 @@ def _tau_keep_mask(tau, tau_mask):
     return tau_a, np.abs(tau_a) >= float(unit_checks(tau_mask))
 
 
+def _health_and_quarantine(curves, in_ok, cs_ok, fit_ok, eta, sig,
+                           popt):
+    """Shared fused-program tail: build the per-chunk ``ok[B]`` int32
+    bitmask and NaN the fitted outputs of input-corrupt lanes — a
+    finite-looking η fitted to a sanitised corrupt epoch must never
+    reach the global η(f) fit (robust/guards.py quarantine
+    semantics). Curve/peak-fit bits are diagnostic only: those lanes
+    are already NaN'd by the fit's own refusal gates exactly where
+    the host path refuses."""
+    import jax.numpy as jnp
+
+    from ..robust import guards
+
+    ok = guards.health_code(input_ok=in_ok, cs_ok=cs_ok,
+                            curve_ok=guards.curve_health(curves,
+                                                         xp=jnp),
+                            fit_ok=fit_ok, xp=jnp)
+    healthy_in = in_ok & cs_ok
+    nan = jnp.asarray(np.nan, eta.dtype)
+    eta = jnp.where(healthy_in, eta, nan)
+    sig = jnp.where(healthy_in, sig, nan)
+    popt = jnp.where(healthy_in[:, None], popt, nan)
+    return eta, sig, popt, ok
+
+
 def make_fused_search_fn(tau, fd, edges, nf, nt, npad=3, coher=True,
                          tau_mask=0.0, fw=0.1, iters=200,
                          method="auto", squarings=10, warm_iters=None,
                          interpret=False):
     """The WHOLE per-row curvature search as one device program:
     ``fn(dspecs[B, nf, nt] float, etas[neta]) → (eigs[B, neta],
-    eta[B], eta_sig[B], popt[B, 3])``.
+    eta[B], eta_sig[B], popt[B, 3], ok[B])`` where ``ok`` is the
+    per-chunk int32 health bitmask (robust/guards.py: 0 = healthy;
+    input-corrupt lanes come back NaN-quarantined).
 
     Fuses per-chunk mean-pad → fft2 conjugate spectrum
     (ops/sspec.py:chunk_conjugate_spectrum_batch) → masked θ-θ gather
@@ -529,11 +566,14 @@ def make_fused_search_fn(tau, fd, edges, nf, nt, npad=3, coher=True,
     from .peakfit import fit_eig_peak_batch_device
 
     def fn(dspecs, etas):
-        cs_ri = _chunk_cs_to_ri(dspecs, npad, tau_keep, power=False,
-                                coher=coher)
+        cs_ri, in_ok, cs_ok = _chunk_cs_to_ri(dspecs, npad, tau_keep,
+                                              power=False, coher=coher)
         eigs = multi(cs_ri, etas)
-        eta, sig, popt = fit_eig_peak_batch_device(etas, eigs, fw=fw)
-        return eigs, eta, sig, popt
+        eta, sig, popt, fit_ok = fit_eig_peak_batch_device(
+            etas, eigs, fw=fw, with_ok=True)
+        eta, sig, popt, ok = _health_and_quarantine(
+            eigs, in_ok, cs_ok, fit_ok, eta, sig, popt)
+        return eigs, eta, sig, popt, ok
 
     return fn
 
@@ -543,10 +583,10 @@ def make_fused_thin_search_fn(tau, fd, edges, edges_arclet, center_cut,
                               fw=0.1, iters=200):
     """Thin-screen counterpart of :func:`make_fused_search_fn`:
     ``fn(dspecs[B, nf, nt], etas) → (sigs[B, neta], eta[B],
-    eta_sig[B], popt[B, 3])`` — raw chunks in, two-curvature singular
-    values + closed-form peak fit out, one program
-    (thth/search.py:multi_chunk_search_thin's staged host FFT +
-    scipy fit, fused)."""
+    eta_sig[B], popt[B, 3], ok[B])`` — raw chunks in, two-curvature
+    singular values + closed-form peak fit + per-chunk health bitmask
+    out, one program (thth/search.py:multi_chunk_search_thin's staged
+    host FFT + scipy fit, fused)."""
     get_jax()
 
     tau_a, tau_keep = _tau_keep_mask(tau, tau_mask)
@@ -560,11 +600,14 @@ def make_fused_thin_search_fn(tau, fd, edges, edges_arclet, center_cut,
     from .peakfit import fit_eig_peak_batch_device
 
     def fn(dspecs, etas):
-        cs_ri = _chunk_cs_to_ri(dspecs, npad, tau_keep, power=True,
-                                coher=coher)
+        cs_ri, in_ok, cs_ok = _chunk_cs_to_ri(dspecs, npad, tau_keep,
+                                              power=True, coher=coher)
         sigs = thin(cs_ri, etas)
-        eta, sig, popt = fit_eig_peak_batch_device(etas, sigs, fw=fw)
-        return sigs, eta, sig, popt
+        eta, sig, popt, fit_ok = fit_eig_peak_batch_device(
+            etas, sigs, fw=fw, with_ok=True)
+        eta, sig, popt, ok = _health_and_quarantine(
+            sigs, in_ok, cs_ok, fit_ok, eta, sig, popt)
+        return sigs, eta, sig, popt, ok
 
     return fn
 
@@ -574,7 +617,8 @@ def make_fused_grid_eval_fn(tau, fd, n_edges, nf, nt, npad=3,
                             iters=200):
     """Fused whole-chunk-grid search with per-chunk TRACED geometry:
     ``fn(dspecs[B, nf, nt], edges[B, n_edges], etas[B, neta]) →
-    (eigs[B, neta], eta[B], eta_sig[B], popt[B, 3])``.
+    (eigs[B, neta], eta[B], eta_sig[B], popt[B, 3], ok[B])`` with
+    ``ok`` the per-chunk health bitmask (robust/guards.py).
 
     The traced-geometry counterpart of :func:`make_fused_search_fn`
     (per-row frequency rescales give every chunk its own edges/η —
@@ -594,10 +638,13 @@ def make_fused_grid_eval_fn(tau, fd, n_edges, nf, nt, npad=3,
     from .peakfit import fit_eig_peak_batch_device
 
     def fn(dspecs, edges_b, etas_b):
-        cs_ri = _chunk_cs_to_ri(dspecs, npad, tau_keep, power=False,
-                                coher=coher)
+        cs_ri, in_ok, cs_ok = _chunk_cs_to_ri(dspecs, npad, tau_keep,
+                                              power=False, coher=coher)
         eigs = grid(cs_ri, edges_b, etas_b)
-        eta, sig, popt = fit_eig_peak_batch_device(etas_b, eigs, fw=fw)
-        return eigs, eta, sig, popt
+        eta, sig, popt, fit_ok = fit_eig_peak_batch_device(
+            etas_b, eigs, fw=fw, with_ok=True)
+        eta, sig, popt, ok = _health_and_quarantine(
+            eigs, in_ok, cs_ok, fit_ok, eta, sig, popt)
+        return eigs, eta, sig, popt, ok
 
     return fn

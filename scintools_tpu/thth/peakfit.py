@@ -38,11 +38,19 @@ import numpy as np
 from ..backend import get_jax
 
 
-def fit_eig_peak_device(etas, eigs, fw=0.1):
+def fit_eig_peak_device(etas, eigs, fw=0.1, with_ok=False):
     """Single-curve traced-safe peak fit: ``(etas[neta], eigs[neta])
     → (eta, eta_sig, popt[3])`` with ``popt = (A, x0, C)`` matching
     ``fit_eig_peak(..., full=True)``'s coefficients. NaN-masked; NaN
-    outputs mark a curve the host path would refuse to fit."""
+    outputs mark a curve the host path would refuse to fit.
+
+    ``with_ok=True`` appends the refusal gate itself as an explicit
+    boolean: ``(eta, eta_sig, popt, ok)``. Before this flag a singular
+    3×3 normal-equations system (flat eigen curve → ``solve`` returns
+    non-finite coefficients) was indistinguishable from a too-narrow
+    window in the NaN outputs; ``ok`` makes the refusal
+    machine-readable so the robust survey layer can quarantine and
+    report it (robust/guards.py:BAD_PEAKFIT)."""
     get_jax()
     import jax.numpy as jnp
 
@@ -100,24 +108,34 @@ def fit_eig_peak_device(etas, eigs, fw=0.1):
     # window half-width is kept, matching curve_fit's convergent
     # region (it converges from the data-driven p0 there — including
     # on concave-up windows, whose vertex the host also returns).
+    # the isfinite(x0)/isfinite(A) terms are the explicit singular-
+    # normal-equations gate: a flat or rank-deficient window makes G
+    # singular, jnp.linalg.solve returns non-finite coefficients, and
+    # the fit must REFUSE rather than return NaN with no cause
     ok = ((n_fin >= 3) & (n_sel >= 3) & jnp.isfinite(x0)
           & jnp.isfinite(A) & (jnp.abs(x0 - e_pk) < 2.0 * s))
     nan = jnp.asarray(np.nan, eigs.dtype)
     popt = jnp.where(ok, jnp.stack([A, x0, C]), nan)
-    return jnp.where(ok, x0, nan), jnp.where(ok, sig, nan), popt
+    out = (jnp.where(ok, x0, nan), jnp.where(ok, sig, nan), popt)
+    return out + (ok,) if with_ok else out
 
 
-def fit_eig_peak_batch_device(etas, eigs, fw=0.1):
+def fit_eig_peak_batch_device(etas, eigs, fw=0.1, with_ok=False):
     """Batched closed-form peak fit: ``eigs[B, neta]`` with ``etas``
     either shared ``(neta,)`` or per-chunk ``(B, neta)`` →
-    ``(eta[B], eta_sig[B], popt[B, 3])``. Pure function of traced
-    values — compose it into a fused device program."""
+    ``(eta[B], eta_sig[B], popt[B, 3])`` (plus ``ok[B]`` bool with
+    ``with_ok=True`` — the per-chunk refusal gate, see
+    :func:`fit_eig_peak_device`). Pure function of traced values —
+    compose it into a fused device program."""
     jax = get_jax()
     import jax.numpy as jnp
 
     eigs = jnp.asarray(eigs)
     etas = jnp.asarray(etas)
-    one = lambda e, g: fit_eig_peak_device(e, g, fw=fw)  # noqa: E731
+
+    def one(e, g):
+        return fit_eig_peak_device(e, g, fw=fw, with_ok=with_ok)
+
     if etas.ndim == 1:
         return jax.vmap(one, in_axes=(None, 0))(etas, eigs)
     return jax.vmap(one)(etas, eigs)

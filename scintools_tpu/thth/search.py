@@ -56,6 +56,38 @@ class ChunkSearchResult:
     eigs: np.ndarray    # eigenvalue-vs-η curve (NaN entries stripped)
     etas: np.ndarray    # η grid matching ``eigs``
     popt: np.ndarray = None  # parabola-fit coefficients (A, x0, C)
+    ok: int = 0         # health bitmask (robust/guards.py; 0=healthy)
+
+    @property
+    def healthy(self):
+        """True when every pipeline stage passed its finite guard."""
+        return int(self.ok) == 0
+
+    @property
+    def health(self):
+        """Decoded flag names, e.g. ['input_nonfinite']."""
+        from ..robust.guards import describe_health
+
+        return describe_health(self.ok)
+
+
+def _host_health(dspec, eigs, eta_fit, popt):
+    """Host-side counterpart of the fused program's per-chunk health
+    bitmask (robust/guards.py) for the staged/numpy tiers, so every
+    fallback-ladder tier reports the same ``ok`` code. ``dspec`` is
+    the RAW chunk (pre mean-subtraction NaN strip happens upstream in
+    Dynspec._chunk; here non-finite pixels mean the caller fed a
+    corrupt epoch directly)."""
+    from ..robust import guards
+
+    eigs = np.asarray(eigs, dtype=float)
+    fit_ok = (popt is not None and np.all(np.isfinite(popt))
+              and np.isfinite(eta_fit))
+    in_ok = bool(np.isfinite(np.asarray(dspec)).all())
+    return int(guards.health_code(
+        input_ok=np.asarray([in_ok]),
+        curve_ok=guards.curve_health(eigs[None]),
+        fit_ok=np.asarray([bool(fit_ok)]))[0])
 
 
 def chunk_geometry(nf=64, nt=64, npad=3, dt=2.0, df=0.05, f0=1400.0,
@@ -135,6 +167,18 @@ def fit_eig_peak(etas, eigs, fw=0.1, full=False):
     return out(eta_fit, eta_sig, popt)
 
 
+def _quarantine_host(ok, eta_fit, eta_sig, popt):
+    """Force NaN fits for input-corrupt chunks on the host tiers —
+    the same quarantine rule the fused program applies on device
+    (thth/batch.py:_health_and_quarantine): a finite-looking η from a
+    corrupt epoch must never reach the global η(f) fit."""
+    from ..robust.guards import BAD_INPUT, BAD_CS
+
+    if int(ok) & (BAD_INPUT | BAD_CS):
+        return np.nan, np.nan, None
+    return eta_fit, eta_sig, popt
+
+
 def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
                   coher=True, tau_mask=0.0, verbose=False, backend=None):
     """Curvature search on one chunk (ththmod.py:715-895 semantics,
@@ -150,6 +194,9 @@ def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
     eigs = eval_calc_batch(base, tau, fd, etas, edges, backend=backend)
     eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
         etas, eigs, fw=fw, full=True)
+    ok = _host_health(dspec, eigs, eta_fit, popt)
+    eta_fit, eta_sig, popt = _quarantine_host(ok, eta_fit, eta_sig,
+                                              popt)
     freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
     time = np.asarray(unit_checks(time, "time"), dtype=float)
     if verbose:  # per-chunk result print (ththmod.py:705-711 role)
@@ -159,7 +206,8 @@ def single_search(dspec, freq, time, etas, edges, fw=0.1, npad=3,
     return ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
                              freq_mean=float(freq.mean()),
                              time_mean=float(time.mean()),
-                             eigs=eigs_c, etas=etas_c, popt=popt)
+                             eigs=eigs_c, etas=etas_c, popt=popt,
+                             ok=ok)
 
 
 _MULTI_JIT_CACHE = {}
@@ -223,27 +271,31 @@ def _stack_chunks(dspecs):
 def _fused_results(fn, stack, etas, freq, times):
     """Run a fused search program and unpack its device outputs into
     per-chunk :class:`ChunkSearchResult` (NaN strip + popt gating on
-    host — pure numpy on a few kB, no scipy)."""
+    host — pure numpy on a few kB, no scipy). The device program's
+    per-chunk health bitmask rides along as ``.ok``."""
     import jax.numpy as jnp
 
-    eigs, eta, sig, popt = fn(jnp.asarray(stack), jnp.asarray(etas))
+    eigs, eta, sig, popt, ok = fn(jnp.asarray(stack),
+                                  jnp.asarray(etas))
     eigs = np.asarray(eigs)
     eta = np.asarray(eta)
     sig = np.asarray(sig)
     popt = np.asarray(popt)
+    ok = np.asarray(ok)
     freq_m = float(np.asarray(unit_checks(freq, "freq"),
                               dtype=float).mean())
     out = []
     for b, t in enumerate(times):
-        ok = np.isfinite(eigs[b])
+        fin = np.isfinite(eigs[b])
         t_a = np.asarray(unit_checks(t, "time"), dtype=float)
         out.append(ChunkSearchResult(
             eta=float(eta[b]), eta_sig=float(sig[b]),
             freq_mean=freq_m, time_mean=float(t_a.mean()),
-            eigs=eigs[b][ok].astype(float),
-            etas=np.asarray(etas, dtype=float)[ok],
+            eigs=eigs[b][fin].astype(float),
+            etas=np.asarray(etas, dtype=float)[fin],
             popt=(popt[b].astype(float) if np.isfinite(eta[b])
-                  else None)))
+                  else None),
+            ok=int(ok[b])))
     return out
 
 
@@ -328,12 +380,15 @@ def _multi_chunk_search_staged(dspecs, freq, times, etas, edges,
     for b, t in enumerate(times):
         eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
             etas, eigs_all[b], fw=fw, full=True)
+        ok = _host_health(dspecs[b], eigs_all[b], eta_fit, popt)
+        eta_fit, eta_sig, popt = _quarantine_host(ok, eta_fit,
+                                                  eta_sig, popt)
         t_a = np.asarray(unit_checks(t, "time"), dtype=float)
         out.append(ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
                                      freq_mean=freq_m,
                                      time_mean=float(t_a.mean()),
                                      eigs=eigs_c, etas=etas_c,
-                                     popt=popt))
+                                     popt=popt, ok=ok))
     return out
 
 
@@ -440,13 +495,16 @@ def multi_chunk_search_thin(dspecs, freq, times, etas, edges,
                     eigs[i] = np.nan
             eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
                 etas, eigs, fw=fw, full=True)
+            ok = _host_health(dspec, eigs, eta_fit, popt)
+            eta_fit, eta_sig, popt = _quarantine_host(ok, eta_fit,
+                                                      eta_sig, popt)
             freq_a = np.asarray(unit_checks(freq, "freq"), dtype=float)
             time_a = np.asarray(unit_checks(time, "time"), dtype=float)
             out.append(ChunkSearchResult(
                 eta=eta_fit, eta_sig=eta_sig,
                 freq_mean=float(freq_a.mean()),
                 time_mean=float(time_a.mean()),
-                eigs=eigs_c, etas=etas_c, popt=popt))
+                eigs=eigs_c, etas=etas_c, popt=popt, ok=ok))
         return out
 
     import jax.numpy as jnp
@@ -474,10 +532,13 @@ def multi_chunk_search_thin(dspecs, freq, times, etas, edges,
     for b, t in enumerate(times):
         eta_fit, eta_sig, popt, etas_c, eigs_c = fit_eig_peak(
             etas, sigs[b], fw=fw, full=True)
+        ok = _host_health(dspecs[b], sigs[b], eta_fit, popt)
+        eta_fit, eta_sig, popt = _quarantine_host(ok, eta_fit,
+                                                  eta_sig, popt)
         t_a = np.asarray(unit_checks(t, "time"), dtype=float)
         out.append(ChunkSearchResult(eta=eta_fit, eta_sig=eta_sig,
                                      freq_mean=freq_m,
                                      time_mean=float(t_a.mean()),
                                      eigs=eigs_c, etas=etas_c,
-                                     popt=popt))
+                                     popt=popt, ok=ok))
     return out

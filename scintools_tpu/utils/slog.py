@@ -25,12 +25,19 @@ import json
 import os
 import sys
 import time
+from collections import deque
 from contextlib import contextmanager
 
 _STATE = {
     "path": os.environ.get("SCINTOOLS_LOG") or None,
     "echo": bool(int(os.environ.get("SCINTOOLS_LOG_ECHO", "0"))),
 }
+
+# in-memory tail of recent events, kept even with no sink configured:
+# the robust survey layer reads failure records back for its run
+# summary, and a post-mortem can inspect the last events of a run
+# that never configured a log file. Bounded, so never a leak.
+_RECENT = deque(maxlen=512)
 
 
 def configure(path=None, echo=None):
@@ -47,11 +54,45 @@ def enabled():
     return bool(_STATE["path"] or _STATE["echo"])
 
 
+def recent(n=None, event=None):
+    """The last ``n`` in-memory event records (all when None),
+    optionally filtered by exact event name. Records are kept even
+    when no sink is configured."""
+    recs = list(_RECENT)
+    if event is not None:
+        recs = [r for r in recs if r.get("event") == event]
+    return recs if n is None else recs[-int(n):]
+
+
+def log_failure(event="robust.failure", epoch=None, stage=None,
+                error=None, tier=None, retry=0, **extra):
+    """Structured failure record with the canonical field set the
+    robust survey layer emits on every quarantine / fallback-ladder
+    transition (docs/robustness.md): epoch id, pipeline stage, error
+    class + message, the tier that failed (or None before dispatch),
+    and the retry count. ``error`` may be an exception instance or a
+    string."""
+    fields = {"epoch": epoch, "stage": stage, "tier": tier,
+              "retry": int(retry)}
+    if error is not None:
+        if isinstance(error, BaseException):
+            fields["error_class"] = type(error).__name__
+            fields["error"] = str(error)[:300]
+        else:
+            fields["error_class"] = "str"
+            fields["error"] = str(error)[:300]
+    fields.update(extra)
+    log_event(event, **fields)
+
+
 def log_event(event, **fields):
-    """Emit one structured event. No-op unless a sink is configured."""
+    """Emit one structured event. Always recorded in the in-memory
+    tail (:func:`recent`); written to stderr/file only when a sink is
+    configured."""
+    rec = {"t": round(time.time(), 3), "event": event, **fields}
+    _RECENT.append(rec)
     if not enabled():
         return
-    rec = {"t": round(time.time(), 3), "event": event, **fields}
     line = json.dumps(rec, default=str)
     if _STATE["echo"]:
         print(line, file=sys.stderr)

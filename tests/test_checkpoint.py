@@ -1,8 +1,16 @@
 """Checkpoint/resume tests (parallel/checkpoint.py)."""
 
+import glob
+import json
+import os
+import warnings
+
 import numpy as np
+import pytest
 
 from scintools_tpu.parallel.checkpoint import (SurveyCheckpointer,
+                                               atomic_write_bytes,
+                                               atomic_write_json,
                                                results_state,
                                                run_survey_with_checkpoints)
 
@@ -36,6 +44,71 @@ class TestSurveyCheckpointer:
         back = ckpt.restore()
         np.testing.assert_allclose(back["x"], 4.0)
         ckpt.close()
+
+
+def _truncate_step_file(ckdir, step):
+    """Corrupt the newest checkpoint the way a torn copy would."""
+    files = [p for p in glob.glob(os.path.join(ckdir, str(step),
+                                               "**"), recursive=True)
+             if os.path.isfile(p) and os.path.getsize(p) > 8]
+    with open(files[0], "rb+") as fh:
+        fh.truncate(os.path.getsize(files[0]) - 8)
+
+
+class TestCorruptCheckpointFallback:
+    """ISSUE 2 satellite: a corrupt/truncated NEWEST checkpoint must
+    fall back to the previous step with a warning, not crash the
+    resume; each checkpoint carries a CRC/size stamp."""
+
+    def test_stamp_written_and_verified(self, tmp_path):
+        ck = SurveyCheckpointer(tmp_path / "ck", every=1, keep=3)
+        ck.save(0, {"x": np.arange(3.0)})
+        assert ck.verify_stamp(0) is True
+        stamp = json.load(open(
+            os.path.join(str(tmp_path / "ck"), "stamps", "0.json")))
+        assert stamp["files"]          # per-file {bytes, crc} entries
+        assert all("crc" in f and "bytes" in f
+                   for f in stamp["files"].values())
+        ck.close()
+
+    def test_corrupt_newest_falls_back_with_warning(self, tmp_path):
+        ck = SurveyCheckpointer(tmp_path / "ck", every=1, keep=3)
+        for s in range(3):
+            ck.save(s, {"x": np.full(3, float(s))})
+        _truncate_step_file(str(tmp_path / "ck"), 2)
+        assert ck.verify_stamp(2) is False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            back = ck.restore(template={"x": np.zeros(3)})
+        np.testing.assert_allclose(back["x"], 1.0)  # previous step
+        assert any("corrupt" in str(x.message) for x in w)
+        ck.close()
+
+    def test_explicit_step_never_falls_back(self, tmp_path):
+        ck = SurveyCheckpointer(tmp_path / "ck", every=1, keep=3)
+        for s in range(2):
+            ck.save(s, {"x": np.full(3, float(s))})
+        _truncate_step_file(str(tmp_path / "ck"), 1)
+        with pytest.raises(Exception):
+            ck.restore(step=1, template={"x": np.zeros(3)})
+        ck.close()
+
+    def test_restore_or_none(self, tmp_path):
+        ck = SurveyCheckpointer(tmp_path / "ck", every=1)
+        assert ck.restore_or_none() is None
+        ck.save(0, {"x": np.ones(2)})
+        np.testing.assert_allclose(
+            ck.restore_or_none(template={"x": np.zeros(2)})["x"], 1.0)
+        ck.close()
+
+
+class TestAtomicWrites:
+    def test_atomic_write_replaces_whole_file(self, tmp_path):
+        path = tmp_path / "state.json"
+        atomic_write_json(path, {"a": 1})
+        atomic_write_bytes(path, b'{"a": 2}')
+        assert json.load(open(path)) == {"a": 2}
+        assert not list(tmp_path.glob("*.tmp"))
 
 
 class TestResumableDriver:

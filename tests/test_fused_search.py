@@ -332,17 +332,19 @@ class TestFusedShardedGrid:
         sharded = par.make_fused_grid_search_sharded(
             mesh, tau, fd, len(edges), nf, nt, npad=npad, fw=0.3,
             iters=300)
-        eig_s, eta_s, sig_s, _ = [np.asarray(x) for x in
-                                  sharded(d_b, edges_b, etas_b)]
+        eig_s, eta_s, sig_s, _, ok_s = [np.asarray(x) for x in
+                                        sharded(d_b, edges_b, etas_b)]
         plain = jax.jit(make_fused_grid_eval_fn(
             tau, fd, len(edges), nf, nt, npad=npad, fw=0.3,
             iters=300))
-        eig_p, eta_p, sig_p, _ = [np.asarray(x) for x in
-                                  plain(d_b, edges_b, etas_b)]
+        eig_p, eta_p, sig_p, _, ok_p = [np.asarray(x) for x in
+                                        plain(d_b, edges_b, etas_b)]
         np.testing.assert_allclose(eig_s, eig_p, rtol=1e-4)
         np.testing.assert_allclose(eta_s, eta_p, rtol=1e-5)
         np.testing.assert_allclose(sig_s, sig_p, rtol=1e-4)
         assert np.isfinite(eta_s).all()
+        # clean synthetic arcs: every chunk healthy on both paths
+        assert (ok_s == 0).all() and (ok_p == 0).all()
 
     def test_dynspec_mesh_matches_per_row(self, mesh):
         """End-to-end: the fused sharded fit_thetatheta(mesh=...)

@@ -1,0 +1,61 @@
+"""Exception-hygiene lint as a tier-1 gate (ISSUE 2 satellite).
+
+tools/lint_excepts.py forbids bare ``except:`` and silent
+``except Exception: pass`` in scintools_tpu/ — the two patterns that
+defeat the robust survey layer by hiding failures the quarantine /
+fallback machinery is supposed to see and report."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_excepts", os.path.join(REPO, "tools",
+                                     "lint_excepts.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_is_clean():
+    lint = _lint()
+    violations = lint.scan_tree(os.path.join(REPO, "scintools_tpu"))
+    assert violations == [], (
+        "exception-hygiene violations (bare except / silent "
+        f"swallow-all): {violations}")
+
+
+def test_detector_flags_bare_except():
+    lint = _lint()
+    out = lint.scan_source("try:\n    x()\nexcept:\n    handle()\n")
+    assert len(out) == 1 and "bare" in out[0][1]
+
+
+def test_detector_flags_silent_swallow():
+    lint = _lint()
+    src = ("try:\n    x()\nexcept Exception:\n    pass\n"
+           "try:\n    y()\nexcept Exception as e:\n    ...\n")
+    out = lint.scan_source(src)
+    assert len(out) == 2
+    assert all("swallows" in msg for _, msg in out)
+
+
+def test_detector_allows_handled_broad_and_marker():
+    lint = _lint()
+    src = (
+        "try:\n    x()\nexcept Exception as e:\n    log(e)\n"
+        "try:\n    y()\nexcept ValueError:\n    pass\n"
+        "try:\n    z()\n"
+        "except Exception:  # broad-except-ok: best-effort\n"
+        "    pass\n")
+    assert lint.scan_source(src) == []
+
+
+def test_detector_flags_tuple_form():
+    lint = _lint()
+    src = ("try:\n    x()\nexcept (ValueError, Exception):\n"
+           "    pass\n")
+    assert len(lint.scan_source(src)) == 1
