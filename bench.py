@@ -824,7 +824,9 @@ def bench_acf2d_fit(jax, jnp):
         return fit_acf2d_tpu(make_params(1400.0, 7.5, 0.8, 50.0),
                              y, None, n_iter=60)
 
+    t0 = time.perf_counter()
     res_j = tpu_fit(ydatas[0])               # compile (cached after)
+    t_compile = time.perf_counter() - t0
     t_jax = _time_variants(tpu_fit, [(y,) for y in ydatas[1:]],
                            repeats=3 if full else 1)
     if res_np is not None:
@@ -841,8 +843,14 @@ def bench_acf2d_fit(jax, jnp):
     # consumer reading only the headline number cannot mistake a
     # 2026-07-31 constant for a live baseline
     live = res_np is not None
+    # compile/steady split (bench-honesty satellite, ISSUE 3):
+    # ``speedup`` reflects steady state only; the first-call compile
+    # and the total are recorded alongside
     return {"numpy_s": round(t_np, 3) if live else None,
             "jax_s": round(t_jax, 3),
+            "compile_s": round(t_compile, 3),
+            "steady_s": round(t_jax, 3),
+            "jax_total_s": round(t_compile + t_jax, 3),
             "speedup": round(t_np / t_jax, 2) if live else None,
             "stamped_baseline_s": None if live else t_np,
             "speedup_vs_stamped_baseline":
@@ -850,6 +858,115 @@ def bench_acf2d_fit(jax, jnp):
                 else round(t_np / t_jax, 2),
             "numpy_provenance": numpy_provenance,
             "crop": nc, "params_agree": bool(dtau <= tol)}
+
+
+def bench_acf2d_batch(jax, jnp):
+    """Config #2d (ISSUE 3 tentpole): the survey-native batched acf2d
+    fit — fit_acf2d_batch vmaps the ENTIRE compiled fit (analytic-ACF
+    model, forward-mode jacobian, damped LM, covariance, per-lane
+    ``ok`` health flags) over an epoch axis, one compile + one H2D +
+    one program for the whole stack — against LOOPING the per-epoch
+    ``fit_acf2d_tpu`` entry at ``precision='highest'``, which is the
+    pre-batch algorithm (dense complex Fresnel GEMMs, the exact path
+    the r05 ``acf2d`` config measured). The batch runs its default
+    throughput policy (float32 rows + rank-≲10 SVD kernel); parity is
+    gated per-epoch at the policy's tolerance tier.
+
+    Reports the compile/steady split separately (bench-honesty
+    satellite) and the retrace count across the timed batch calls —
+    the acceptance gate is steady-state epochs/sec ≥5× looped on CPU
+    at 32 epochs with agree_frac == 1.0 and zero retraces."""
+    from scintools_tpu.fit import models as mdl
+    from scintools_tpu.fit.acf2d import (ACF2D_CACHE_STATS,
+                                         fit_acf2d_batch,
+                                         fit_acf2d_tpu)
+    from scintools_tpu.fit.parameters import Parameters
+
+    full = jax.default_backend() != "cpu"
+    B = 32
+    # crop 65 = the r05 acf2d CPU crop (continuity) and a bucket
+    # shape; the dense-vs-lowrank gap grows with crop, measured 5.8×
+    # here on the 1-core fallback host
+    nc = 65
+    # the CPU looped baseline is ~5 s/epoch — time a warm subset and
+    # scale by its per-epoch mean (each loop iteration is an
+    # independent warm execution of the same compiled program, so the
+    # per-epoch cost is constant); the subset size is recorded
+    n_loop = B if full else 6
+
+    def make_params(tau, dnu, amp, psi):
+        pr = Parameters()
+        pr.add("tau", value=tau, vary=True, min=0, max=np.inf)
+        pr.add("dnu", value=dnu, vary=True, min=0, max=np.inf)
+        pr.add("amp", value=amp, vary=True, min=0, max=np.inf)
+        pr.add("alpha", value=5 / 3, vary=False)
+        pr.add("nt", value=2 * nc - 1, vary=False)
+        pr.add("nf", value=2 * nc - 1, vary=False)
+        pr.add("phasegrad", value=0.0, vary=True)
+        pr.add("tobs", value=7200.0, vary=False)
+        pr.add("bw", value=64.0, vary=False)
+        pr.add("ar", value=2.0, vary=False)
+        pr.add("theta", value=0, vary=False)
+        pr.add("psi", value=psi, vary=True)
+        return pr
+
+    rng = np.random.default_rng(13)
+    truth = make_params(tau=1800.0, dnu=6.0, amp=1.0, psi=60.0)
+    clean = -np.asarray(mdl.scint_acf_model_2d(
+        truth, np.zeros((nc, nc)), np.ones((nc, nc))))
+    epochs = np.stack([clean + 0.01 * clean.max()
+                       * rng.standard_normal((nc, nc))
+                       for _ in range(B)])
+    variants = [epochs + 1e-7 * i for i in range(3)]
+    start = make_params(1400.0, 7.5, 0.8, 50.0)
+
+    # ---- looped per-epoch baseline (pre-batch algorithm) ------------
+    fit_acf2d_tpu(start, epochs[0], None, precision="highest")
+    t0 = time.perf_counter()
+    looped = [fit_acf2d_tpu(start, epochs[b], None,
+                            precision="highest")
+              for b in range(n_loop)]
+    t_loop_each = (time.perf_counter() - t0) / n_loop
+
+    # ---- batched: one vmapped program -------------------------------
+    t0 = time.perf_counter()
+    res0, ok0 = fit_acf2d_batch(start, variants[0], None)
+    t_compile = time.perf_counter() - t0
+    builders0 = ACF2D_CACHE_STATS["builder_calls"]
+
+    def run_batch(v):
+        fit_acf2d_batch(start, v, None)
+
+    t_batch = _time_variants(run_batch, [(v,) for v in variants[1:]],
+                             repeats=2)
+    retraces = ACF2D_CACHE_STATS["builder_calls"] - builders0
+
+    # ---- parity (tolerance-tiered for the float32 policy) -----------
+    agree = []
+    for b, res_l in enumerate(looped):
+        ok_lane = True
+        for k in ("tau", "dnu"):
+            vb = res0[b].params[k].value
+            vl = res_l.params[k].value
+            tol = max(0.01 * abs(vl), res_l.params[k].stderr or 0)
+            ok_lane &= abs(vb - vl) <= tol
+        agree.append(ok_lane)
+    eps = B / t_batch
+    eps_loop = 1.0 / t_loop_each
+    return {"epochs": B, "crop": nc,
+            "looped_s_per_epoch": round(t_loop_each, 3),
+            "looped_epochs_timed": n_loop,
+            "looped_policy": "highest (dense, pre-batch algorithm)",
+            "jax_s": round(t_batch, 3),
+            "compile_s": round(t_compile, 3),
+            "steady_s": round(t_batch, 3),
+            "jax_total_s": round(t_compile + t_batch, 3),
+            "epochs_per_sec": round(eps, 2),
+            "looped_epochs_per_sec": round(eps_loop, 2),
+            "speedup_vs_looped": round(eps / eps_loop, 2),
+            "agree_frac": round(float(np.mean(agree)), 3),
+            "retraces": int(retraces),
+            "unhealthy_lanes": int(np.count_nonzero(ok0))}
 
 
 def bench_survey_arc(jax, jnp):
@@ -909,7 +1026,9 @@ def bench_survey_arc(jax, jnp):
     t_pal = None
     pallas_rec = None
     try:
+        t0 = time.perf_counter()
         fits0 = run_batch(variants[0], dev[0])
+        t_compile = time.perf_counter() - t0
         t_jax = _time_variants(run_batch,
                                list(zip(variants[1:], dev[1:])),
                                repeats=3 if full else 1)
@@ -965,6 +1084,9 @@ def bench_survey_arc(jax, jnp):
         <= 0.01 * np.abs(eta_s[both])
     truth_err = np.abs(eta_b[np.isfinite(eta_b)] - eta_true) / eta_true
     out = {"numpy_s": round(t_np, 3), "jax_s": round(t_jax, 3),
+           "compile_s": round(t_compile, 3),
+           "steady_s": round(t_jax, 3),
+           "jax_total_s": round(t_compile + t_jax, 3),
            "speedup": round(t_np / t_jax, 2), "epochs": B,
            "epochs_per_sec": round(B / t_jax, 2),
            "agree_frac": round(float(agree.mean()), 3)
@@ -1251,7 +1373,8 @@ _EST_S = {
     "sim_batch":     {"acc": 60,  "cpu": 90},
     "robust":        {"acc": 60,  "cpu": 60},
     "acf_fit":       {"acc": 60,  "cpu": 60},
-    "acf2d":         {"acc": 150, "cpu": 180},
+    "acf2d":         {"acc": 150, "cpu": 60},
+    "acf2d_batch":   {"acc": 150, "cpu": 200},
     "scatim":        {"acc": 60,  "cpu": 60},
 }
 
@@ -1375,6 +1498,7 @@ def main():
         ("sspec_thth", bench_sspec_thth),
         ("acf_fit_batch", bench_acf_fit_batch),
         ("survey", bench_survey),
+        ("acf2d_batch", bench_acf2d_batch),
         ("survey_arc", bench_survey_arc),
         ("sim_batch", bench_sim_batch),
         ("robust", bench_robust_survey),

@@ -762,9 +762,16 @@ class Dynspec:
                          display=True, filename=None, dpi=200,
                          nan_policy="raise", weighted=True, workers=1,
                          tau_vary_2d=True, tau_input=None, bartlett=True,
-                         get_fit_report=True):
+                         get_fit_report=True, precision=None):
         """Scintillation timescale/bandwidth measurement
-        (dynspec.py:2470-3156)."""
+        (dynspec.py:2470-3156).
+
+        ``precision`` selects the jitted acf2d fit's Fresnel-row
+        policy (fit/acf2d.py: None → the float32/low-rank throughput
+        default, ``'highest'`` → the dense ambient-dtype oracle); the
+        single-epoch fit here and survey batches
+        (fit/acf2d.py:fit_acf2d_batch) share one compiled-program
+        cache either way."""
         methods = ("nofit", "acf1d", "acf2d_approx", "acf2d", "sspec")
         if method not in methods:
             raise ValueError(f"method must be one of {methods}, "
@@ -951,7 +958,8 @@ class Dynspec:
                         from .fit.acf2d import fit_acf2d_tpu
 
                         res = fit_acf2d_tpu(params2d, ydata_2d,
-                                            weights_2d)
+                                            weights_2d,
+                                            precision=precision)
                     else:
                         res = fitter(
                             mdl.scint_acf_model_2d, params2d,

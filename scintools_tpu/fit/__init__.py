@@ -5,13 +5,17 @@ from .parameters import Parameters
 from .fitter import fitter, minimize_leastsq, sample_emcee
 from .ensemble import (sample_emcee_jax, make_ensemble_sampler,
                        make_logp)
-from .lm_jax import make_lm_solver, lm_covariance
+from .lm_jax import make_lm_solver, make_lm_fit_fn, lm_covariance
 from .batch import (make_acf1d_batch, make_acf1d_fit_one,
-                    scint_params_batch, acf_cuts_batch)
+                    scint_params_batch, scint_params_acf2d_batch,
+                    acf_cuts_batch)
+from .acf2d import fit_acf2d_tpu, fit_acf2d_batch
 from . import models
 
 __all__ = ["Parameters", "fitter", "minimize_leastsq", "sample_emcee",
            "sample_emcee_jax", "make_ensemble_sampler", "make_logp",
-           "make_lm_solver", "lm_covariance", "make_acf1d_batch",
-           "make_acf1d_fit_one", "scint_params_batch", "acf_cuts_batch",
+           "make_lm_solver", "make_lm_fit_fn", "lm_covariance",
+           "make_acf1d_batch", "make_acf1d_fit_one",
+           "scint_params_batch", "scint_params_acf2d_batch",
+           "acf_cuts_batch", "fit_acf2d_tpu", "fit_acf2d_batch",
            "models"]

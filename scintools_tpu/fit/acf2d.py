@@ -1,16 +1,44 @@
-"""TPU-resident acf2d fit: jitted analytic-ACF model + jitted LM.
+"""TPU-resident acf2d fit: jitted analytic-ACF model + jitted LM,
+single-epoch AND survey-batched.
 
 The reference's hottest fit (`get_scint_params(method='acf2d')`,
 /root/reference/scintools/dynspec.py:2858-2909) rebuilds the
 theoretical ``ACF`` on the host for every residual evaluation inside
 scipy least-squares (scint_models.py:164-215 → scint_sim.py:417-765).
-Here the model (sim/acf_model.py:make_acf2d_model_fn) and the
-Levenberg–Marquardt loop (fit/lm_jax.py) are ONE compiled program: the
-residual, its forward-mode jacobian over the ~5 varying parameters,
-and the damped normal-equation solve all run on device. Compiled
-solvers are cached on the static fit configuration (crop shape, grid
-sizes, vary set, bounds), so survey workloads with many epochs pay
-one compile.
+Here the model (sim/acf_model.py:make_acf2d_model_core) and the
+Levenberg–Marquardt loop (fit/lm_jax.py:make_lm_fit_fn) are ONE
+compiled program: the residual, its forward-mode jacobian over the ~5
+varying parameters, the damped normal-equation solve, and the
+Gauss-Newton covariance all run on device.
+
+Survey shape (the batched-GPU-solver design of Adámek & Armour 2017,
+arXiv:1711.10855 — batch the WHOLE solver, not the inner kernel):
+:func:`fit_acf2d_batch` vmaps the entire fit over an epoch axis, so N
+epochs cost one compile, one H2D of the stacked crops, and one device
+program, with a per-epoch ``ok[B]`` health bitmask (robust/guards.py
+pattern) quarantining NaN-poisoned crops and singular-normal-equation
+lanes in-batch.
+
+Zero per-epoch recompiles, by construction:
+
+- the per-epoch lag steps ``dt``/``df`` are TRACED inputs of the
+  compiled program (make_acf2d_model_core), so mixed-``tobs``/``bw``
+  surveys share one executable;
+- epoch crops are padded to a small set of bucketed static shapes
+  (``SHAPE_BUCKETS``) with zero-weight borders and per-epoch rescaled
+  lag steps that keep the original lag positions EXACT, so mixed-size
+  surveys cannot blow the 16-entry ``_SOLVER_CACHE``;
+- compiled programs are cached on the static fit configuration only
+  (bucket shape, grid sizes, vary set, bounds, n_iter, policy) and the
+  ``ACF2D_CACHE_STATS`` probe counts builder calls so retraces cannot
+  regress silently (tests/test_acf2d_batch.py).
+
+Precision policy: ``precision='default'`` runs float32/complex64
+Fresnel rows with the static e-field kernel SVD-factorised (rank ≲ 10)
+— the survey throughput path; ``precision='highest'`` is the dense
+ambient-dtype oracle (the pre-batch behaviour). The experimental
+``fresnel_method='czt'`` chirp-Z evaluation keeps the GEMM path as its
+oracle (sim/acf_model.py).
 """
 
 from __future__ import annotations
@@ -19,11 +47,33 @@ import numpy as np
 
 from ..backend import get_jax
 from .fitter import MinimizerResult
-from .lm_jax import make_lm_solver, lm_covariance
+from .lm_jax import make_lm_fit_fn
 
 MODEL_ARGS = ("tau", "dnu", "amp", "phasegrad", "psi", "wn", "alpha")
 
+#: bucketed static crop sizes (odd): a mixed-size survey maps every
+#: epoch crop to the smallest bucket that holds it, so the number of
+#: distinct compiled programs is bounded by the ladder length, not the
+#: number of distinct crop shapes
+SHAPE_BUCKETS = (9, 17, 25, 33, 49, 65, 97, 129, 193, 257)
+
+DEFAULT_PRECISION = "default"
+
 _SOLVER_CACHE = {}
+
+# incremented on every compiled-program BUILD (a cache miss). The
+# retrace-guard test pins that a multi-epoch batch traces once and
+# repeat same-config calls do not rebuild (FUSED_CACHE_STATS pattern,
+# thth/search.py).
+ACF2D_CACHE_STATS = {"builder_calls": 0}
+
+
+def _resolve_precision(precision):
+    p = DEFAULT_PRECISION if precision is None else precision
+    if p not in ("default", "highest"):
+        raise ValueError(f"precision must be 'default' or 'highest' "
+                         f"(or None), got {precision!r}")
+    return p
 
 
 def _spike_zero_weights(weights, shape):
@@ -35,101 +85,338 @@ def _spike_zero_weights(weights, shape):
     return np.fft.ifftshift(w)
 
 
-def _build(nt_crop, nf_crop, dt, df, ar, alpha, theta, tau0, vary,
-           lo, hi, n_iter):
-    """Compile (solver, residual) for one static fit configuration.
+def bucket_crop_size(n):
+    """Smallest shape bucket holding an odd crop size ``n``."""
+    for b in SHAPE_BUCKETS:
+        if b >= n:
+            return b
+    return n
 
-    All per-call data (ydata, weights, triangle taper, fixed model
-    values) flow in as solver ARGUMENTS, so the compiled program is
-    reusable across epochs; only the statics live in the closure.
+
+def make_acf2d_fit_one(nt_crop, nf_crop, ar, alpha, theta, tau0, dt0,
+                       vary, lo, hi, n_iter=60, precision=None,
+                       fresnel_method=None, alpha_varies=False):
+    """Un-jitted single-epoch acf2d fit
+    ``fit_one(x0, y, w, tri, fixed_vec, dtdf) -> dict(x, cost, ok,
+    cov, residual)`` for embedding in larger programs — fit/acf2d.py
+    jits ``vmap(fit_one)`` for the batch entry and
+    parallel/survey.py:make_acf2d_fit_sharded shards the same function
+    over a device mesh. ``ok`` is the int32 health bitmask
+    (robust/guards.py): BAD_INPUT for non-finite crop/weight pixels
+    (lane outputs NaN-quarantined in-program), BAD_FIT for
+    singular/non-finite normal-equation solves.
     """
     jax = get_jax()
     import jax.numpy as jnp
 
-    from ..sim.acf_model import make_acf2d_model_fn
+    from ..robust import guards
+    from ..sim.acf_model import make_acf2d_model_core
 
-    model = make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha,
-                                theta, tau0=tau0)
+    precision = _resolve_precision(precision)
+    fresnel_method = fresnel_method or "gemm"
+    model = make_acf2d_model_core(nt_crop, nf_crop, ar, alpha, theta,
+                                  tau0, dt0, precision=precision,
+                                  alpha_varies=alpha_varies,
+                                  fresnel_method=fresnel_method)
     vary_idx = {n: i for i, n in enumerate(vary)}
 
-    def residual(x, y, w, tri, fixed_vec):
+    def residual(x, y, w, tri, fixed_vec, dtdf):
         vals = [x[vary_idx[n]] if n in vary_idx else fixed_vec[j]
                 for j, n in enumerate(MODEL_ARGS)]
-        m = model(*vals) * tri
+        m = model(*vals[:6], dtdf[0], dtdf[1], alpha=vals[6]) * tri
         return ((y - m) * w).ravel()
 
-    solver = jax.jit(make_lm_solver(residual, n_iter=n_iter,
-                                    bounds=(lo, hi)))
-    # the returned residual is jitted too: the covariance and final
-    # residual evaluations call it directly, and the eager (un-jitted)
-    # complex Fresnel model is UNIMPLEMENTED on the TPU backend —
-    # everything that touches the model must run compiled
-    return solver, jax.jit(residual)
+    jac_fn = None
+    if "amp" in vary_idx:
+        # the residual is LINEAR in amp away from the white-noise
+        # spike, and the spike weight is always zeroed
+        # (_spike_zero_weights) — so amp's jacobian column is exact
+        # from the primal: ∂r/∂amp = -(m/amp)·w = (r - y·w)/amp. One
+        # fewer tangent pass per iteration.
+        amp_i = vary_idx["amp"]
+        others = [i for i in range(len(vary)) if i != amp_i]
+
+        def jac_fn(x, r, y, w, tri, fixed_vec, dtdf):
+            _, jvp = jax.linearize(
+                lambda xx: residual(xx, y, w, tri, fixed_vec, dtdf), x)
+            if others:
+                basis = jnp.eye(len(vary),
+                                dtype=x.dtype)[np.asarray(others)]
+                tang = jax.vmap(jvp)(basis)
+            else:
+                tang = jnp.zeros((0, r.size), r.dtype)
+            amp = x[amp_i]
+            denom = jnp.where(amp == 0, jnp.asarray(1e-30, x.dtype),
+                              amp)
+            amp_col = (r - (y * w).ravel()) / denom
+            cols = []
+            k = 0
+            for i in range(len(vary)):
+                if i == amp_i:
+                    cols.append(amp_col)
+                else:
+                    cols.append(tang[k])
+                    k += 1
+            return jnp.stack(cols, axis=1)
+
+    # the throughput policy takes the xtol step-size exit (outputs
+    # shift at the ~1e-5 level — inside its parity tier); the
+    # 'highest' oracle keeps the fixed-budget reference algorithm —
+    # only the provably output-identical λ-saturation stall exit
+    # (lm_jax.make_lm_fit_fn docstring) applies there
+    lm_fit = make_lm_fit_fn(residual, n_iter=n_iter, bounds=(lo, hi),
+                            jac_fn=jac_fn,
+                            xtol=1e-6 if precision == "default"
+                            else 0.0)
+
+    def fit_one(x0, y, w, tri, fixed_vec, dtdf):
+        input_ok = (jnp.all(jnp.isfinite(y)) & jnp.all(jnp.isfinite(w))
+                    & jnp.all(jnp.isfinite(tri)))
+        out = lm_fit(x0, y, w, tri, fixed_vec, dtdf)
+        code = guards.health_code(input_ok=input_ok,
+                                  fit_ok=out["ok"], xp=jnp)
+        # input-corrupt lanes are NaN-quarantined in-program (PR-2
+        # semantics): a finite-looking fit of a poisoned crop must
+        # never reach the survey results
+        nan = jnp.asarray(np.nan, out["x"].dtype)
+        quar = lambda a: jnp.where(input_ok, a, nan)  # noqa: E731
+        return {"x": quar(out["x"]), "cost": quar(out["cost"]),
+                "ok": code, "cov": quar(out["cov"]),
+                "residual": quar(out["residual"]),
+                "niter": out["niter"]}
+
+    return fit_one
 
 
-def fit_acf2d_tpu(params, ydata, weights, n_iter=60):
+def _batch_program(key, builder):
+    """FIFO-bounded cache of jitted vmapped fit programs keyed on the
+    static fit configuration (keyed_jit_cache pattern)."""
+    jax = get_jax()
+
+    fn = _SOLVER_CACHE.get(key)
+    if fn is None:
+        ACF2D_CACHE_STATS["builder_calls"] += 1
+        fn = jax.jit(jax.vmap(builder()))
+        if len(_SOLVER_CACHE) >= 16:
+            _SOLVER_CACHE.pop(next(iter(_SOLVER_CACHE)))
+        _SOLVER_CACHE[key] = fn
+    return fn
+
+
+def _epoch_config(params, ydata):
+    """Per-epoch fit pieces from one Parameters set + crop."""
+    ydata = np.asarray(ydata, dtype=float)
+    nf_crop, nt_crop = ydata.shape
+    if nt_crop % 2 == 0 or nf_crop % 2 == 0:
+        raise ValueError("acf2d crop must be odd-sized (reference "
+                         "centres the ACF, dynspec.py:2729-2745)")
+    p = {k: v.value for k, v in params.items()}
+    dt = 2 * p["tobs"] / p["nt"]
+    df = 2 * p["bw"] / p["nf"]
+    vary = tuple(n for n in MODEL_ARGS
+                 if n in params and params[n].vary)
+    lo = np.array([params[n].min for n in vary], dtype=float)
+    hi = np.array([params[n].max for n in vary], dtype=float)
+    return ydata, p, dt, df, vary, lo, hi
+
+
+#: default execution-group width for the batched fit: the LM
+#: while_loop runs each vmapped group until its SLOWEST lane
+#: terminates, so narrower groups stop earlier (measured on the
+#: 1-core CPU host: 32 lanes as 4×8 run ~20% less lane-iterations
+#: than one 32-wide group) while still amortising dispatch overhead.
+ACF2D_GROUP_SIZE = 8
+
+
+def fit_acf2d_batch(params, ydatas, weights=None, n_iter=60,
+                    precision=None, fresnel_method=None, bucket=True,
+                    group_size=None):
+    """Survey-native acf2d: fit a whole stack of epoch crops as ONE
+    vmapped compiled program.
+
+    ``params`` — a :class:`~scintools_tpu.fit.parameters.Parameters`
+    set shared by every epoch, or a sequence of per-epoch sets (the
+    static configuration — vary set, bounds, ar/theta/alpha — must
+    match; per-epoch *values* flow in as data). ``ydatas`` — a
+    ``[B, nf, nt]`` stack or a list of odd-sized 2-D crops (mixed
+    sizes allowed: crops are padded to ``SHAPE_BUCKETS`` shapes with
+    zero-weight borders and exactly-rescaled lag steps, one program
+    per bucket). ``weights`` — matching stack/list or None.
+
+    Returns ``(results, ok)``: a list of B
+    :class:`~scintools_tpu.fit.fitter.MinimizerResult` (each also
+    carrying ``.ok``) and the int32 health bitmask array —
+    ``guards.BAD_INPUT`` lanes (NaN-poisoned crops) come back
+    NaN-quarantined with their neighbours untouched,
+    ``guards.BAD_FIT`` marks singular normal equations.
+
+    N epochs cost one compile (cached on the static configuration —
+    repeat surveys pay zero retraces, ``ACF2D_CACHE_STATS``), one H2D
+    of the stacked crops, and one device program per
+    ``group_size``-wide execution group (``None`` →
+    ``ACF2D_GROUP_SIZE``; the early-exiting LM while_loop runs each
+    group to its slowest lane, so narrow groups waste fewer
+    lane-iterations — pass a large ``group_size`` for one monolithic
+    program).
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    precision = _resolve_precision(precision)
+    fresnel_method = fresnel_method or "gemm"
+    if hasattr(ydatas, "ndim") and getattr(ydatas, "ndim", 0) == 3:
+        ydatas = [np.asarray(y) for y in ydatas]
+    B = len(ydatas)
+    if weights is None:
+        weights = [None] * B
+    params_list = ([params] * B if hasattr(params, "items")
+                   else list(params))
+    if len(params_list) != B or len(weights) != B:
+        raise ValueError(f"got {B} crops, {len(params_list)} params, "
+                         f"{len(weights)} weights")
+
+    epochs = []
+    for pr, y in zip(params_list, ydatas):
+        epochs.append(_epoch_config(pr, y))
+    vary = epochs[0][4]
+    lo, hi = epochs[0][5], epochs[0][6]
+    ar = abs(epochs[0][1]["ar"])
+    theta = epochs[0][1]["theta"]
+    alpha_varies = "alpha" in vary
+    alpha0 = epochs[0][1]["alpha"]
+    for y_, p_, _, _, v_, lo_, hi_ in epochs[1:]:
+        if (v_ != vary or not np.array_equal(lo_, lo)
+                or not np.array_equal(hi_, hi)
+                or abs(p_["ar"]) != ar or p_["theta"] != theta
+                or (not alpha_varies and p_["alpha"] != alpha0)):
+            raise ValueError(
+                "fit_acf2d_batch needs one static fit configuration "
+                "(vary set, bounds, ar/theta/alpha) across the epoch "
+                "batch — per-epoch VALUES may differ, statics may not")
+
+    # group epochs by (bucketed) static crop shape: one compiled
+    # program per bucket, per-epoch rescaled lag steps keep the
+    # original lag positions exact (module docstring)
+    groups = {}
+    for b, (y, p, dt, df, _, _, _) in enumerate(epochs):
+        nf0, nt0 = y.shape
+        if bucket:
+            shape = (bucket_crop_size(nf0), bucket_crop_size(nt0))
+        else:
+            shape = (nf0, nt0)
+        groups.setdefault(shape, []).append(b)
+
+    fdtype = np.float32 if precision == "default" else float
+    results = [None] * B
+    ok_arr = np.zeros(B, dtype=np.int32)
+    for (nfb, ntb), idxs in groups.items():
+        ys = np.zeros((len(idxs), nfb, ntb), dtype=fdtype)
+        ws = np.zeros((len(idxs), nfb, ntb), dtype=fdtype)
+        tris = np.zeros((len(idxs), nfb, ntb), dtype=fdtype)
+        x0s = np.zeros((len(idxs), len(vary)), dtype=fdtype)
+        fixed = np.zeros((len(idxs), len(MODEL_ARGS)), dtype=fdtype)
+        dtdf = np.zeros((len(idxs), 2), dtype=fdtype)
+        crops = []
+        for g, b in enumerate(idxs):
+            y, p, dt, df, _, _, _ = epochs[b]
+            nf0, nt0 = y.shape
+            # exact-lag rescale: the padded model grid
+            # linspace(-ntb·dt_eff/τ, ·, ntb) has the ORIGINAL lag
+            # step and centre, so the central nf0×nt0 cells see the
+            # identical model values and the zero-weight border
+            # contributes nothing
+            dt_eff = dt * (nt0 * (ntb - 1)) / (ntb * (nt0 - 1))
+            df_eff = df * (nf0 * (nfb - 1)) / (nfb * (nf0 - 1))
+            of = (nfb - nf0) // 2
+            ot = (ntb - nt0) // 2
+            w = _spike_zero_weights(weights[b], y.shape)
+            tri_t = 1 - np.abs(np.linspace(-nt0 * dt, nt0 * dt,
+                                           nt0)) / p["tobs"]
+            tri_f = 1 - np.abs(np.linspace(-nf0 * df, nf0 * df,
+                                           nf0)) / p["bw"]
+            ys[g, of:of + nf0, ot:ot + nt0] = y
+            ws[g, of:of + nf0, ot:ot + nt0] = w
+            tris[g, of:of + nf0, ot:ot + nt0] = np.outer(tri_f, tri_t)
+            x0s[g] = [p[n] for n in vary]
+            fixed[g] = [float(p.get(n, 0.0)) for n in MODEL_ARGS]
+            dtdf[g] = (dt_eff, df_eff)
+            crops.append((of, ot, nf0, nt0))
+
+        # static integration-grid sizes from the batch-representative
+        # tau0/dt (the only way either enters the compiled program)
+        from ..sim.acf_model import acf2d_grid_sizes
+
+        tau0 = float(np.median([abs(epochs[b][1]["tau"])
+                                for b in idxs]))
+        dt0 = float(np.median(dtdf[:, 0]))
+        grid_key = acf2d_grid_sizes(ntb, dt0, ar, tau0)
+        key = (ntb, nfb, ar, None if alpha_varies else alpha0, theta,
+               grid_key, vary, lo.tobytes(), hi.tobytes(), n_iter,
+               precision, fresnel_method)
+        fn = _batch_program(key, lambda: make_acf2d_fit_one(
+            ntb, nfb, ar, alpha0, theta, tau0, dt0, vary, lo, hi,
+            n_iter=n_iter, precision=precision,
+            fresnel_method=fresnel_method, alpha_varies=alpha_varies))
+
+        gs = int(ACF2D_GROUP_SIZE if group_size is None
+                 else group_size)
+        chunk_outs = []
+        for s in range(0, len(idxs), gs):
+            sl = slice(s, min(s + gs, len(idxs)))
+            chunk_outs.append(fn(
+                jnp.asarray(x0s[sl]), jnp.asarray(ys[sl]),
+                jnp.asarray(ws[sl]), jnp.asarray(tris[sl]),
+                jnp.asarray(fixed[sl]), jnp.asarray(dtdf[sl])))
+        out = {k: np.concatenate([np.asarray(o[k])
+                                  for o in chunk_outs])
+               for k in chunk_outs[0]}
+        xs = np.asarray(out["x"], dtype=float)
+        covs = np.asarray(out["cov"], dtype=float)
+        codes = np.asarray(out["ok"], dtype=np.int32)
+        res = np.asarray(out["residual"], dtype=float)
+
+        for g, b in enumerate(idxs):
+            of, ot, nf0, nt0 = crops[g]
+            out_params = params_list[b].copy()
+            for i, n in enumerate(vary):
+                out_params[n].value = float(
+                    abs(xs[g, i]) if n in ("tau", "dnu") else xs[g, i])
+                out_params[n].stderr = float(
+                    np.sqrt(np.abs(covs[g, i, i])))
+            # residual trimmed to the epoch's own crop cells so
+            # chisqr/redchi match an unpadded fit exactly
+            r2d = res[g].reshape(nfb, ntb)[of:of + nf0, ot:ot + nt0]
+            result = MinimizerResult(
+                out_params, residual=r2d.ravel(),
+                nfev=int(np.asarray(out["niter"])[g]),
+                message=f"jitted batched LM (fit/acf2d.py, "
+                        f"precision={precision})")
+            result.ok = int(codes[g])
+            results[b] = result
+            ok_arr[b] = codes[g]
+    return results, ok_arr
+
+
+def fit_acf2d_tpu(params, ydata, weights, n_iter=60, precision=None,
+                  fresnel_method=None):
     """Drop-in acf2d fit on the jax backend.
 
     params must carry the reference parameter set (tau, dnu, amp,
     phasegrad, psi varying as configured; ar/theta/nt/nf/tobs/bw
     fixed, alpha fixed or varying — dynspec.py:2858-2871). Returns a
     MinimizerResult with lmfit-convention stderr from the Gauss-Newton
-    covariance.
+    covariance (plus the ``.ok`` health code).
+
+    This is the B=1 lane of :func:`fit_acf2d_batch` — the single-epoch
+    and survey entries share one compiled-program path, so an
+    interactive ``Dynspec.get_scint_params`` fit and a thousand-epoch
+    survey warm the same cache. ``precision=None`` resolves to the
+    float32/low-rank throughput policy (module docstring); pass
+    ``precision='highest'`` for the dense ambient-dtype oracle (the
+    pre-batch behaviour).
     """
-    jax = get_jax()
-    import jax.numpy as jnp
-
-    from ..sim.acf_model import acf2d_grid_sizes
-
-    ydata = np.asarray(ydata, dtype=float)
-    nf_crop, nt_crop = ydata.shape
-    p = {k: v.value for k, v in params.items()}
-    dt = 2 * p["tobs"] / p["nt"]
-    df = 2 * p["bw"] / p["nf"]
-    ar = abs(p["ar"])
-    vary = tuple(n for n in MODEL_ARGS
-                 if n in params and params[n].vary)
-    lo = np.array([params[n].min for n in vary], dtype=float)
-    hi = np.array([params[n].max for n in vary], dtype=float)
-    # the initial tau fixes only the (static) integration-grid sizes
-    grid_key = acf2d_grid_sizes(nt_crop, dt, ar, abs(p["tau"]))
-    key = (nt_crop, nf_crop, round(dt, 9), round(df, 9), ar,
-           p["alpha"], p["theta"], grid_key, vary, lo.tobytes(),
-           hi.tobytes(), n_iter)
-    if key not in _SOLVER_CACHE:
-        if len(_SOLVER_CACHE) >= 16:
-            _SOLVER_CACHE.pop(next(iter(_SOLVER_CACHE)))
-        _SOLVER_CACHE[key] = _build(nt_crop, nf_crop, dt, df, ar,
-                                    p["alpha"], p["theta"],
-                                    abs(p["tau"]), vary, lo, hi,
-                                    n_iter)
-    solver, residual = _SOLVER_CACHE[key]
-
-    w_j = jnp.asarray(_spike_zero_weights(weights, ydata.shape))
-    y_j = jnp.asarray(ydata)
-    # triangle tapers (scint_models.py:119-121): τmax·τ = nt_crop·dt
-    # regardless of the current τ, so both tapers are per-call static
-    tri_t = 1 - np.abs(np.linspace(-nt_crop * dt, nt_crop * dt,
-                                   nt_crop)) / p["tobs"]
-    tri_f = 1 - np.abs(np.linspace(-nf_crop * df, nf_crop * df,
-                                   nf_crop)) / p["bw"]
-    tri_j = jnp.asarray(np.outer(tri_f, tri_t))
-    fixed_vec = jnp.asarray([float(p.get(n, 0.0))
-                             for n in MODEL_ARGS])
-    x0 = np.array([p[n] for n in vary], dtype=float)
-
-    args = (y_j, w_j, tri_j, fixed_vec)
-    x, cost = jax.block_until_ready(solver(jnp.asarray(x0), *args))
-    x = np.asarray(x, dtype=float)
-    cov = np.asarray(lm_covariance(residual, jnp.asarray(x),
-                                   args=args))
-
-    out = params.copy()
-    for i, n in enumerate(vary):
-        out[n].value = float(abs(x[i]) if n in ("tau", "dnu")
-                             else x[i])
-        out[n].stderr = float(np.sqrt(np.abs(cov[i, i])))
-    res = np.asarray(residual(jnp.asarray(x), *args))
-    result = MinimizerResult(out, residual=res, nfev=n_iter,
-                             message="jitted LM (fit/acf2d.py)")
-    return result
+    results, _ = fit_acf2d_batch(params, [np.asarray(ydata)],
+                                 [weights], n_iter=n_iter,
+                                 precision=precision,
+                                 fresnel_method=fresnel_method)
+    return results[0]

@@ -162,6 +162,38 @@ def make_acf1d_batch(nt, nf, dt, df, alpha=5 / 3, n_iter=100,
     return jax.jit(jax.vmap(fit_one))
 
 
+def scint_params_acf2d_batch(params, ydatas, weights=None, n_iter=60,
+                             precision=None):
+    """Survey-style dict-of-arrays view of the batched analytic-ACF
+    2-D fit (fit/acf2d.py:fit_acf2d_batch) — the ``acf2d`` companion
+    to :func:`scint_params_batch`'s 1-D fits, sharing its calling
+    convention so survey drivers (robust/runner.py:run_survey_batched)
+    treat both interchangeably.
+
+    ``params`` — shared or per-epoch Parameters (fit_acf2d_batch
+    semantics); ``ydatas`` — ``[B, nf, nt]`` crop stack or mixed-size
+    list. Returns per-epoch numpy arrays for every varying parameter
+    (``tau, dnu, ...`` with ``<name>err`` stderr), plus ``chisqr``,
+    ``redchi``, and the int32 ``ok`` health bitmask
+    (robust/guards.py: BAD_INPUT lanes are NaN-quarantined in-batch,
+    BAD_FIT marks singular normal equations).
+    """
+    from .acf2d import fit_acf2d_batch
+
+    results, ok = fit_acf2d_batch(params, ydatas, weights,
+                                  n_iter=n_iter, precision=precision)
+    out = {"ok": ok}
+    names = [n for n in results[0].params.varying_names()]
+    for n in names:
+        out[n] = np.array([r.params[n].value for r in results])
+        out[n + "err"] = np.array(
+            [r.params[n].stderr if r.params[n].stderr is not None
+             else np.nan for r in results])
+    out["chisqr"] = np.array([r.chisqr for r in results])
+    out["redchi"] = np.array([r.redchi for r in results])
+    return out
+
+
 def scint_params_batch(dyns, dt, df, alpha=5 / 3, n_iter=100,
                        bartlett=True, weighted=True, backend="jax"):
     """Fit (τ_d, Δν_d, amp) on a whole batch of epochs in one program:

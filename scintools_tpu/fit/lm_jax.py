@@ -77,6 +77,124 @@ def make_lm_solver(residual_fn, n_iter=40, lam0=1e-3, lam_up=4.0,
     return solver
 
 
+def make_lm_fit_fn(residual_fn, n_iter=40, lam0=1e-3, lam_up=4.0,
+                   lam_down=0.5, lam_min=1e-9, lam_max=1e9,
+                   bounds=None, eps=1e-12, jac_fn=None, with_cov=True,
+                   xtol=1e-6):
+    """Build the survey-grade LM fit ``fit(x0, *args) -> dict`` with
+    keys ``x, cost, ok, cov, residual`` — the whole fit (iterations,
+    Gauss-Newton covariance at the solution, final residual, health
+    flag) as ONE traceable function, designed to be ``vmap``-ped over
+    an epoch axis and jitted once (fit/acf2d.py:fit_acf2d_batch).
+
+    Differences from :func:`make_lm_solver` (which is kept bitwise
+    unchanged as the differentiable building block):
+
+    - the accepted-step residual is CARRIED between iterations instead
+      of re-evaluated, and the jacobian comes from ``jax.linearize``
+      (one primal + one tangent pass per parameter) — same iterates,
+      fewer model evaluations;
+    - ``jac_fn(x, r, *args) -> J`` optionally replaces the autodiff
+      jacobian — e.g. fit/acf2d.py supplies analytic columns for
+      parameters the residual is linear in;
+    - ``ok`` is a per-fit health bool (False when the damped normal
+      equations ever produced a non-finite step — NaN-poisoned crops,
+      overflow — or the final cost/iterate is non-finite), the
+      PR-2 ``ok[B]``-flag pattern for batched lanes;
+    - ``cov`` is the Gauss-Newton parameter covariance at the solution
+      (:func:`lm_covariance` semantics) evaluated in-program, so a
+      batched caller gets stderr without per-epoch dispatches;
+    - the loop is a ``while_loop`` capped at ``n_iter`` with two
+      early exits. ``xtol`` is the classic step-size termination
+      (scipy least_squares' xtol): stop when the PROPOSED damped step
+      is below ``xtol`` relative — accepted or not, since a rejected
+      tiny step only grows λ, which shrinks the next proposal
+      further. The backstop is PROVABLY terminal: once λ sits at
+      ``lam_max`` and a trial is rejected, every further iteration
+      would recompute the numerically identical rejected step (same
+      x, same λ → same δ → same rejection). Measured on the crop-49
+      acf2d workload, lanes converge by ~8 iterations and exit at
+      ~15 of a 60-iteration budget (``niter`` reports the count);
+      ``xtol=0`` keeps only the λ-saturation backstop. Under ``vmap``
+      the batch runs until its slowest lane exits; finished lanes'
+      updates are no-ops.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    lo = hi = None
+    if bounds is not None:
+        lo = jnp.asarray(np.asarray(bounds[0], dtype=float))
+        hi = jnp.asarray(np.asarray(bounds[1], dtype=float))
+
+    def default_jac(x, r, *args):
+        _, jvp = jax.linearize(lambda xx: residual_fn(xx, *args), x)
+        return jax.vmap(jvp)(jnp.eye(x.size, dtype=x.dtype)).T
+
+    jac = jac_fn if jac_fn is not None else default_jac
+
+    def fit(x0, *args):
+        x0 = jnp.asarray(x0, dtype=jnp.result_type(float, x0))
+        # bounds follow the iterate dtype: under the float32 policy a
+        # float64 clip operand would silently upcast every iteration
+        lo_ = lo.astype(x0.dtype) if lo is not None else None
+        hi_ = hi.astype(x0.dtype) if hi is not None else None
+
+        def cond(carry):
+            x, lam, cost, r, bad, it, done = carry
+            return (it < n_iter) & ~done
+
+        def body(carry):
+            x, lam, cost, r, bad, it, done = carry
+            J = jac(x, r, *args)
+            g = J.T @ r
+            H = J.T @ J
+            damp = lam * (jnp.diag(H) + eps)
+            delta = jnp.linalg.solve(H + jnp.diag(damp), -g)
+            bad = bad | ~jnp.all(jnp.isfinite(delta))
+            x_new = x + delta
+            if lo_ is not None:
+                x_new = jnp.clip(x_new, lo_, hi_)
+            r_new = residual_fn(x_new, *args)
+            cost_new = 0.5 * jnp.sum(r_new * r_new)
+            ok = jnp.isfinite(cost_new) & (cost_new < cost)
+            # terminal stall (docstring): λ was already clipped at
+            # lam_max when this rejected trial was computed, so every
+            # further iteration would repeat it identically
+            done = (~ok) & (lam >= lam_max)
+            if xtol:
+                # xtol step-size termination (docstring) — on the
+                # proposed step, accepted or not
+                rel = jnp.max(jnp.abs(delta)
+                              / jnp.maximum(jnp.abs(x), eps))
+                done = done | (jnp.isfinite(rel) & (rel < xtol))
+            x = jnp.where(ok, x_new, x)
+            r = jnp.where(ok, r_new, r)
+            cost = jnp.where(ok, cost_new, cost)
+            lam = jnp.clip(jnp.where(ok, lam * lam_down, lam * lam_up),
+                           lam_min, lam_max)
+            return (x, lam, cost, r, bad, it + 1, done)
+
+        r0 = residual_fn(x0, *args)
+        init = (x0, jnp.asarray(lam0, x0.dtype),
+                0.5 * jnp.sum(r0 * r0), r0, jnp.asarray(False),
+                jnp.asarray(0, jnp.int32), jnp.asarray(False))
+        x, _, cost, r, bad, it, _ = jax.lax.while_loop(cond, body,
+                                                       init)
+        ok = (jnp.isfinite(cost) & jnp.all(jnp.isfinite(x)) & ~bad)
+        out = {"x": x, "cost": cost, "ok": ok, "residual": r,
+               "niter": it}
+        if with_cov:
+            J = jac(x, r, *args)
+            H = J.T @ J
+            nfree = jnp.maximum(r.size - x.size, 1)
+            redchi = jnp.sum(r * r) / nfree
+            out["cov"] = jnp.linalg.pinv(H) * redchi
+        return out
+
+    return fit
+
+
 def lm_covariance(residual_fn, x, args=()):
     """Gauss-Newton parameter covariance at the solution:
     (JᵀJ)⁻¹ · redχ² — the same stderr convention as

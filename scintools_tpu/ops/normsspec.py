@@ -226,6 +226,15 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
                 "kernel assumes index arithmetic) — this axis is "
                 "non-uniform")
         pallas = False               # env knob: quiet XLA fallback
+    # formulation policy (profiled on the 16×256² survey_arc bench
+    # geometry): the tent slabs ride the MXU on TPU, but on CPU they
+    # are pure overhead — the same batch measured 2.57 s as tent
+    # matmuls vs 0.12 s as the index-arithmetic gather interp
+    # (scaled_row_interp's uniform branch, identical np.interp
+    # semantics). One geometry-keyed compiled program either way
+    # (ops/fitarc.py:_ARC_PROFILE_CACHE).
+    if jax.default_backend() == "cpu":
+        uniform = False              # route through the gather interp
     if pallas:
         from .arc_pallas import (make_arc_profile_pallas_fn,
                                  pad_to_multiple)

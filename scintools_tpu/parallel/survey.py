@@ -174,6 +174,40 @@ def make_arc_fit_sharded(mesh, tdel, fdop, delmax=None, startbin=3,
                    out_shardings=(sh, sh)), ndev
 
 
+def make_acf2d_fit_sharded(mesh, nt_crop, nf_crop, ar, alpha, theta,
+                           tau0, dt0, vary, lo, hi, n_iter=60,
+                           precision=None, fresnel_method=None,
+                           alpha_varies=False):
+    """Epoch-sharded batched acf2d fit: the vmapped analytic-ACF LM
+    program (fit/acf2d.py:make_acf2d_fit_one — model, forward-mode
+    jacobian, damped-LM loop, covariance, per-lane ``ok`` bitmask as
+    ONE compiled function) with the epoch axis split over every device
+    of the mesh. Returns ``(fn, n_devices)`` where
+    ``fn(x0s[B, k], ys[B, nf, nt], ws[B, nf, nt], tris[B, nf, nt],
+    fixed[B, 7], dtdf[B, 2]) → dict(x[B, k], cost[B], ok[B],
+    cov[B, k, k], residual[B, nf·nt])``; the caller pads B to a
+    multiple of n_devices (dummy lanes are dropped).
+
+    This is the same fit function ``fit_acf2d_batch`` jits for a
+    single device, so the sharded survey path and
+    ``Dynspec.get_scint_params`` share one implementation.
+    """
+    jax = get_jax()
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..fit.acf2d import make_acf2d_fit_one
+
+    fit_one = make_acf2d_fit_one(
+        nt_crop, nf_crop, ar, alpha, theta, tau0, dt0, vary, lo, hi,
+        n_iter=n_iter, precision=precision,
+        fresnel_method=fresnel_method, alpha_varies=alpha_varies)
+    sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    ndev = int(np.prod(list(mesh.shape.values())))
+    return jax.jit(jax.vmap(fit_one),
+                   in_shardings=(sh,) * 6), ndev
+
+
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
     """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
     the η grid split over every device of the mesh (CS replicated;

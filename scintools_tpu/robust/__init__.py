@@ -29,23 +29,24 @@ See docs/robustness.md for the failure model and resume workflow.
 """
 
 from .guards import (OK, BAD_INPUT, BAD_CS, BAD_CURVE, BAD_PEAKFIT,
-                     describe_health, chunk_finite_ok, sanitize_chunks,
-                     curve_health, health_code)
+                     BAD_FIT, describe_health, chunk_finite_ok,
+                     sanitize_chunks, curve_health, health_code)
 from .ladder import (TIER_FUSED, TIER_STAGED, TIER_NUMPY, LadderError,
                      is_transient, run_ladder, thth_search_ladder)
 from .faults import (inject_nan_pixels, inject_neginf_db,
                      truncate_chunk_stack, corrupt_file_tail,
                      tier_failure_hook, maybe_fail)
-from .runner import EpochOutcome, run_survey
+from .runner import EpochOutcome, run_survey, run_survey_batched
 from ..parallel.checkpoint import EpochJournal
 
 __all__ = [
     "OK", "BAD_INPUT", "BAD_CS", "BAD_CURVE", "BAD_PEAKFIT",
-    "describe_health", "chunk_finite_ok", "sanitize_chunks",
-    "curve_health", "health_code",
+    "BAD_FIT", "describe_health", "chunk_finite_ok",
+    "sanitize_chunks", "curve_health", "health_code",
     "TIER_FUSED", "TIER_STAGED", "TIER_NUMPY", "LadderError",
     "is_transient", "run_ladder", "thth_search_ladder",
     "inject_nan_pixels", "inject_neginf_db", "truncate_chunk_stack",
     "corrupt_file_tail", "tier_failure_hook", "maybe_fail",
-    "EpochOutcome", "run_survey", "EpochJournal",
+    "EpochOutcome", "run_survey", "run_survey_batched",
+    "EpochJournal",
 ]

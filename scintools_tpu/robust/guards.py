@@ -46,6 +46,12 @@ BAD_INPUT = 1
 BAD_CS = 2
 BAD_CURVE = 4
 BAD_PEAKFIT = 8
+# the batched acf2d LM (fit/acf2d.py) reuses bit 8 for its own
+# fit-refusal condition — a singular / non-finite damped
+# normal-equation solve — which is the same failure class the θ-θ
+# peak fit's 3×3 normal equations flag; BAD_FIT is the
+# domain-neutral name
+BAD_FIT = BAD_PEAKFIT
 
 _NAMES = {BAD_INPUT: "input_nonfinite", BAD_CS: "cs_nonfinite",
           BAD_CURVE: "curve_degenerate", BAD_PEAKFIT: "peakfit_refused"}

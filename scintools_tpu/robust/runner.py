@@ -140,6 +140,155 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
             "summary": tally}
 
 
+def run_survey_batched(epochs, process_batch, workdir, process=None,
+                       batch_size=32, tiers=_DEFAULT_TIERS, retries=1,
+                       validate=None, journal_name="journal.jsonl",
+                       resume=True):
+    """Batched counterpart of :func:`run_survey` for device programs
+    that fit a whole epoch stack at once (e.g.
+    ``fit/acf2d.py:fit_acf2d_batch`` — one compile, one H2D, one
+    program for N epochs).
+
+    Pending (non-journaled) epochs are grouped into stacks of
+    ``batch_size`` and dispatched as ``process_batch(payloads,
+    tier=<tiers[0]>) -> list of per-epoch result dicts`` (one dict per
+    payload, in order). The batch attempt runs through the ladder's
+    bounded transient retries; if the whole batch fails, every lane
+    falls back to the per-epoch path. Per-lane screening uses the
+    device health flags: a lane is accepted when ``validate(result)``
+    is true (default: its ``"ok"`` bitmask — the fused-program /
+    batched-LM health code — is 0/absent). Rejected lanes are retried
+    INDIVIDUALLY through the remaining tiers via ``process(payload,
+    tier=...)`` (:func:`run_survey` semantics) when ``process`` is
+    given, else quarantined — so one poisoned epoch never takes its
+    batch down, and a healthy batch costs one device program instead
+    of N.
+
+    Journal format, resume semantics, and the return structure are
+    shared with :func:`run_survey` (same ``workdir`` journal resumes
+    either entry); the summary additionally counts ``n_batches``.
+    """
+    os.makedirs(workdir, exist_ok=True)
+    journal = EpochJournal(os.path.join(workdir, journal_name))
+    done = journal.records() if resume else {}
+
+    if validate is None:
+        def validate(result):                 # noqa: ANN001
+            return int(result.get("ok", 0) or 0) == 0
+
+    outcomes = {}
+    results = {}
+    tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
+             "n_resumed": 0, "retries": 0, "n_batches": 0,
+             "tier_counts": {t: 0 for t in tiers}}
+
+    def _record(epoch_id, out):
+        key = str(epoch_id)
+        outcomes[key] = out
+        tally["retries"] += out.retries
+        if out.status == "ok":
+            tally["n_ok"] += 1
+            tally["tier_counts"][out.tier] = \
+                tally["tier_counts"].get(out.tier, 0) + 1
+            results[key] = out.result
+            journal.append(key, status="ok", tier=out.tier,
+                           retries=out.retries, result=out.result)
+        else:
+            tally["n_quarantined"] += 1
+            journal.append(key, status="quarantined", tier=out.tier,
+                           retries=out.retries, error=out.error,
+                           error_class=out.error_class)
+
+    epochs = list(epochs)
+    pending = []
+    with slog.span("survey.robust_run_batched", n_epochs=len(epochs),
+                   batch_size=batch_size,
+                   workdir=os.fspath(workdir)):
+        for epoch_id, payload in epochs:
+            tally["n_epochs"] += 1
+            key = str(epoch_id)
+            if key in done:
+                rec = done[key]
+                out = EpochOutcome(
+                    epoch=epoch_id, status="resumed",
+                    tier=rec.get("tier", ""),
+                    result=rec.get("result") or {})
+                if rec.get("status") == "quarantined":
+                    tally["n_quarantined"] += 1
+                    out.error = rec.get("error", "")
+                    out.error_class = rec.get("error_class", "")
+                else:
+                    results[key] = out.result
+                tally["n_resumed"] += 1
+                outcomes[key] = out
+                continue
+            pending.append((epoch_id, payload))
+
+        rest_tiers = tuple(tiers[1:])
+        for i in range(0, len(pending), batch_size):
+            group = pending[i:i + batch_size]
+            tally["n_batches"] += 1
+            try:
+                value, report = _ladder.run_ladder(
+                    [(tiers[0], lambda: process_batch(
+                        [p for _, p in group], tier=tiers[0]))],
+                    epoch=f"batch[{i}:{i + len(group)}]",
+                    stage="process_batch", retries=retries)
+                batch_results = list(value)
+                if len(batch_results) != len(group):
+                    raise ValueError(
+                        f"process_batch returned {len(batch_results)} "
+                        f"results for {len(group)} epochs")
+            except (_ladder.LadderError, ValueError) as exc:
+                slog.log_failure("robust.batch_fallback",
+                                 epoch=f"batch[{i}]",
+                                 stage="process_batch", error=exc,
+                                 tier=tiers[0], retry=0)
+                # whole-batch failure: every lane takes the per-epoch
+                # ladder (quarantine isolation unchanged)
+                for epoch_id, payload in group:
+                    if process is None:
+                        _record(epoch_id, EpochOutcome(
+                            epoch=epoch_id, status="quarantined",
+                            tier=tiers[0], error=str(exc),
+                            error_class=type(exc).__name__))
+                    else:
+                        _record(epoch_id, _run_one(
+                            epoch_id, payload, process, tiers,
+                            retries, None))
+                continue
+            for (epoch_id, payload), result in zip(group,
+                                                   batch_results):
+                if validate(result):
+                    _record(epoch_id, EpochOutcome(
+                        epoch=epoch_id, status="ok", tier=tiers[0],
+                        result=dict(result)))
+                    continue
+                slog.log_failure(
+                    "robust.lane_reject", epoch=epoch_id,
+                    stage="process_batch", tier=tiers[0],
+                    error=ValueError(
+                        f"lane health rejected (ok="
+                        f"{result.get('ok', 'validator')!r})"),
+                    retry=0)
+                if process is None or not rest_tiers:
+                    _record(epoch_id, EpochOutcome(
+                        epoch=epoch_id, status="quarantined",
+                        tier=tiers[0],
+                        error="lane health rejected",
+                        error_class="LaneRejected"))
+                else:
+                    _record(epoch_id, _run_one(
+                        epoch_id, payload, process, rest_tiers,
+                        retries, None))
+        slog.log_event("survey.robust_batched_summary", **{
+            k: v for k, v in tally.items() if k != "tier_counts"},
+            tier_counts=dict(tally["tier_counts"]))
+    ordered = [outcomes[str(e)] for e, _ in epochs]
+    return {"results": results, "outcomes": ordered,
+            "summary": tally}
+
+
 def _run_one(epoch_id, payload, process, tiers, retries, validate):
     """Dispatch one epoch through the ladder; never raises."""
 

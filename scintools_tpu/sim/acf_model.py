@@ -56,6 +56,131 @@ def _fresnel_row(gammes, snp, snx, sny, dnun, dsp_eff, xp):
     return -1j * (dsp_eff ** 2) * phase * s / ((2 * np.pi) * dnun)
 
 
+def _fresnel_row_lowrank(U, V, snp, snx, sny, dnun, dsp_eff, xp):
+    """:func:`_fresnel_row` with the STATIC e-field ACF kernel
+    pre-factorised as ``gammes ≈ U @ V.T`` (truncated SVD, rank r).
+
+    The smooth kernel ``exp(-0.5·base^(α/2))`` is numerically rank
+    ≲ 10 at 1e-6 relative truncation even on 600²-point grids, so the
+    two dense chirp GEMMs — O(nsn·nx²) each — collapse to two THIN
+    transforms O(nsn·nx·r): with G = gammes·cy⊗cx,
+
+        s_i = Σ_p [E2 @ (cy·U)]_{ip} · [E1 @ (cx·V)]_{ip}
+
+    (exactly the factorised integral with the y- and x-contractions
+    routed through the rank-r factors). Valid only when ``gammes`` is
+    static, i.e. alpha is a FIXED fit parameter — the fit builder
+    falls back to :func:`_fresnel_row` when alpha varies.
+    """
+    inv2d = 1.0 / (2.0 * dnun)
+    chirp = xp.exp(1j * inv2d * snp ** 2)
+    Uc = chirp[:, None] * U                              # (ny, r)
+    Vc = chirp[:, None] * V                              # (nx, r)
+    E1 = xp.exp(-2j * inv2d * snx[:, None] * snp[None, :])
+    E2 = xp.exp(-2j * inv2d * sny[:, None] * snp[None, :])
+    s = xp.sum((E2 @ Uc) * (E1 @ Vc), axis=1)
+    phase = xp.exp(1j * inv2d * (snx ** 2 + sny ** 2))
+    return -1j * (dsp_eff ** 2) * phase * s / ((2 * np.pi) * dnun)
+
+
+def lowrank_gammes(snp, sqrtar, alph2, rank_tol=1e-5, dtype=None):
+    """Truncated-SVD factors ``(U, V)`` of the static e-field ACF
+    kernel on grid ``snp`` with ``gammes ≈ U @ V.T``; singular values
+    below ``rank_tol·σ0`` are dropped (√σ folded into both factors).
+    Host-side (numpy) — the factors bake into the compiled program."""
+    snp = np.asarray(snp, dtype=float)
+    SX, SY = np.meshgrid(snp, snp)
+    base = (SX / sqrtar) ** 2 + (SY * sqrtar) ** 2
+    g = np.exp(-0.5 * base ** alph2)
+    U, s, Vt = np.linalg.svd(g)
+    r = max(int(np.sum(s > rank_tol * s[0])), 1)
+    sq = np.sqrt(s[:r])
+    U = U[:, :r] * sq
+    V = Vt[:r].T * sq
+    if dtype is not None:
+        U = U.astype(dtype)
+        V = V.astype(dtype)
+    return U, V
+
+
+def _czt_1d(u, a, phi0, L, xp):
+    """Bluestein chirp-Z evaluation of ``X[n] = Σ_m u[..., m] ·
+    exp(-i·(a·m·n + phi0·n))`` for n = 0..N-1 over the last axis,
+    with TRACED chirp rate ``a`` and per-output phase ``phi0``
+    (static shapes only: M = u.shape[-1] and N are baked via the
+    precomputed FFT length ``L`` ≥ M+N-1).
+
+    m·n = (m² + n² − (n−m)²)/2 turns the sum into a convolution of
+    ``u·e^{-i·a·m²/2}`` with the conjugate chirp, done with
+    zero-padded FFTs — O((M+N)·log) per output row instead of the
+    O(M·N) plane-wave GEMM."""
+    M = u.shape[-1]
+    N = L[1]
+    Lf = L[0]
+    m = xp.arange(M)
+    n = xp.arange(N)
+    k = xp.arange(-(M - 1), N)                 # conv kernel support
+    wm = xp.exp(-0.5j * a * m ** 2)
+    wn = xp.exp(-0.5j * a * n ** 2 - 1j * phi0 * n)
+    v = xp.exp(0.5j * a * k ** 2)              # conjugate chirp
+    uf = xp.fft.fft(u * wm, n=Lf, axis=-1)
+    vf = xp.fft.fft(v, n=Lf)
+    conv = xp.fft.ifft(uf * vf, axis=-1)
+    # conv index k0 + n with k0 = M-1 aligns (n-m) = k
+    return conv[..., M - 1:M - 1 + N] * wn
+
+
+def czt_fft_length(M, N):
+    """Static (fft_len, N) pair for :func:`_czt_1d`."""
+    L = 1
+    while L < M + N - 1:
+        L *= 2
+    return (L, N)
+
+
+def _fresnel_row_czt(gammes, snp, snx, sny, dnun, dsp_eff, xp,
+                     fft_len=None):
+    """:func:`_fresnel_row` evaluated with chirp-Z/FFT transforms
+    instead of plane-wave GEMMs (arXiv:2208.06060-style FFT phase
+    evaluation): the x- and y-contractions are Bluestein CZTs onto
+    the uniform sample grids, and the diagonal of the separable
+    2-D transform gives the (snx_i, sny_i) samples. O(nx²·log nx)
+    per lag vs the GEMM's O(nsn·nx²). Requires UNIFORM snx/sny
+    (they are: linspace times direction cosines) and is kept behind
+    the ``fresnel_method='czt'`` flag with the GEMM path as oracle.
+    """
+    nsn = snx.shape[0]
+    nx = snp.shape[0]
+    if fft_len is None:
+        fft_len = czt_fft_length(nx, nsn)
+    inv2d = 1.0 / (2.0 * dnun)
+    chirp = xp.exp(1j * inv2d * snp ** 2)
+    G = gammes * chirp[:, None] * chirp[None, :]
+    dsn = snp[1] - snp[0]
+    # sample grids snx = sx0 + i·gx (uniform); phase x·sx decomposes
+    # into the m·n chirp plus separable per-m / per-n linear phases
+    gx = snx[1] - snx[0]
+    gy = sny[1] - sny[0]
+    sx0, sy0 = snx[0], sny[0]
+    x0 = snp[0]
+    two = 2.0 * inv2d
+
+    def axis_czt(u, g0, s0):
+        a = two * dsn * g0                      # traced chirp rate
+        pre = xp.exp(-1j * two * s0 * snp)      # per-m phase (n-indep)
+        phi0 = two * x0 * g0                    # per-n linear phase
+        return _czt_1d(u * pre, a, phi0, fft_len, xp)
+
+    # contract x (last axis) for every y row → (ny, nsn), then
+    # contract y for every sample column → (nsn, nsn); the needed
+    # values are the diagonal (sx_i, sy_i) pairs
+    Tx = axis_czt(G, gx, sx0)                   # (ny, nsn)
+    Ty = axis_czt(Tx.T, gy, sy0)                # (nsn, nsn)
+    s = xp.diagonal(Ty)
+    phase = xp.exp(1j * inv2d * (snx ** 2 + sny ** 2))
+    return -1j * (dsp_eff ** 2) * phase * s / ((2 * np.pi) * dnun)
+
+
 def _gammitv_block(snx, sny, snp, gammes, snp2, gammes2, dnun, dsp,
                    res_fac, core_fac, sigxn, sigyn, sqrtar, alph2, wn_amp,
                    spike_index, xp, backend):
@@ -280,8 +405,152 @@ def acf2d_grid_sizes(nt_crop, dt, ar, tau0, grid_oversample=1.25):
     return n(res_fac), n(core_fac)
 
 
+ACF2D_RANK_TOL = 1e-5       # low-rank kernel truncation (·σ0)
+
+
+def make_acf2d_model_core(nt_crop, nf_crop, ar, alpha, theta, tau0,
+                          dt0, grid_oversample=1.25,
+                          precision="default", alpha_varies=False,
+                          fresnel_method="gemm"):
+    """Static-shape theoretical-ACF model core with TRACED lag steps:
+    ``model(tau, dnu, amp, phasegrad, psi, wn, dt, df[, alpha]) ->
+    (nf_crop, nt_crop)``.
+
+    This is :func:`make_acf2d_model_fn` with ``dt``/``df`` moved from
+    compile-time statics to runtime scalars, so one compiled program
+    serves every epoch of a mixed-``tobs``/``bw`` survey (and the
+    shape-bucketed crops of fit/acf2d.py, whose per-epoch rescaled lag
+    steps flow in as data). ``dt0`` sizes the static integration
+    grids together with ``tau0`` (the only way either enters the
+    compiled program).
+
+    Precision policy (the acf2d throughput knob):
+
+    - ``precision='default'`` — float32/complex64 Fresnel rows, and
+      the STATIC e-field ACF kernel factorised by truncated SVD
+      (:func:`lowrank_gammes`, rank ≲ 10) so the two chirp GEMMs per
+      lag collapse to thin rank-r transforms. Model error vs the
+      dense complex128 path is ~1e-5 relative — far below the acf2d
+      fit's noise floor.
+    - ``precision='highest'`` — the pre-policy behaviour: dense
+      GEMMs in the ambient dtype (complex128 under x64).
+
+    ``alpha_varies=True`` keeps the kernel traced in alpha (dense path
+    regardless of policy). ``fresnel_method='czt'`` swaps the GEMMs
+    for the Bluestein chirp-Z evaluation (:func:`_fresnel_row_czt`) —
+    experimental, GEMM is the oracle.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    if nt_crop % 2 == 0 or nf_crop % 2 == 0:
+        raise ValueError("acf2d crop must be odd-sized (reference "
+                         "centres the ACF, dynspec.py:2729-2745)")
+    if precision not in ("default", "highest"):
+        raise ValueError(f"precision must be 'default' or 'highest', "
+                         f"got {precision!r}")
+    if fresnel_method not in ("gemm", "czt"):
+        raise ValueError(f"fresnel_method must be 'gemm' or 'czt', "
+                         f"got {fresnel_method!r}")
+    sqrtar = float(np.sqrt(ar))
+    f32 = precision == "default"
+    fdtype = np.float32 if f32 else None
+    lowrank = f32 and not alpha_varies and fresnel_method == "gemm"
+    # grids are static (size from tau0, range ±6·ar); alpha enters
+    # only through the exponent of exp(−0.5·BASE^(α/2)), so a varying
+    # alpha (get_scint_params(alpha=None), dynspec.py:745-746) stays
+    # traceable with the same static BASE arrays
+    n_normal, n_core = acf2d_grid_sizes(nt_crop, dt0, ar, tau0,
+                                        grid_oversample)
+
+    def _grid(n):
+        snp = np.linspace(-6 * ar, 6 * ar, n)
+        SX, SY = np.meshgrid(snp, snp)
+        base = (SX / sqrtar) ** 2 + (SY * sqrtar) ** 2
+        if fdtype is not None:
+            snp = snp.astype(fdtype)
+            base = base.astype(fdtype)
+        uv = (lowrank_gammes(snp, sqrtar, alpha / 2,
+                             rank_tol=ACF2D_RANK_TOL, dtype=fdtype)
+              if lowrank else None)
+        return (jnp.asarray(snp), jnp.asarray(base), uv,
+                float(snp[1] - snp[0]))
+
+    snp_j, base_j, uv1, step = _grid(n_normal)
+    snp2_j, base2_j, uv2, step2 = _grid(n_core)
+    czt_len = czt_fft_length(n_normal, nt_crop)
+    czt_len2 = czt_fft_length(n_core, nt_crop)
+    ndnun = (nf_crop + 1) // 2
+    spike_index = nt_crop // 2              # tn centre (nt odd)
+    deg = np.pi / 180.0
+
+    def _gammes(base, alph2):
+        safe = jnp.where(base == 0, 1.0, base)   # pow-grad guard
+        return jnp.where(base == 0, 1.0,
+                         jnp.exp(-0.5 * safe ** alph2))
+
+    def _row(which, alph2, snx, sny, d, eff_step):
+        if which == 0:
+            snp, base, uv, L = snp_j, base_j, uv1, czt_len
+        else:
+            snp, base, uv, L = snp2_j, base2_j, uv2, czt_len2
+        if lowrank:
+            return _fresnel_row_lowrank(jnp.asarray(uv[0]),
+                                        jnp.asarray(uv[1]), snp,
+                                        snx, sny, d, eff_step, jnp)
+        gam = _gammes(base, alph2)
+        if fresnel_method == "czt":
+            return _fresnel_row_czt(gam, snp, snx, sny, d, eff_step,
+                                    jnp, fft_len=L)
+        return _fresnel_row(gam, snp, snx, sny, d, eff_step, jnp)
+
+    def model(tau, dnu, amp, phasegrad, psi, wn, dt, df, alpha=alpha):
+        tau = jnp.abs(tau)
+        dnu = jnp.abs(dnu)
+        if f32:
+            tau, dnu, amp = (jnp.asarray(v, jnp.float32)
+                             for v in (tau, dnu, amp))
+            phasegrad, psi, wn, dt, df = (
+                jnp.asarray(v, jnp.float32)
+                for v in (phasegrad, psi, wn, dt, df))
+        alph2 = alpha / 2
+        taumax = nt_crop * dt / tau
+        dnumax = nf_crop * df / dnu
+        xi = (90.0 - psi) * deg
+        sigxn = phasegrad * jnp.cos(xi - theta * deg)
+        sigyn = phasegrad * jnp.sin(xi - theta * deg)
+        tn = jnp.linspace(-taumax, taumax, nt_crop)
+        snx = jnp.cos(xi) * tn
+        sny = jnp.sin(xi) * tn
+        dnun = jnp.linspace(0.0, dnumax, ndnun)
+
+        col0 = _efield_acf(snx, sny, sqrtar, alph2, jnp)
+        col0 = col0.at[spike_index].add(wn / amp)
+
+        first = _row(1, alph2, snx - 2 * sigxn * dnun[1],
+                     sny - 2 * sigyn * dnun[1], dnun[1], step2)
+
+        def one(d):
+            return _row(0, alph2, snx - 2 * sigxn * d,
+                        sny - 2 * sigyn * d, d, step)
+
+        rest = jax.vmap(one, out_axes=1)(dnun[2:])   # (nt, ndnun-2)
+        g = jnp.concatenate([col0[:, None].astype(rest.dtype),
+                             first[:, None], rest], axis=1)
+        g = jnp.real(g * jnp.conj(g))                # |Γ_E|² → Γ_I
+        # mirror in frequency only (two-quadrant branch,
+        # scint_sim.py:601-607), then transpose to (nf, nt)
+        gam3 = jnp.concatenate(
+            [jnp.flip(g[:, 1:], axis=(0, 1)), g], axis=1).T
+        return amp * gam3
+
+    return model
+
+
 def make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha, theta,
-                        tau0, grid_oversample=1.25):
+                        tau0, grid_oversample=1.25,
+                        precision="default", alpha_varies=False,
+                        fresnel_method="gemm"):
     """Build a fully-jitted theoretical-ACF model
     ``model(tau, dnu, amp, phasegrad, psi, wn) -> (nf_crop, nt_crop)``
     with STATIC shapes — the TPU-resident core of the ``acf2d`` fit
@@ -303,75 +572,20 @@ def make_acf2d_model_fn(nt_crop, nf_crop, dt, df, ar, alpha, theta,
       phasegrad=0 it reproduces the mirrored quadrant result exactly,
       and it keeps ``phasegrad`` traceable;
     - the white-noise spike lands at the static centre bin (nt odd).
+
+    ``precision``/``fresnel_method`` select the Fresnel-row policy —
+    see :func:`make_acf2d_model_core` (this wrapper bakes ``dt``/``df``
+    back into the closure for the fixed-geometry single-model uses).
     """
-    jax = get_jax()
-    import jax.numpy as jnp
-
-    if nt_crop % 2 == 0 or nf_crop % 2 == 0:
-        raise ValueError("acf2d crop must be odd-sized (reference "
-                         "centres the ACF, dynspec.py:2729-2745)")
-    sqrtar = float(np.sqrt(ar))
-    # grids are static (size from tau0, range ±6·ar); alpha enters
-    # only through the exponent of exp(−0.5·BASE^(α/2)), so a varying
-    # alpha (get_scint_params(alpha=None), dynspec.py:745-746) stays
-    # traceable with the same static BASE arrays
-    n_normal, n_core = acf2d_grid_sizes(nt_crop, dt, ar, tau0,
-                                        grid_oversample)
-
-    def _grid(n):
-        snp = np.linspace(-6 * ar, 6 * ar, n)
-        SX, SY = np.meshgrid(snp, snp)
-        base = (SX / sqrtar) ** 2 + (SY * sqrtar) ** 2
-        return (jnp.asarray(snp), jnp.asarray(base),
-                float(snp[1] - snp[0]))
-
-    snp_j, base_j, step = _grid(n_normal)
-    snp2_j, base2_j, step2 = _grid(n_core)
-    ndnun = (nf_crop + 1) // 2
-    spike_index = nt_crop // 2              # tn centre (nt odd)
-    deg = np.pi / 180.0
-
-    def _gammes(base, alph2):
-        safe = jnp.where(base == 0, 1.0, base)   # pow-grad guard
-        return jnp.where(base == 0, 1.0,
-                         jnp.exp(-0.5 * safe ** alph2))
+    core = make_acf2d_model_core(nt_crop, nf_crop, ar, alpha, theta,
+                                 tau0, dt,
+                                 grid_oversample=grid_oversample,
+                                 precision=precision,
+                                 alpha_varies=alpha_varies,
+                                 fresnel_method=fresnel_method)
 
     def model(tau, dnu, amp, phasegrad, psi, wn, alpha=alpha):
-        tau = jnp.abs(tau)
-        dnu = jnp.abs(dnu)
-        alph2 = alpha / 2
-        gammes_j = _gammes(base_j, alph2)
-        gammes2_j = _gammes(base2_j, alph2)
-        taumax = nt_crop * dt / tau
-        dnumax = nf_crop * df / dnu
-        xi = (90.0 - psi) * deg
-        sigxn = phasegrad * jnp.cos(xi - theta * deg)
-        sigyn = phasegrad * jnp.sin(xi - theta * deg)
-        tn = jnp.linspace(-taumax, taumax, nt_crop)
-        snx = jnp.cos(xi) * tn
-        sny = jnp.sin(xi) * tn
-        dnun = jnp.linspace(0.0, dnumax, ndnun)
-
-        col0 = _efield_acf(snx, sny, sqrtar, alph2, jnp)
-        col0 = col0.at[spike_index].add(wn / amp)
-
-        first = _fresnel_row(gammes2_j, snp2_j,
-                             snx - 2 * sigxn * dnun[1],
-                             sny - 2 * sigyn * dnun[1],
-                             dnun[1], step2, jnp)
-
-        def one(d):
-            return _fresnel_row(gammes_j, snp_j, snx - 2 * sigxn * d,
-                                sny - 2 * sigyn * d, d, step, jnp)
-
-        rest = jax.vmap(one, out_axes=1)(dnun[2:])   # (nt, ndnun-2)
-        g = jnp.concatenate([col0[:, None].astype(rest.dtype),
-                             first[:, None], rest], axis=1)
-        g = jnp.real(g * jnp.conj(g))                # |Γ_E|² → Γ_I
-        # mirror in frequency only (two-quadrant branch,
-        # scint_sim.py:601-607), then transpose to (nf, nt)
-        gam3 = jnp.concatenate(
-            [jnp.flip(g[:, 1:], axis=(0, 1)), g], axis=1).T
-        return amp * gam3
+        return core(tau, dnu, amp, phasegrad, psi, wn, dt, df,
+                    alpha=alpha)
 
     return model

@@ -1,0 +1,343 @@
+"""Batched acf2d fit (ISSUE 3 tentpole): stacked-vs-looped parity,
+retrace guard, singular/NaN-lane quarantine, shape bucketing, the
+precision-policy tiers, the CZT Fresnel oracle, and the batched
+robust-runner wiring. Reference workload: dynspec.py:2858-2909."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.fit import models as mdl
+from scintools_tpu.fit.acf2d import (ACF2D_CACHE_STATS,
+                                     bucket_crop_size,
+                                     fit_acf2d_batch, fit_acf2d_tpu)
+from scintools_tpu.fit.parameters import Parameters
+from scintools_tpu.robust import guards
+
+NC = 17
+N_ITER = 12
+
+
+def _params(nc=NC, tau=1200.0, dnu=4.0, amp=1.0, phasegrad=0.0,
+            psi=60.0, tobs=3600.0, bw=32.0):
+    p = Parameters()
+    p.add("tau", value=tau, vary=True, min=0, max=np.inf)
+    p.add("dnu", value=dnu, vary=True, min=0, max=np.inf)
+    p.add("amp", value=amp, vary=True, min=0, max=np.inf)
+    p.add("alpha", value=5 / 3, vary=False)
+    p.add("nt", value=2 * nc - 1, vary=False)
+    p.add("nf", value=2 * nc - 1, vary=False)
+    p.add("phasegrad", value=phasegrad, vary=True)
+    p.add("tobs", value=tobs, vary=False)
+    p.add("bw", value=bw, vary=False)
+    p.add("ar", value=2.0, vary=False)
+    p.add("theta", value=0, vary=False)
+    p.add("psi", value=psi, vary=True)
+    return p
+
+
+def _epochs(B, nc=NC, noise=0.01, seed=8):
+    rng = np.random.default_rng(seed)
+    truth = _params(nc)
+    model = -mdl.scint_acf_model_2d(truth, np.zeros((nc, nc)),
+                                    np.ones((nc, nc)))
+    return np.stack([model + noise * np.max(model)
+                     * rng.normal(size=(nc, nc)) for _ in range(B)])
+
+
+class TestBatchedParity:
+    def test_stacked_matches_looped_same_policy(self):
+        """Same epochs stacked vs looped through the B=1 entry at the
+        SAME precision policy: the two are lanes of one compiled-fit
+        family and must agree to float-batching tolerance."""
+        ys = _epochs(3)
+        start = _params(tau=900.0, dnu=5.0, amp=0.8, psi=55.0)
+        res_b, ok = fit_acf2d_batch(start, ys, None, n_iter=N_ITER)
+        assert list(ok) == [0, 0, 0]
+        for b in range(len(ys)):
+            res_s = fit_acf2d_tpu(start, ys[b], None, n_iter=N_ITER)
+            for k in ("tau", "dnu", "psi"):
+                vb = res_b[b].params[k].value
+                vs = res_s.params[k].value
+                assert vb == pytest.approx(vs, rel=1e-4), (k, b)
+
+    def test_stacked_matches_looped_highest_tiered(self):
+        """Batched default (float32 + low-rank kernel) vs the looped
+        dense oracle (precision='highest'): tolerance-tiered parity
+        for the float32 policy — the acceptance-gate comparison."""
+        ys = _epochs(3)
+        start = _params(tau=900.0, dnu=5.0, amp=0.8, psi=55.0)
+        res_b, _ = fit_acf2d_batch(start, ys, None, n_iter=N_ITER)
+        for b in range(len(ys)):
+            res_h = fit_acf2d_tpu(start, ys[b], None, n_iter=N_ITER,
+                                  precision="highest")
+            for k in ("tau", "dnu"):
+                vb = res_b[b].params[k].value
+                vh = res_h.params[k].value
+                tol = max(0.01 * abs(vh),
+                          res_h.params[k].stderr or 0)
+                assert abs(vb - vh) <= tol, (k, b, vb, vh)
+
+    def test_stderr_and_redchi_populated(self):
+        ys = _epochs(2)
+        res, _ = fit_acf2d_batch(_params(), ys, None, n_iter=N_ITER)
+        for r in res:
+            assert r.params["tau"].stderr is not None
+            assert np.isfinite(r.redchi)
+
+
+class TestRetraceGuard:
+    def test_one_trace_for_multi_epoch_batch(self):
+        """A multi-epoch batch builds its compiled program ONCE, and
+        repeat same-configuration calls (fresh data, same statics)
+        rebuild nothing — zero per-epoch recompiles by construction."""
+        ys = _epochs(3, seed=21)
+        start = _params(tau=900.0, dnu=5.0)
+        fit_acf2d_batch(start, ys, None, n_iter=N_ITER)   # warm
+        before = ACF2D_CACHE_STATS["builder_calls"]
+        fit_acf2d_batch(start, ys + 1e-6, None, n_iter=N_ITER)
+        fit_acf2d_batch(start, ys + 2e-6, None, n_iter=N_ITER)
+        assert ACF2D_CACHE_STATS["builder_calls"] == before, \
+            "same-configuration batch calls must not rebuild the " \
+            "compiled program"
+
+    def test_single_and_batch_share_cache(self):
+        """fit_acf2d_tpu is the B=1 lane of the batch entry — the
+        same static configuration warms ONE cache for both."""
+        ys = _epochs(2, seed=22)
+        start = _params(tau=900.0, dnu=5.0)
+        fit_acf2d_batch(start, ys, None, n_iter=N_ITER)   # warm
+        before = ACF2D_CACHE_STATS["builder_calls"]
+        fit_acf2d_tpu(start, ys[0], None, n_iter=N_ITER)
+        assert ACF2D_CACHE_STATS["builder_calls"] == before
+
+
+class TestLaneQuarantine:
+    def test_nan_lane_quarantined_neighbours_untouched(self):
+        """A NaN-poisoned crop gets BAD_INPUT, NaN parameters, and
+        bitwise-identical healthy neighbours (PR-2 semantics)."""
+        ys = _epochs(3, seed=30)
+        start = _params(tau=900.0, dnu=5.0)
+        res_clean, ok_clean = fit_acf2d_batch(start, ys, None,
+                                              n_iter=N_ITER)
+        assert list(ok_clean) == [0, 0, 0]
+        bad = ys.copy()
+        bad[1, :, :] = np.nan
+        res, ok = fit_acf2d_batch(start, bad, None, n_iter=N_ITER)
+        assert ok[1] & guards.BAD_INPUT
+        assert np.isnan(res[1].params["tau"].value)
+        assert np.isnan(res[1].params["tau"].stderr)
+        for b in (0, 2):
+            assert ok[b] == guards.OK
+            assert (res[b].params["tau"].value
+                    == res_clean[b].params["tau"].value)
+            assert (res[b].params["dnu"].value
+                    == res_clean[b].params["dnu"].value)
+
+    def test_singular_lane_flags_bad_fit(self):
+        """A lane whose residuals are non-finite (±inf crop) makes
+        the damped normal equations unsolvable: ok carries BAD_FIT
+        and the health decode names the refusal."""
+        ys = _epochs(2, seed=31)
+        bad = ys.copy()
+        bad[0, :, :] = np.inf
+        _, ok = fit_acf2d_batch(_params(), bad, None, n_iter=N_ITER)
+        assert ok[0] & guards.BAD_FIT
+        assert ok[1] == guards.OK
+        assert "peakfit_refused" in guards.describe_health(ok[0])
+
+
+class TestShapeBuckets:
+    def test_bucket_sizes(self):
+        assert bucket_crop_size(17) == 17
+        assert bucket_crop_size(19) == 25
+        assert bucket_crop_size(27) == 33
+
+    def test_mixed_sizes_one_program_per_bucket_exact_values(self):
+        """Mixed-size crops pad to bucket shapes with zero-weight
+        borders and exactly-rescaled lag steps: same fitted values as
+        the exact-shape program, and the 16-entry cache sees one
+        program per bucket, not per size."""
+        ys17 = _epochs(1, nc=17, seed=40)[0]
+        ys19 = _epochs(1, nc=19, seed=41)[0]
+        p17 = _params(nc=17, tau=900.0, dnu=5.0)
+        p19 = _params(nc=19, tau=900.0, dnu=5.0)
+        res, ok = fit_acf2d_batch([p17, p19], [ys17, ys19], None,
+                                  n_iter=N_ITER)
+        assert list(ok) == [0, 0]
+        # bucketed 19→25 lane agrees with its exact-shape fit: the
+        # rescaled lag step makes the padded model identical on the
+        # original cells, so only float-op ordering differs
+        res_exact, _ = fit_acf2d_batch([p19], [ys19], None,
+                                       n_iter=N_ITER, bucket=False)
+        for k in ("tau", "dnu", "psi"):
+            assert res[1].params[k].value == pytest.approx(
+                res_exact[0].params[k].value, rel=1e-3), k
+        # redchi counts only the epoch's own cells (padding trimmed)
+        assert res[1].nfree == res_exact[0].nfree
+
+    def test_mixed_statics_rejected(self):
+        p_a = _params()
+        p_b = _params()
+        p_b["ar"].value = 3.0
+        ys = _epochs(2)
+        with pytest.raises(ValueError, match="static fit config"):
+            fit_acf2d_batch([p_a, p_b], list(ys), None,
+                            n_iter=N_ITER)
+
+
+class TestPrecisionPolicy:
+    def test_lowrank_model_matches_dense(self):
+        """The float32/low-rank model tracks the dense complex128
+        path to well below the fit noise floor."""
+        from scintools_tpu.sim.acf_model import make_acf2d_model_fn
+
+        p = _params()
+        nc = NC
+        dt = 2 * p["tobs"].value / p["nt"].value
+        df = 2 * p["bw"].value / p["nf"].value
+        args = (1200.0, 4.0, 1.0, 0.2, 60.0, 0.0)
+        fast = make_acf2d_model_fn(nc, nc, dt, df, 2.0, 5 / 3, 0.0,
+                                   tau0=1200.0)
+        hi = make_acf2d_model_fn(nc, nc, dt, df, 2.0, 5 / 3, 0.0,
+                                 tau0=1200.0, precision="highest")
+        a = np.asarray(fast(*args))
+        b = np.asarray(hi(*args))
+        assert np.max(np.abs(a - b)) < 1e-3 * np.max(np.abs(b))
+
+    def test_alpha_varying_falls_back_to_dense(self):
+        """A varying alpha keeps the kernel traced (no static SVD) —
+        the fit must still run and converge."""
+        p = _params(tau=900.0, dnu=5.0)
+        p["alpha"].vary = True
+        ys = _epochs(1, seed=50)
+        res, ok = fit_acf2d_batch(p, ys, None, n_iter=N_ITER)
+        assert ok[0] == guards.OK
+        assert np.isfinite(res[0].params["alpha"].value)
+
+
+class TestCztOracle:
+    def test_czt_row_matches_gemm(self):
+        """The chirp-Z Fresnel-row evaluation reproduces the GEMM
+        oracle on a representative lag."""
+        from scintools_tpu.sim.acf_model import (_fresnel_row,
+                                                 _fresnel_row_czt)
+
+        n, nsn = 41, 17
+        snp = np.linspace(-12.0, 12.0, n)
+        SX, SY = np.meshgrid(snp, snp)
+        gammes = np.exp(-0.5 * ((SX / np.sqrt(2)) ** 2
+                                + (SY * np.sqrt(2)) ** 2) ** (5 / 6))
+        snx = np.cos(0.5) * np.linspace(-4.0, 4.0, nsn)
+        sny = np.sin(0.5) * np.linspace(-4.0, 4.0, nsn)
+        for dnun in (0.7, 2.3):
+            ref = _fresnel_row(gammes, snp, snx, sny, dnun,
+                               snp[1] - snp[0], np)
+            czt = _fresnel_row_czt(gammes, snp, snx, sny, dnun,
+                                   snp[1] - snp[0], np)
+            np.testing.assert_allclose(czt, ref, rtol=1e-8,
+                                       atol=1e-10 * np.max(np.abs(ref)))
+
+    def test_czt_fit_converges(self):
+        ys = _epochs(1, seed=60)
+        res, ok = fit_acf2d_batch(_params(tau=900.0, dnu=5.0), ys,
+                                  None, n_iter=N_ITER,
+                                  precision="highest",
+                                  fresnel_method="czt")
+        assert ok[0] == guards.OK
+        assert np.isfinite(res[0].params["tau"].value)
+
+
+class TestSurveyWiring:
+    def test_scint_params_acf2d_batch_dict_view(self):
+        from scintools_tpu.fit import scint_params_acf2d_batch
+
+        ys = _epochs(2, seed=70)
+        out = scint_params_acf2d_batch(_params(tau=900.0, dnu=5.0),
+                                       ys, n_iter=N_ITER)
+        assert out["tau"].shape == (2,)
+        assert np.all(out["ok"] == 0)
+        assert np.all(np.isfinite(out["tauerr"]))
+        assert np.all(np.isfinite(out["redchi"]))
+
+    def test_run_survey_batched_quarantines_bad_lane(self, tmp_path):
+        """The batched runner journals healthy lanes from ONE device
+        program, quarantines the NaN lane via its ok flag, and
+        resumes from the shared journal format."""
+        from scintools_tpu.fit import scint_params_acf2d_batch
+        from scintools_tpu.robust import run_survey_batched
+
+        ys = _epochs(4, seed=80)
+        ys[2, :, :] = np.nan
+        start = _params(tau=900.0, dnu=5.0)
+
+        def process_batch(payloads, tier=None):
+            out = scint_params_acf2d_batch(start, list(payloads),
+                                           n_iter=N_ITER)
+            return [{"tau": float(out["tau"][i]),
+                     "dnu": float(out["dnu"][i]),
+                     "ok": int(out["ok"][i])}
+                    for i in range(len(payloads))]
+
+        wd = str(tmp_path / "survey")
+        out = run_survey_batched(
+            [(f"e{i}", ys[i]) for i in range(4)], process_batch, wd,
+            tiers=("jax_fused",), batch_size=4)
+        s = out["summary"]
+        assert s["n_ok"] == 3 and s["n_quarantined"] == 1
+        assert s["n_batches"] == 1
+        assert "e2" not in out["results"]
+        resumed = run_survey_batched(
+            [(f"e{i}", ys[i]) for i in range(4)], process_batch, wd,
+            tiers=("jax_fused",), batch_size=4)
+        assert resumed["summary"]["n_resumed"] == 4
+        assert resumed["results"] == out["results"]
+
+    def test_sharded_fit_matches_unsharded(self):
+        """The epoch-sharded acf2d program (virtual 8-device mesh)
+        returns the same fits as the single-device batch entry."""
+        import jax
+        import jax.numpy as jnp
+
+        from scintools_tpu import parallel as par
+        from scintools_tpu.fit.acf2d import (_epoch_config,
+                                             _spike_zero_weights)
+        from scintools_tpu.parallel.survey import \
+            make_acf2d_fit_sharded
+
+        if jax.device_count() < 2:
+            pytest.skip("needs virtual multi-device CPU")
+        B = 8
+        ys = _epochs(B, seed=90)
+        start = _params(tau=900.0, dnu=5.0)
+        _, p, dt, df, vary, lo, hi = _epoch_config(start, ys[0])
+        mesh = par.make_mesh(min(jax.device_count(), B))
+        fn, ndev = make_acf2d_fit_sharded(
+            mesh, NC, NC, abs(p["ar"]), p["alpha"], p["theta"],
+            abs(p["tau"]), dt, vary, lo, hi, n_iter=N_ITER)
+        w = _spike_zero_weights(None, ys[0].shape)
+        tri_t = 1 - np.abs(np.linspace(-NC * dt, NC * dt, NC)) \
+            / p["tobs"]
+        tri_f = 1 - np.abs(np.linspace(-NC * df, NC * df, NC)) \
+            / p["bw"]
+        tri = np.outer(tri_f, tri_t)
+        from scintools_tpu.fit.acf2d import MODEL_ARGS
+
+        x0 = np.array([p[n] for n in vary], np.float32)
+        fixed = np.array([float(p.get(n, 0.0)) for n in MODEL_ARGS],
+                         np.float32)
+        out = fn(jnp.asarray(np.tile(x0, (B, 1))),
+                 jnp.asarray(ys, jnp.float32),
+                 jnp.asarray(np.broadcast_to(w, ys.shape),
+                             jnp.float32),
+                 jnp.asarray(np.broadcast_to(tri, ys.shape),
+                             jnp.float32),
+                 jnp.asarray(np.tile(fixed, (B, 1))),
+                 jnp.asarray(np.tile(np.array([dt, df], np.float32),
+                                     (B, 1))))
+        xs = np.asarray(out["x"], dtype=float)
+        res_b, ok_b = fit_acf2d_batch(start, ys, None, n_iter=N_ITER)
+        assert np.all(np.asarray(out["ok"]) == 0)
+        tau_i = vary.index("tau")
+        for b in range(B):
+            assert abs(xs[b, tau_i]) == pytest.approx(
+                res_b[b].params["tau"].value, rel=1e-3)

@@ -1,9 +1,14 @@
-"""Exception-hygiene lint as a tier-1 gate (ISSUE 2 satellite).
+"""Repo lints as tier-1 gates.
 
-tools/lint_excepts.py forbids bare ``except:`` and silent
-``except Exception: pass`` in scintools_tpu/ — the two patterns that
-defeat the robust survey layer by hiding failures the quarantine /
-fallback machinery is supposed to see and report."""
+- tools/lint_excepts.py (ISSUE 2 satellite) forbids bare ``except:``
+  and silent ``except Exception: pass`` in scintools_tpu/ — the two
+  patterns that defeat the robust survey layer by hiding failures the
+  quarantine / fallback machinery is supposed to see and report.
+- tools/lint_import_jit.py (ISSUE 3 satellite) forbids import-time
+  ``jax.jit`` in scintools_tpu/fit/ — compiled programs must be built
+  lazily inside cached factories so cold-start and test collection
+  stay fast (and cannot hang on a dead accelerator tunnel).
+"""
 
 import importlib.util
 import os
@@ -11,13 +16,16 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _lint():
+def _tool(name):
     spec = importlib.util.spec_from_file_location(
-        "lint_excepts", os.path.join(REPO, "tools",
-                                     "lint_excepts.py"))
+        name, os.path.join(REPO, "tools", name + ".py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _lint():
+    return _tool("lint_excepts")
 
 
 def test_package_is_clean():
@@ -59,3 +67,36 @@ def test_detector_flags_tuple_form():
     src = ("try:\n    x()\nexcept (ValueError, Exception):\n"
            "    pass\n")
     assert len(lint.scan_source(src)) == 1
+
+
+class TestImportTimeJit:
+    def test_fit_layer_is_clean(self):
+        lint = _tool("lint_import_jit")
+        violations = lint.scan_tree(
+            os.path.join(REPO, "scintools_tpu", "fit"))
+        assert violations == [], (
+            "import-time jax.jit in fit/ (build programs lazily in "
+            f"a cached factory): {violations}")
+
+    def test_detector_flags_module_level_jit(self):
+        lint = _tool("lint_import_jit")
+        out = lint.scan_source(
+            "import jax\nf = jax.jit(lambda x: x)\n")
+        assert len(out) == 1 and "import time" in out[0][1]
+
+    def test_detector_flags_decorator_and_partial(self):
+        lint = _tool("lint_import_jit")
+        src = ("import jax\nfrom functools import partial\n"
+               "@jax.jit\ndef f(x):\n    return x\n"
+               "@partial(jax.jit, static_argnums=0)\n"
+               "def g(n, x):\n    return x\n")
+        assert len(lint.scan_source(src)) == 2
+
+    def test_detector_allows_lazy_jit(self):
+        lint = _tool("lint_import_jit")
+        src = ("import jax\n"
+               "def build():\n    return jax.jit(lambda x: x)\n"
+               "class C:\n"
+               "    def m(self):\n"
+               "        return jax.jit(lambda x: x)\n")
+        assert lint.scan_source(src) == []
