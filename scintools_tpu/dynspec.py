@@ -2176,6 +2176,58 @@ class HoloDyn:
         self.mjd = mjd
 
 
+def run_psrflux_survey(dynfiles, workdir, crop=None, alpha=5 / 3,
+                       n_iter=100, pipeline=True, prefetch=4,
+                       inflight=2, loader_workers=2, timeline=None,
+                       **runner_kw):
+    """Journaled, PIPELINED scintillation-parameter survey over a list
+    of psrflux files — the Dynspec-level entry to the pipelined survey
+    engine (robust/runner.py:run_survey + parallel/pipeline.py).
+
+    Each file becomes one epoch: its LOADER (parse via
+    ``load_psrflux(survey=True)``, optional ``crop=(nchan, nsub)``
+    top-left crop, float32 cast) runs in the background prefetch
+    queue; a malformed/truncated file raises the epoch-skipping
+    :class:`~scintools_tpu.io.MalformedInputError` and is quarantined
+    with a journal record while the rest of the survey streams on.
+    The per-epoch ``process`` is the batched-ACF acf1d LM fit
+    (fit/batch.py:scint_params_batch, B=1 lane) — the jax tiers run
+    the device ACF + vmapped LM, the ``numpy`` tier the host-FFT
+    reference ACF. Results journal to ``workdir/journal.jsonl``;
+    rerunning the same ``workdir`` resumes (PR-2 semantics).
+
+    ``pipeline=False`` is the sequential oracle (identical journal
+    bytes); remaining ``runner_kw`` pass through to
+    :func:`~scintools_tpu.robust.runner.run_survey`."""
+    from .fit.batch import scint_params_batch
+    from .robust import run_survey
+    from .robust.ladder import TIER_NUMPY
+
+    def make_loader(path):
+        def load():
+            ds = load_psrflux(path, survey=True)
+            dyn = np.asarray(ds.dyn, dtype=np.float32)
+            if crop is not None:
+                dyn = dyn[:crop[0], :crop[1]]
+            return dyn, float(ds.dt), float(ds.df)
+
+        return load
+
+    def process(payload, tier=None):
+        dyn, dt, df = payload
+        backend = "numpy" if tier == TIER_NUMPY else "jax"
+        out = scint_params_batch(dyn[None], dt, df, alpha=alpha,
+                                 n_iter=n_iter, backend=backend)
+        return {k: float(v[0]) for k, v in out.items()}
+
+    epochs = [(os.path.basename(os.fspath(f)), make_loader(f))
+              for f in dynfiles]
+    return run_survey(epochs, process, workdir, pipeline=pipeline,
+                      prefetch=prefetch, inflight=inflight,
+                      loader_workers=loader_workers,
+                      timeline=timeline, **runner_kw)
+
+
 def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,
              min_tsub=10, min_freq=0, max_freq=5000, verbose=True,
              max_frac_bw=2):

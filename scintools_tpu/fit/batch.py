@@ -144,6 +144,14 @@ def make_acf1d_fit_one(nt, nf, dt, df, alpha=5 / 3, n_iter=100,
     return fit_one
 
 
+# jitted-fitter cache keyed on the full static configuration: a fresh
+# jax.jit wrapper per call would retrace per SURVEY EPOCH (~0.3 s on
+# the CPU host — measured while building the pipelined survey bench),
+# turning the per-epoch fit path into pure compile noise. Bounded by
+# the number of distinct epoch geometries in a run.
+_ACF1D_BATCH_CACHE = {}
+
+
 def make_acf1d_batch(nt, nf, dt, df, alpha=5 / 3, n_iter=100,
                      bartlett=True, weighted=True):
     """Build the jitted batched acf1d fitter.
@@ -152,14 +160,22 @@ def make_acf1d_batch(nt, nf, dt, df, alpha=5 / 3, n_iter=100,
     arrays ``tau, dnu, amp, tauerr, dnuerr, amperr, chisqr, redchi``
     following the lmfit-result conventions the reference reads
     (dynspec.py:2946-3028). One XLA program for any B (recompiled only
-    on shape change).
+    on shape change); the wrapper is CACHED per static configuration,
+    so per-epoch survey callers (dynspec.py:run_psrflux_survey →
+    :func:`scint_params_batch`) never pay a retrace for a repeated
+    geometry.
     """
     jax = get_jax()
 
-    fit_one = make_acf1d_fit_one(nt, nf, dt, df, alpha=alpha,
-                                 n_iter=n_iter, bartlett=bartlett,
-                                 weighted=weighted)
-    return jax.jit(jax.vmap(fit_one))
+    key = (int(nt), int(nf), float(dt), float(df), float(alpha),
+           int(n_iter), bool(bartlett), bool(weighted))
+    fit = _ACF1D_BATCH_CACHE.get(key)
+    if fit is None:
+        fit_one = make_acf1d_fit_one(nt, nf, dt, df, alpha=alpha,
+                                     n_iter=n_iter, bartlett=bartlett,
+                                     weighted=weighted)
+        fit = _ACF1D_BATCH_CACHE[key] = jax.jit(jax.vmap(fit_one))
+    return fit
 
 
 def scint_params_acf2d_batch(params, ydatas, weights=None, n_iter=60,

@@ -494,7 +494,8 @@ def fit_arc_batch(sspecs, yaxis, fdop, delmax=None, numsteps=1e4,
     noises = sspec_noise_batch(sspecs, cutmid, n_rows=ind)
     # device program returns the ±fdop-folded profile (fold=True):
     # half the fetch over the tunnel, and the fold rides the chip
-    folded = np.asarray(fn(s_dev, jnp.asarray(e_in)))[:B]
+    folded = np.asarray(fn(s_dev, jnp.asarray(e_in)))[:B]  # sync-ok:
+    # result-consumption boundary — the host parabola tail needs it
 
     fdopnew = np.linspace(-1.0, 1.0, int(numsteps))
     pos = fdopnew >= 0

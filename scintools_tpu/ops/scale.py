@@ -110,5 +110,6 @@ def trapezoid_rescale(dyn, times, freqs, window="hanning",
     def row(x, d, v):
         return jnp.where(v, jnp.interp(x, t_j, d), 0.0)
 
-    return np.asarray(jax.jit(jax.vmap(row))(
+    return np.asarray(jax.jit(jax.vmap(row))(  # sync-ok: eager host
+        # API — the resampled dynspec is this function's return value
         jnp.asarray(X), jnp.asarray(dyn), jnp.asarray(valid)))

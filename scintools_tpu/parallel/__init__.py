@@ -15,9 +15,13 @@ from .survey import (make_survey_step, make_eta_search_sharded,
                      make_fused_grid_search_sharded)
 from .checkpoint import (EpochJournal, atomic_write_bytes,
                          atomic_write_json)
+from .pipeline import (PrefetchLoader, AsyncJournalWriter,
+                       DeferredResult, LoadedEpoch, finalize_result)
 
 __all__ = [
     "EpochJournal", "atomic_write_bytes", "atomic_write_json",
+    "PrefetchLoader", "AsyncJournalWriter", "DeferredResult",
+    "LoadedEpoch", "finalize_result",
     "make_mesh", "device_count", "DATA_AXIS", "SEQ_AXIS",
     "data_sharding", "batch_freq_sharding", "replicated",
     "make_fft2_sharded", "make_gs_sharded",

@@ -74,12 +74,21 @@ class EpochJournal:
         parent = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(parent, exist_ok=True)
 
-    def append(self, epoch, **fields):
-        """Durably journal one completed epoch (flush + fsync)."""
+    @staticmethod
+    def format_line(epoch, **fields):
+        """The exact journal line (sans newline) :meth:`append` writes
+        for a record — the ONE formatting definition, shared with the
+        threaded writer (parallel/pipeline.py:AsyncJournalWriter) so a
+        pipelined run's journal is byte-identical to a sequential
+        one's."""
         rec = {"epoch": epoch, **fields}
         payload = json.dumps(rec, default=str)
-        line = json.dumps({**rec, "crc": _line_crc(payload)},
+        return json.dumps({**rec, "crc": _line_crc(payload)},
                           default=str)
+
+    def append(self, epoch, **fields):
+        """Durably journal one completed epoch (flush + fsync)."""
+        line = self.format_line(epoch, **fields)
         with open(self.path, "a") as fh:
             fh.write(line + "\n")
             fh.flush()

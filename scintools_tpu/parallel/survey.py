@@ -269,7 +269,11 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
     one device program — ``chisq[B]`` the per-epoch fit chi-square,
     and ``power`` the sharded secondary spectrum of every epoch.
 
-    B must be divisible by the mesh's 'data' axis size.
+    B must be divisible by the mesh's 'data' axis size. Off-CPU the
+    epoch stack is DONATED: a pipelined driver keeping K step
+    programs in flight (robust/runner.py dispatch-ahead) recycles
+    each consumed batch's HBM into the next batch's sspec buffers
+    instead of holding both live.
     """
     jax = get_jax()
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -301,4 +305,10 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
         return out, chisq, power, tcut, fcut
 
     dyn_sh = batch_freq_sharding(mesh)
-    return jax.jit(step, in_shardings=(dyn_sh,))
+    kwargs = {}
+    if jax.default_backend() != "cpu":
+        # donate the epoch stack (cf. make_fused_grid_search_sharded);
+        # skipped on CPU/virtual meshes where XLA cannot alias it and
+        # warns on every compile
+        kwargs["donate_argnums"] = (0,)
+    return jax.jit(step, in_shardings=(dyn_sh,), **kwargs)

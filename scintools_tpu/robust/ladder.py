@@ -49,11 +49,15 @@ _TRANSIENT_MARKERS = ("resource_exhausted", "out of memory", "oom",
 class LadderError(RuntimeError):
     """Every tier of the fallback ladder failed for one epoch. Carries
     the per-attempt records so the caller can quarantine with a full
-    explanation instead of a bare traceback."""
+    explanation instead of a bare traceback. ``fatal`` marks an abort
+    on a corrupt input (:func:`_is_fatal`) — no further tier may be
+    tried for it (the pipelined runner checks this before descending
+    the remaining tiers on a deferred tier-0 failure)."""
 
-    def __init__(self, epoch, stage, attempts):
+    def __init__(self, epoch, stage, attempts, fatal=False):
         self.epoch = epoch
         self.stage = stage
+        self.fatal = bool(fatal)
         self.attempts = list(attempts)
         last = attempts[-1] if attempts else None
         super().__init__(
@@ -121,7 +125,8 @@ def run_ladder(tiers, epoch=None, stage="search", retries=1,
             except Exception as exc:  # noqa: BLE001 — ladder boundary
                 _record(report, epoch, stage, name, exc, attempt)
                 if _is_fatal(exc):
-                    raise LadderError(epoch, stage, report.attempts)
+                    raise LadderError(epoch, stage, report.attempts,
+                                      fatal=True)
                 if is_transient(exc) and attempt < int(retries):
                     attempt += 1
                     continue

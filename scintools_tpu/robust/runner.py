@@ -1,16 +1,20 @@
-"""Fault-tolerant, journaled survey runner.
+"""Fault-tolerant, journaled, PIPELINED survey runner.
 
 The production shape of a survey is "for each of ~10³ epochs: load →
 search → fit → append results". The naive loop dies with the first
 malformed file, poisons its batch with the first non-finite epoch, and
-loses everything to a preemption. This runner wraps the loop with the
-three robustness layers of this package:
+loses everything to a preemption; and even the robust sequential loop
+leaves the accelerator idle during every host load/parse and every
+fsynced journal line. This runner wraps the loop with the three
+robustness layers of this package AND the pipelined execution engine
+(parallel/pipeline.py):
 
 - **per-epoch quarantine** — an epoch whose loader raises
-  :class:`~scintools_tpu.io.MalformedInputError`, whose every
-  fallback tier fails, or whose result a validator rejects is recorded
-  as quarantined (structured slog record + journal line) and the
-  survey moves on. Healthy epochs are never touched by a bad
+  :class:`~scintools_tpu.io.MalformedInputError` (or any loader
+  exception — captured per epoch, never a pipeline crash), whose
+  every fallback tier fails, or whose result a validator rejects is
+  recorded as quarantined (structured slog record + journal line) and
+  the survey moves on. Healthy epochs are never touched by a bad
   neighbour: each epoch is processed independently and journaled
   results are bitwise what ``process`` returned.
 - **tiered fallback** — ``process(payload, tier=...)`` is dispatched
@@ -23,14 +27,28 @@ three robustness layers of this package:
   only unfinished epochs, so the resumed run's results are identical
   to an uninterrupted run (tests/test_robust.py pins this, including
   a real SIGKILL).
+- **pipelining** (default; ``pipeline=False`` keeps the strictly
+  sequential oracle) — epoch loading/preprocessing runs in a bounded
+  background prefetch queue, up to ``inflight`` dispatched epochs
+  stay un-fenced so JAX async dispatch keeps the device busy (results
+  are only fetched when consumed — ``process`` may return a
+  :class:`~scintools_tpu.parallel.pipeline.DeferredResult` or a dict
+  of still-in-flight device values), and journal CRC/fsync runs on a
+  writer thread with group commit. Epoch order, quarantine semantics,
+  journal bytes, and resume behaviour are IDENTICAL to the sequential
+  oracle (tests/test_pipeline.py pins byte-identical journals on
+  clean, fault-injected, and SIGKILL-resumed runs).
 
 Use :class:`~scintools_tpu.parallel.checkpoint.SurveyCheckpointer`
 alongside when the loop also carries large array state; the journal
-covers the per-epoch scalar results and progress cursor.
+covers the per-epoch scalar results and progress cursor. Pass a
+:class:`~scintools_tpu.utils.profiling.StageTimeline` as ``timeline``
+to account load/dispatch/fence/journal overlap per epoch.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 from dataclasses import asdict, dataclass, field
 
@@ -62,9 +80,88 @@ def _is_malformed(exc):
     return isinstance(exc, MalformedInputError)
 
 
+def _loader_outcome(epoch_id, exc):
+    """Quarantine outcome for an epoch whose LOADER failed (malformed
+    file, truncated read, preprocessing crash). The exception class is
+    preserved; non-:class:`MalformedInputError` loader failures are
+    still per-epoch quarantines — a bad file must never crash the
+    pipeline — but keep their own class for the post-mortem."""
+    slog.log_failure("robust.quarantine", epoch=epoch_id, stage="load",
+                     error=exc, tier=None, retry=0)
+    return EpochOutcome(
+        epoch=epoch_id, status="quarantined", tier="", retries=0,
+        error=str(exc)[:300], error_class=type(exc).__name__)
+
+
+def _load_inline(payload, load_fn):
+    """The sequential oracle's load stage: same semantics as the
+    background prefetch loader, on the calling thread."""
+    if load_fn is not None:
+        return load_fn(payload)
+    if callable(payload):
+        return payload()
+    return payload
+
+
+class _Recorder:
+    """Shared bookkeeping for both runner entries: tallies, ordered
+    outcomes, results, and journal appends (direct or via the async
+    writer)."""
+
+    def __init__(self, journal, writer, tiers):
+        self.journal = journal
+        self.writer = writer
+        self.outcomes = []
+        self.results = {}
+        self.tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
+                      "n_resumed": 0, "retries": 0,
+                      "tier_counts": {t: 0 for t in tiers}}
+
+    def _append(self, key, **fields):
+        if self.writer is not None:
+            self.writer.append(key, **fields)
+        else:
+            self.journal.append(key, **fields)
+
+    def resumed(self, epoch_id, rec):
+        out = EpochOutcome(epoch=epoch_id, status="resumed",
+                           tier=rec.get("tier", ""),
+                           result=rec.get("result") or {})
+        if rec.get("status") == "quarantined":
+            self.tally["n_quarantined"] += 1
+            out.error = rec.get("error", "")
+            out.error_class = rec.get("error_class", "")
+        else:
+            self.results[str(epoch_id)] = out.result
+        self.tally["n_resumed"] += 1
+        self.outcomes.append(out)
+        return out
+
+    def record(self, out):
+        """Tally + journal one fresh (non-resumed) outcome."""
+        key = str(out.epoch)
+        self.tally["retries"] += out.retries
+        if out.status == "ok":
+            self.tally["n_ok"] += 1
+            self.tally["tier_counts"][out.tier] = \
+                self.tally["tier_counts"].get(out.tier, 0) + 1
+            self.results[key] = out.result
+            self._append(key, status="ok", tier=out.tier,
+                         retries=out.retries, result=out.result)
+        else:
+            self.tally["n_quarantined"] += 1
+            self._append(key, status="quarantined", tier=out.tier,
+                         retries=out.retries, error=out.error,
+                         error_class=out.error_class)
+        self.outcomes.append(out)
+        return out
+
+
 def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
                retries=1, validate=None, journal_name="journal.jsonl",
-               resume=True):
+               resume=True, pipeline=True, prefetch=4, inflight=2,
+               loader_workers=2, load_fn=None, defer_validate=False,
+               timeline=None):
     """Process ``epochs`` — an iterable of ``(epoch_id, payload)`` —
     fault-tolerantly, journaling each completion to
     ``workdir/journal_name``.
@@ -80,6 +177,22 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
     result — e.g. require the device health bitmask be clean — and
     sends the epoch down to the next tier.
 
+    **Pipelined by default** (``pipeline=True``): a payload that is
+    CALLABLE is a lazy loader run in ``loader_workers`` background
+    threads at most ``prefetch`` epochs ahead (``load_fn`` instead
+    maps every payload in the background); up to ``inflight`` epochs
+    stay dispatched-but-un-fenced so the device queue never drains —
+    ``process`` may return a dict of in-flight device values or a
+    :class:`~scintools_tpu.parallel.pipeline.DeferredResult`, fenced
+    only at consumption; journal fsyncs run on a writer thread
+    (group commit, drained before return). Epoch order, quarantine
+    semantics, journal bytes, and resume behaviour match the
+    ``pipeline=False`` sequential oracle exactly. A ``validate`` hook
+    disables dispatch-ahead (results fence immediately, in order)
+    unless ``defer_validate=True`` declares it stateless. ``timeline``
+    (a :class:`~scintools_tpu.utils.profiling.StageTimeline`) records
+    per-epoch load/dispatch/fence/journal spans.
+
     Returns ``{"results": {epoch_id: result_dict},
     "outcomes": [EpochOutcome...], "summary": {...}}`` where summary
     counts ok/quarantined/resumed epochs, per-tier completions, and
@@ -89,61 +202,195 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
     os.makedirs(workdir, exist_ok=True)
     journal = EpochJournal(os.path.join(workdir, journal_name))
     done = journal.records() if resume else {}
-
-    outcomes = []
-    results = {}
-    tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
-             "n_resumed": 0, "retries": 0,
-             "tier_counts": {t: 0 for t in tiers}}
     epochs = list(epochs)
+
     with slog.span("survey.robust_run", n_epochs=len(epochs),
-                   workdir=os.fspath(workdir)):
-        for epoch_id, payload in epochs:
-            tally["n_epochs"] += 1
-            key = str(epoch_id)
-            if key in done:
-                rec = done[key]
-                out = EpochOutcome(
-                    epoch=epoch_id, status="resumed",
-                    tier=rec.get("tier", ""),
-                    result=rec.get("result") or {})
-                if rec.get("status") == "quarantined":
-                    tally["n_quarantined"] += 1
-                    out.error = rec.get("error", "")
-                    out.error_class = rec.get("error_class", "")
-                else:
-                    results[key] = out.result
-                tally["n_resumed"] += 1
-                outcomes.append(out)
-                continue
-            out = _run_one(epoch_id, payload, process, tiers, retries,
-                           validate)
-            tally["retries"] += out.retries
-            if out.status == "ok":
-                tally["n_ok"] += 1
-                tally["tier_counts"][out.tier] = \
-                    tally["tier_counts"].get(out.tier, 0) + 1
-                results[key] = out.result
-                journal.append(key, status="ok", tier=out.tier,
-                               retries=out.retries, result=out.result)
-            else:
-                tally["n_quarantined"] += 1
-                journal.append(key, status="quarantined",
-                               tier=out.tier, retries=out.retries,
-                               error=out.error,
-                               error_class=out.error_class)
-            outcomes.append(out)
+                   workdir=os.fspath(workdir),
+                   pipeline=bool(pipeline)):
+        if pipeline:
+            rec = _run_pipelined(
+                epochs, process, journal, done, tiers, retries,
+                validate, prefetch, inflight, loader_workers, load_fn,
+                defer_validate, timeline)
+        else:
+            rec = _run_sequential(epochs, process, journal, done,
+                                  tiers, retries, validate, load_fn,
+                                  timeline)
         slog.log_event("survey.robust_summary", **{
-            k: v for k, v in tally.items() if k != "tier_counts"},
-            tier_counts=dict(tally["tier_counts"]))
-    return {"results": results, "outcomes": outcomes,
-            "summary": tally}
+            k: v for k, v in rec.tally.items() if k != "tier_counts"},
+            tier_counts=dict(rec.tally["tier_counts"]))
+    if timeline is not None:
+        timeline.log_summary()
+    return {"results": rec.results, "outcomes": rec.outcomes,
+            "summary": rec.tally}
+
+
+def _run_sequential(epochs, process, journal, done, tiers, retries,
+                    validate, load_fn, timeline):
+    """The strictly sequential oracle: load, process, fsync — one
+    epoch at a time on the calling thread (the pre-pipeline PR-2
+    loop; kept as the parity/throughput baseline)."""
+    rec = _Recorder(journal, None, tiers)
+    for epoch_id, payload in epochs:
+        rec.tally["n_epochs"] += 1
+        key = str(epoch_id)
+        if key in done:
+            rec.resumed(epoch_id, done[key])
+            continue
+        try:
+            if timeline is not None:
+                with timeline.span(epoch_id, "load"):
+                    payload = _load_inline(payload, load_fn)
+            else:
+                payload = _load_inline(payload, load_fn)
+        except Exception as e:  # noqa: BLE001 — per-epoch quarantine
+            rec.record(_loader_outcome(epoch_id, e))
+            continue
+        rec.record(_run_one(epoch_id, payload, process, tiers,
+                            retries, validate))
+    return rec
+
+
+def _run_pipelined(epochs, process, journal, done, tiers, retries,
+                   validate, prefetch, inflight, loader_workers,
+                   load_fn, defer_validate, timeline):
+    """The pipelined engine: bounded prefetch loader feeding a
+    dispatch-ahead window of un-fenced epochs, results consumed (and
+    journaled via the threaded writer) in strict epoch order.
+
+    A ``validate`` hook forces immediate fencing (the window is
+    consumed right after each dispatch) unless ``defer_validate``:
+    validators may be stateful — closed over the last-dispatched
+    tier, a call counter — and deferring them would change what they
+    observe relative to the sequential oracle. ``defer_validate=True``
+    opts a STATELESS validator (e.g. the device health-bitmask check)
+    back into the full dispatch-ahead window."""
+    from ..parallel.pipeline import AsyncJournalWriter, PrefetchLoader
+
+    inflight = max(1, int(inflight))
+    if validate is not None and not defer_validate:
+        inflight = 0
+    writer = AsyncJournalWriter(journal, timeline=timeline)
+    rec = _Recorder(journal, writer, tiers)
+    window = collections.deque()   # (epoch_id, payload, value, report)
+
+    def consume_one():
+        epoch_id, payload, value, report = window.popleft()
+        if isinstance(value, EpochOutcome):   # already decided
+            rec.record(value)
+            return
+        if timeline is not None:
+            with timeline.span(epoch_id, "fence"):
+                out = _consume_deferred(epoch_id, payload, value,
+                                        report, process, tiers,
+                                        retries, validate)
+        else:
+            out = _consume_deferred(epoch_id, payload, value, report,
+                                    process, tiers, retries, validate)
+        rec.record(out)
+
+    loader = PrefetchLoader(
+        ((eid, p) for eid, p in epochs if str(eid) not in done),
+        depth=prefetch, workers=loader_workers, load_fn=load_fn,
+        timeline=timeline)
+    try:
+        with loader:
+            loaded = iter(loader)
+            for epoch_id, payload in epochs:
+                rec.tally["n_epochs"] += 1
+                key = str(epoch_id)
+                if key in done:
+                    # strict order: everything dispatched before this
+                    # resumed epoch is consumed first, so outcome and
+                    # journal order match the sequential oracle
+                    while window:
+                        consume_one()
+                    rec.resumed(epoch_id, done[key])
+                    continue
+                eid, item = next(loaded)
+                assert str(eid) == key, (eid, epoch_id)
+                if not item.ok:
+                    window.append((epoch_id, None,
+                                   _loader_outcome(epoch_id,
+                                                   item.error), None))
+                else:
+                    if timeline is not None:
+                        with timeline.span(epoch_id, "dispatch"):
+                            entry = _dispatch_first(
+                                epoch_id, item.payload, process,
+                                tiers, retries, validate)
+                    else:
+                        entry = _dispatch_first(
+                            epoch_id, item.payload, process, tiers,
+                            retries, validate)
+                    window.append(entry)
+                while len(window) > inflight:
+                    consume_one()
+            while window:
+                consume_one()
+    finally:
+        # durability barrier: every journal line fsynced before the
+        # summary is trusted (PR-2 resume guarantee)
+        writer.close()
+    return rec
+
+
+def _dispatch_first(epoch_id, payload, process, tiers, retries,
+                    validate):
+    """Dispatch the FIRST tier without fencing: on success the raw
+    (possibly still in-flight) value enters the window; validation
+    and host conversion wait for consumption. Tier-0 exhaustion falls
+    through the remaining tiers synchronously with the attempt trail
+    carried over (ladder semantics identical to the sequential
+    path)."""
+    report = _ladder.LadderReport()
+    try:
+        value, report = _ladder.run_ladder(
+            [(tiers[0], lambda: process(payload, tier=tiers[0]))],
+            epoch=epoch_id, stage="process", retries=retries,
+            report=report)
+        return (epoch_id, payload, value, report)
+    except _ladder.LadderError as exc:
+        if exc.fatal or len(tiers) == 1:
+            return (epoch_id, None,
+                    _quarantined_outcome(epoch_id, exc), None)
+        out = _run_one(epoch_id, payload, process, tiers[1:], retries,
+                       validate, report=report)
+        return (epoch_id, None, out, None)
+
+
+def _consume_deferred(epoch_id, payload, value, report, process,
+                      tiers, retries, validate):
+    """Fence + validate a deferred tier-0 result; a validator
+    rejection descends the remaining tiers exactly as the sequential
+    ladder would (same attempt records, same retry counts)."""
+    from ..parallel.pipeline import finalize_result
+
+    try:
+        result = finalize_result(value)
+        if validate is not None and not validate(result):
+            raise ValueError(
+                f"validator rejected tier {tiers[0]} result for "
+                f"epoch {epoch_id!r}")
+    except Exception as exc:  # noqa: BLE001 — a fence/validate
+        # failure is one failed attempt on tier 0 (with its usual
+        # slog robust.fallback record, emitted by _record); the
+        # remaining tiers run synchronously with the trail carried
+        _ladder._record(report, epoch_id, "process", tiers[0], exc, 0)
+        if len(tiers) == 1:
+            return _quarantined_outcome(epoch_id, _ladder.LadderError(
+                epoch_id, "process", report.attempts))
+        return _run_one(epoch_id, payload, process, tiers[1:],
+                        retries, validate, report=report)
+    return EpochOutcome(epoch=epoch_id, status="ok", tier=report.tier,
+                        retries=report.retries, result=dict(result))
 
 
 def run_survey_batched(epochs, process_batch, workdir, process=None,
                        batch_size=32, tiers=_DEFAULT_TIERS, retries=1,
                        validate=None, journal_name="journal.jsonl",
-                       resume=True):
+                       resume=True, pipeline=True, prefetch=4,
+                       loader_workers=2, load_fn=None, timeline=None):
     """Batched counterpart of :func:`run_survey` for device programs
     that fit a whole epoch stack at once (e.g.
     ``fit/acf2d.py:fit_acf2d_batch`` — one compile, one H2D, one
@@ -164,10 +411,19 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
     batch down, and a healthy batch costs one device program instead
     of N.
 
+    With ``pipeline=True`` (default) callable payloads load in a
+    bounded background prefetch queue (``prefetch`` deep,
+    ``loader_workers`` threads; loader failures quarantine that epoch
+    only) and journal fsyncs run on the threaded writer, which DRAINS
+    at every batch boundary — the PR-2 SIGKILL-resume guarantee is
+    unchanged. ``pipeline=False`` is the sequential oracle.
+
     Journal format, resume semantics, and the return structure are
     shared with :func:`run_survey` (same ``workdir`` journal resumes
     either entry); the summary additionally counts ``n_batches``.
     """
+    from ..parallel.pipeline import AsyncJournalWriter, PrefetchLoader
+
     os.makedirs(workdir, exist_ok=True)
     journal = EpochJournal(os.path.join(workdir, journal_name))
     done = journal.records() if resume else {}
@@ -176,121 +432,171 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
         def validate(result):                 # noqa: ANN001
             return int(result.get("ok", 0) or 0) == 0
 
-    outcomes = {}
-    results = {}
-    tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
-             "n_resumed": 0, "retries": 0, "n_batches": 0,
-             "tier_counts": {t: 0 for t in tiers}}
+    writer = AsyncJournalWriter(journal, timeline=timeline) \
+        if pipeline else None
+    rec = _Recorder(journal, writer, tiers)
+    rec.tally["n_batches"] = 0
+    outcomes_by_key = {}
 
     def _record(epoch_id, out):
-        key = str(epoch_id)
-        outcomes[key] = out
-        tally["retries"] += out.retries
-        if out.status == "ok":
-            tally["n_ok"] += 1
-            tally["tier_counts"][out.tier] = \
-                tally["tier_counts"].get(out.tier, 0) + 1
-            results[key] = out.result
-            journal.append(key, status="ok", tier=out.tier,
-                           retries=out.retries, result=out.result)
-        else:
-            tally["n_quarantined"] += 1
-            journal.append(key, status="quarantined", tier=out.tier,
-                           retries=out.retries, error=out.error,
-                           error_class=out.error_class)
+        # the ordered outcome view is rebuilt from this map at return
+        # (lane rejects complete out of epoch order)
+        outcomes_by_key[str(epoch_id)] = out
+        rec.record(out)
 
     epochs = list(epochs)
     pending = []
-    with slog.span("survey.robust_run_batched", n_epochs=len(epochs),
-                   batch_size=batch_size,
-                   workdir=os.fspath(workdir)):
-        for epoch_id, payload in epochs:
-            tally["n_epochs"] += 1
-            key = str(epoch_id)
-            if key in done:
-                rec = done[key]
-                out = EpochOutcome(
-                    epoch=epoch_id, status="resumed",
-                    tier=rec.get("tier", ""),
-                    result=rec.get("result") or {})
-                if rec.get("status") == "quarantined":
-                    tally["n_quarantined"] += 1
-                    out.error = rec.get("error", "")
-                    out.error_class = rec.get("error_class", "")
+    try:
+        with slog.span("survey.robust_run_batched",
+                       n_epochs=len(epochs), batch_size=batch_size,
+                       workdir=os.fspath(workdir),
+                       pipeline=bool(pipeline)):
+            loader = None
+            scan = iter(epochs)
+            if pipeline:
+                loader = PrefetchLoader(
+                    ((eid, p) for eid, p in epochs
+                     if str(eid) not in done),
+                    depth=prefetch, workers=loader_workers,
+                    load_fn=load_fn, timeline=timeline)
+                loaded = iter(loader)
+            for epoch_id, payload in scan:
+                rec.tally["n_epochs"] += 1
+                key = str(epoch_id)
+                if key in done:
+                    outcomes_by_key[key] = rec.resumed(epoch_id,
+                                                       done[key])
+                    continue
+                if pipeline:
+                    eid, item = next(loaded)
+                    assert str(eid) == key, (eid, epoch_id)
+                    if not item.ok:
+                        _record(epoch_id,
+                                _loader_outcome(epoch_id, item.error))
+                        continue
+                    payload = item.payload
                 else:
-                    results[key] = out.result
-                tally["n_resumed"] += 1
-                outcomes[key] = out
-                continue
-            pending.append((epoch_id, payload))
+                    try:
+                        payload = _load_inline(payload, load_fn)
+                    except Exception as e:  # noqa: BLE001 — per-epoch
+                        _record(epoch_id, _loader_outcome(epoch_id, e))
+                        continue
+                pending.append((epoch_id, payload))
+            if loader is not None:
+                loader.close()
 
-        rest_tiers = tuple(tiers[1:])
-        for i in range(0, len(pending), batch_size):
-            group = pending[i:i + batch_size]
-            tally["n_batches"] += 1
-            try:
-                value, report = _ladder.run_ladder(
-                    [(tiers[0], lambda: process_batch(
-                        [p for _, p in group], tier=tiers[0]))],
-                    epoch=f"batch[{i}:{i + len(group)}]",
-                    stage="process_batch", retries=retries)
-                batch_results = list(value)
-                if len(batch_results) != len(group):
-                    raise ValueError(
-                        f"process_batch returned {len(batch_results)} "
-                        f"results for {len(group)} epochs")
-            except (_ladder.LadderError, ValueError) as exc:
-                slog.log_failure("robust.batch_fallback",
-                                 epoch=f"batch[{i}]",
-                                 stage="process_batch", error=exc,
-                                 tier=tiers[0], retry=0)
-                # whole-batch failure: every lane takes the per-epoch
-                # ladder (quarantine isolation unchanged)
-                for epoch_id, payload in group:
-                    if process is None:
+            rest_tiers = tuple(tiers[1:])
+            for i in range(0, len(pending), batch_size):
+                group = pending[i:i + batch_size]
+                rec.tally["n_batches"] += 1
+                try:
+                    if timeline is not None:
+                        with timeline.span(f"batch[{i}]", "compute"):
+                            value, report = _ladder.run_ladder(
+                                [(tiers[0], lambda: process_batch(
+                                    [p for _, p in group],
+                                    tier=tiers[0]))],
+                                epoch=f"batch[{i}:{i + len(group)}]",
+                                stage="process_batch",
+                                retries=retries)
+                    else:
+                        value, report = _ladder.run_ladder(
+                            [(tiers[0], lambda: process_batch(
+                                [p for _, p in group],
+                                tier=tiers[0]))],
+                            epoch=f"batch[{i}:{i + len(group)}]",
+                            stage="process_batch", retries=retries)
+                    batch_results = list(value)
+                    if len(batch_results) != len(group):
+                        raise ValueError(
+                            f"process_batch returned "
+                            f"{len(batch_results)} results for "
+                            f"{len(group)} epochs")
+                except (_ladder.LadderError, ValueError) as exc:
+                    slog.log_failure("robust.batch_fallback",
+                                     epoch=f"batch[{i}]",
+                                     stage="process_batch", error=exc,
+                                     tier=tiers[0], retry=0)
+                    # whole-batch failure: every lane takes the
+                    # per-epoch ladder (quarantine isolation
+                    # unchanged)
+                    for epoch_id, payload in group:
+                        if process is None:
+                            _record(epoch_id, EpochOutcome(
+                                epoch=epoch_id, status="quarantined",
+                                tier=tiers[0], error=str(exc),
+                                error_class=type(exc).__name__))
+                        else:
+                            _record(epoch_id, _run_one(
+                                epoch_id, payload, process, tiers,
+                                retries, None))
+                    continue
+                for (epoch_id, payload), result in zip(group,
+                                                       batch_results):
+                    if validate(result):
+                        _record(epoch_id, EpochOutcome(
+                            epoch=epoch_id, status="ok",
+                            tier=tiers[0], result=dict(result)))
+                        continue
+                    slog.log_failure(
+                        "robust.lane_reject", epoch=epoch_id,
+                        stage="process_batch", tier=tiers[0],
+                        error=ValueError(
+                            f"lane health rejected (ok="
+                            f"{result.get('ok', 'validator')!r})"),
+                        retry=0)
+                    if process is None or not rest_tiers:
                         _record(epoch_id, EpochOutcome(
                             epoch=epoch_id, status="quarantined",
-                            tier=tiers[0], error=str(exc),
-                            error_class=type(exc).__name__))
+                            tier=tiers[0],
+                            error="lane health rejected",
+                            error_class="LaneRejected"))
                     else:
                         _record(epoch_id, _run_one(
-                            epoch_id, payload, process, tiers,
+                            epoch_id, payload, process, rest_tiers,
                             retries, None))
-                continue
-            for (epoch_id, payload), result in zip(group,
-                                                   batch_results):
-                if validate(result):
-                    _record(epoch_id, EpochOutcome(
-                        epoch=epoch_id, status="ok", tier=tiers[0],
-                        result=dict(result)))
-                    continue
-                slog.log_failure(
-                    "robust.lane_reject", epoch=epoch_id,
-                    stage="process_batch", tier=tiers[0],
-                    error=ValueError(
-                        f"lane health rejected (ok="
-                        f"{result.get('ok', 'validator')!r})"),
-                    retry=0)
-                if process is None or not rest_tiers:
-                    _record(epoch_id, EpochOutcome(
-                        epoch=epoch_id, status="quarantined",
-                        tier=tiers[0],
-                        error="lane health rejected",
-                        error_class="LaneRejected"))
-                else:
-                    _record(epoch_id, _run_one(
-                        epoch_id, payload, process, rest_tiers,
-                        retries, None))
-        slog.log_event("survey.robust_batched_summary", **{
-            k: v for k, v in tally.items() if k != "tier_counts"},
-            tier_counts=dict(tally["tier_counts"]))
-    ordered = [outcomes[str(e)] for e, _ in epochs]
-    return {"results": results, "outcomes": ordered,
-            "summary": tally}
+                if writer is not None:
+                    # batch-boundary durability barrier (PR-2
+                    # guarantee: at most the in-flight batch redone)
+                    writer.drain()
+            slog.log_event("survey.robust_batched_summary", **{
+                k: v for k, v in rec.tally.items()
+                if k != "tier_counts"},
+                tier_counts=dict(rec.tally["tier_counts"]))
+    finally:
+        if writer is not None:
+            writer.close()
+    if timeline is not None:
+        timeline.log_summary()
+    ordered = [outcomes_by_key[str(e)] for e, _ in epochs]
+    return {"results": rec.results, "outcomes": ordered,
+            "summary": rec.tally}
 
 
-def _run_one(epoch_id, payload, process, tiers, retries, validate):
-    """Dispatch one epoch through the ladder; never raises."""
+def _quarantined_outcome(epoch_id, exc):
+    """Quarantine outcome from an exhausted ladder, with the slog
+    record :func:`_run_one` has always emitted."""
+    slog.log_failure("robust.quarantine", epoch=epoch_id,
+                     stage="process", error=exc,
+                     tier=exc.attempts[-1]["tier"]
+                     if exc.attempts else None,
+                     retry=len(exc.attempts))
+    last = exc.attempts[-1] if exc.attempts else {}
+    # a malformed input shows up as the same error on every tier;
+    # collapse the trail to the first record's class
+    return EpochOutcome(
+        epoch=epoch_id, status="quarantined",
+        retries=len(exc.attempts),
+        error=last.get("error", str(exc)),
+        error_class=last.get("error_class", "LadderError"))
+
+
+def _run_one(epoch_id, payload, process, tiers, retries, validate,
+             report=None):
+    """Dispatch one epoch through the ladder; never raises. A seeded
+    ``report`` carries earlier attempts (the pipelined path's
+    deferred tier-0 failure) into the retry count and quarantine
+    trail."""
 
     def tier_fn(name):
         def run():
@@ -306,21 +612,9 @@ def _run_one(epoch_id, payload, process, tiers, retries, validate):
     try:
         value, report = _ladder.run_ladder(
             [(t, tier_fn(t)) for t in tiers], epoch=epoch_id,
-            stage="process", retries=retries)
+            stage="process", retries=retries, report=report)
     except _ladder.LadderError as exc:
-        slog.log_failure("robust.quarantine", epoch=epoch_id,
-                         stage="process", error=exc,
-                         tier=exc.attempts[-1]["tier"]
-                         if exc.attempts else None,
-                         retry=len(exc.attempts))
-        last = exc.attempts[-1] if exc.attempts else {}
-        # a malformed input shows up as the same error on every tier;
-        # collapse the trail to the first record's class
-        return EpochOutcome(
-            epoch=epoch_id, status="quarantined",
-            retries=len(exc.attempts),
-            error=last.get("error", str(exc)),
-            error_class=last.get("error_class", "LadderError"))
+        return _quarantined_outcome(epoch_id, exc)
     return EpochOutcome(epoch=epoch_id, status="ok", tier=report.tier,
                         retries=report.retries, result=dict(value))
 

@@ -393,7 +393,8 @@ def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None,
     fd_a = np.asarray(unit_checks(fd, "fd"), dtype=float)
     edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
     fn = _jitted_eval_fn(tau_a, fd_a, edges_a, iters, method=method)
-    return np.asarray(fn(jnp.asarray(cs_to_ri(CS)), jnp.asarray(etas)))
+    return np.asarray(  # sync-ok: eager host API returns numpy eigs
+        fn(jnp.asarray(cs_to_ri(CS)), jnp.asarray(etas)))
 
 
 def modeler(CS, tau, fd, eta, edges, hermetian=True, backend=None):

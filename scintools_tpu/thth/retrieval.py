@@ -492,7 +492,8 @@ def vlbi_retrieval_batch(dspecs, edges, eta, dt, df, n_dish, npad=3,
                                         None)))
     else:
         d_dev = jnp.asarray(d_in)
-    E_ri = np.asarray(fn(d_dev, jnp.asarray(edges), eta,
+    E_ri = np.asarray(fn(d_dev, jnp.asarray(edges), eta,  # sync-ok:
+                         # host API — callers consume the E-field
                          float(tau_mask)))[:B]
     return E_ri[:, :, 0] + 1j * E_ri[:, :, 1]
 
@@ -812,8 +813,9 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
         return rot_mos(chunks, res.x), res
     phases = res.x[: nchunk - 1]
     amps = res.x[nchunk - 1:]
-    E = np.asarray(_jax_stack(chunks_j, masks_j, jnp.asarray(phases),
-                              jnp.asarray(amps), jnp))
+    E = np.asarray(  # sync-ok: final mosaic fetch, host return value
+        _jax_stack(chunks_j, masks_j, jnp.asarray(phases),
+                   jnp.asarray(amps), jnp))
     return E, res
 
 

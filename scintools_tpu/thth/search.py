@@ -371,7 +371,9 @@ def _multi_chunk_search_staged(dspecs, freq, times, etas, edges,
         cs_ri.append(cs_to_ri(base).astype(np.float32))
     edges_a = np.asarray(unit_checks(edges, "edges"), dtype=float)
     fn = _jitted_multi_eval(tau, fd, edges_a, method)
-    eigs_all = np.asarray(fn(jnp.asarray(np.stack(cs_ri)),
+    eigs_all = np.asarray(fn(jnp.asarray(np.stack(cs_ri)),  # sync-ok:
+                             # staged-path consumption boundary — the
+                             # host scipy peak fit needs the curves
                              jnp.asarray(etas)))
 
     freq_m = float(np.asarray(unit_checks(freq, "freq"),
@@ -523,7 +525,9 @@ def multi_chunk_search_thin(dspecs, freq, times, etas, edges,
                           dtype=float)
     fn = _jitted_thin_eval(tau, fd, edges_a, arclet_a,
                            float(unit_checks(centerCut, "center_cut")))
-    sigs = np.asarray(fn(jnp.asarray(np.stack(cs_ri)),
+    sigs = np.asarray(fn(jnp.asarray(np.stack(cs_ri)),  # sync-ok:
+                         # staged-path consumption boundary (host
+                         # peak fit consumes the significance curves)
                          jnp.asarray(etas)))
 
     freq_m = float(np.asarray(unit_checks(freq, "freq"),

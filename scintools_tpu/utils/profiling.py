@@ -101,6 +101,132 @@ class Timer:
         return "\n".join(rows)
 
 
+def _interval_union(intervals):
+    """Total length of the union of ``[(t0, t1), ...]`` intervals."""
+    total = 0.0
+    end = -np.inf
+    for t0, t1 in sorted(intervals):
+        if t1 <= end:
+            continue
+        total += t1 - max(t0, end)
+        end = t1
+    return total
+
+
+class StageTimeline:
+    """Per-epoch stage-span recorder with overlap accounting — the
+    observability half of the pipelined survey engine
+    (parallel/pipeline.py + robust/runner.py).
+
+    Each pipeline stage of each epoch records one wall-clock span:
+
+    >>> tl = StageTimeline()
+    >>> with tl.span("e0", "load"):
+    ...     payload = load(path)          # in a prefetch worker
+    >>> with tl.span("e0", "compute"):
+    ...     out = program(payload)
+    >>> tl.summary()["overlap_frac"]
+
+    Spans may be recorded from any thread (`record` appends under a
+    lock); the clock is ``time.perf_counter`` so spans from the
+    loader threads, the main dispatch loop, and the journal writer
+    share one timeline.
+
+    :meth:`summary` reports:
+
+    - ``wall_s`` — last span end − first span start;
+    - ``stage_busy_s`` — per-stage union of that stage's intervals
+      (concurrent loads of two epochs count once where they overlap);
+    - ``busy_s`` — union of ALL spans (time at least one stage was
+      active);
+    - ``overlap_frac`` — ``1 − busy_s / Σ stage_busy_s``: 0 for a
+      strictly sequential run (stages never coincide), → 0.5 when two
+      stages are perfectly hidden behind each other, higher with more
+      stages overlapped;
+    - ``device_idle_s`` — wall time NOT covered by a
+      ``device_stage`` span (default ``"compute"``): what an
+      accelerator would have wasted waiting on the host.
+
+    ``log_summary()`` emits the summary as one structured slog event
+    (utils/slog.py) so a survey run's pipeline efficiency is
+    greppable next to its quarantine/fallback records.
+    """
+
+    def __init__(self, device_stage="compute"):
+        import threading
+
+        self.device_stage = device_stage
+        self._spans = []                # (stage, epoch, t0, t1)
+        self._lock = threading.Lock()
+
+    def record(self, epoch, stage, t0, t1):
+        """Record one finished span (absolute perf_counter times)."""
+        with self._lock:
+            self._spans.append((str(stage), epoch, float(t0),
+                                float(t1)))
+
+    @contextmanager
+    def span(self, epoch, stage):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(epoch, stage, t0, time.perf_counter())
+
+    def stages(self):
+        return sorted({s for s, _, _, _ in self._spans})
+
+    def summary(self):
+        if not self._spans:
+            return {"n_spans": 0, "n_epochs": 0, "wall_s": 0.0,
+                    "busy_s": 0.0, "overlap_frac": 0.0,
+                    "device_idle_s": 0.0, "stage_busy_s": {}}
+        spans = list(self._spans)
+        t_start = min(t0 for _, _, t0, _ in spans)
+        t_end = max(t1 for _, _, _, t1 in spans)
+        wall = t_end - t_start
+        by_stage = {}
+        for stage, _, t0, t1 in spans:
+            by_stage.setdefault(stage, []).append((t0, t1))
+        stage_busy = {s: _interval_union(v)
+                      for s, v in by_stage.items()}
+        busy = _interval_union([(t0, t1) for _, _, t0, t1 in spans])
+        total = sum(stage_busy.values())
+        device_busy = _interval_union(
+            by_stage.get(self.device_stage, []))
+        return {
+            "n_spans": len(spans),
+            "n_epochs": len({e for _, e, _, _ in spans}),
+            "wall_s": round(wall, 4),
+            "busy_s": round(busy, 4),
+            "stage_busy_s": {s: round(v, 4)
+                             for s, v in sorted(stage_busy.items())},
+            "overlap_frac": round(1.0 - busy / total, 4)
+            if total > 0 else 0.0,
+            "device_idle_s": round(max(0.0, wall - device_busy), 4),
+        }
+
+    def log_summary(self, event="survey.pipeline_timeline", **extra):
+        """Emit :meth:`summary` as one structured slog event; returns
+        the summary dict."""
+        from . import slog
+
+        out = self.summary()
+        slog.log_event(event, **out, **extra)
+        return out
+
+    def report(self):
+        """Fixed-width per-stage table (cf. :class:`Timer.report`)."""
+        s = self.summary()
+        rows = [f"{'stage':<12}{'busy_s':>10}",
+                *(f"{name:<12}{busy:>10.4f}"
+                  for name, busy in s["stage_busy_s"].items()),
+                f"{'wall':<12}{s['wall_s']:>10.4f}",
+                f"overlap_frac {s['overlap_frac']:.3f}  "
+                f"device_idle_s {s['device_idle_s']:.4f}"]
+        return "\n".join(rows)
+
+
 @contextmanager
 def trace(trace_dir):
     """jax.profiler trace context (view with TensorBoard / xprof).

@@ -8,6 +8,14 @@
   ``jax.jit`` in scintools_tpu/fit/ — compiled programs must be built
   lazily inside cached factories so cold-start and test collection
   stay fast (and cannot hang on a dead accelerator tunnel).
+- tools/lint_syncpoints.py (ISSUE 4 satellite) forbids premature
+  device-sync points (``.block_until_ready``, eager ``np.asarray`` on
+  in-flight device values) in the library hot paths ``ops/``,
+  ``fit/``, ``thth/``, ``parallel/`` — the pipelined survey engine
+  only overlaps host and device work if the dispatch chain stays
+  async. Deliberate result-consumption boundaries carry a
+  ``# sync-ok: <reason>`` marker; utils/profiling.py (whose job IS
+  fencing) is allowlisted.
 """
 
 import importlib.util
@@ -100,3 +108,55 @@ class TestImportTimeJit:
                "    def m(self):\n"
                "        return jax.jit(lambda x: x)\n")
         assert lint.scan_source(src) == []
+
+
+class TestSyncpoints:
+    """tools/lint_syncpoints.py (ISSUE 4): library hot paths must not
+    fence the device queue — the acceptance gate is zero violations
+    across ops/, fit/, thth/, parallel/."""
+
+    def test_hot_paths_are_clean(self):
+        lint = _tool("lint_syncpoints")
+        violations = []
+        for d in ("ops", "fit", "thth", "parallel"):
+            violations.extend(lint.scan_tree(
+                os.path.join(REPO, "scintools_tpu", d)))
+        assert violations == [], (
+            "premature device-sync points in library hot paths "
+            f"(fence only at consumption boundaries): {violations}")
+
+    def test_detector_flags_block_until_ready(self):
+        lint = _tool("lint_syncpoints")
+        out = lint.scan_source("y = fn(x).block_until_ready()\n")
+        assert len(out) == 1 and "block_until_ready" in out[0][1]
+        out = lint.scan_source("jax.block_until_ready(fn(x))\n")
+        assert len(out) == 1
+
+    def test_detector_flags_dispatch_and_fetch(self):
+        lint = _tool("lint_syncpoints")
+        out = lint.scan_source(
+            "v = np.asarray(f(jnp.asarray(x)))\n")
+        assert len(out) == 1 and "one expression" in out[0][1]
+        out = lint.scan_source(
+            "v = float(f(jax.device_put(x)))\n")
+        assert len(out) == 1
+
+    def test_detector_flags_jit_bound_fetch(self):
+        lint = _tool("lint_syncpoints")
+        src = ("import jax\ng = jax.jit(lambda x: x)\n"
+               "v = np.asarray(g(y))\n")
+        out = lint.scan_source(src)
+        assert len(out) == 1 and "jit-bound" in out[0][1]
+
+    def test_detector_respects_marker_and_plain_asarray(self):
+        lint = _tool("lint_syncpoints")
+        src = ("v = np.asarray(f(jnp.asarray(x)))  # sync-ok: edge\n"
+               "w = np.asarray(unit_checks(x))\n"
+               "u = np.asarray(host_array)\n")
+        assert lint.scan_source(src) == []
+
+    def test_allowlist_exempts_profiling(self):
+        lint = _tool("lint_syncpoints")
+        assert lint._allowlisted(
+            os.path.join(REPO, "scintools_tpu", "utils",
+                         "profiling.py"), REPO)

@@ -73,7 +73,98 @@ def _free_port():
     return port
 
 
+# capability-probe worker: the MINIMAL two-process bring-up + one
+# jitted cross-process reduction. Some images ship a jax whose CPU
+# backend has no multiprocess collectives ("Multiprocess computations
+# aren't implemented on the CPU backend") — that is a platform
+# capability gap, not a regression in this repo, so the full test
+# SKIPS with the probe's reason instead of failing (ISSUE 4
+# satellite; the probe result is cached per session).
+_PROBE_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from scintools_tpu.backend import force_cpu_platform
+    force_cpu_platform(2)
+    from scintools_tpu.parallel.checkpoint import initialize_distributed
+    initialize_distributed({addr!r}, 2, {pid})
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from scintools_tpu import parallel as par
+    mesh = par.make_mesh(4)
+    arr = jax.make_array_from_callback(
+        (4, 4), NamedSharding(mesh, P(("data", "seq"))),
+        lambda idx: np.ones((1, 4)))
+    total = float(jax.jit(jnp.sum)(arr))
+    assert total == 16.0, total
+    print("PROBE_OK", {pid})
+""")
+
+_CAPABILITY = {}
+
+_UNSUPPORTED_MARKERS = (
+    "aren't implemented", "not implemented", "unimplemented",
+    "does not support", "unsupported")
+
+
+def _cpu_multiprocess_collectives_supported():
+    """(ok, reason): spawn two 2-device workers doing one jitted
+    global reduction. ``ok=False`` ONLY for the known
+    capability-missing signatures — an unexpected failure returns
+    ``ok=True`` so the full test still runs (and fails loudly) on a
+    real regression."""
+    if "result" in _CAPABILITY:
+        return _CAPABILITY["result"]
+    import tempfile
+    import time
+
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+        env.pop(k, None)
+    with tempfile.TemporaryDirectory() as d:
+        procs = []
+        for pid in (0, 1):
+            script = os.path.join(d, f"probe{pid}.py")
+            with open(script, "w") as fh:
+                fh.write(_PROBE_WORKER.format(repo=REPO, addr=addr,
+                                              pid=pid))
+            procs.append(subprocess.Popen(
+                [sys.executable, script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.monotonic() + 120
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(
+                    timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, err = p.communicate()
+            outs.append((p.returncode, out.decode(), err.decode()))
+    result = (True, "collectives probe passed")
+    for rc, out, err in outs:
+        if rc == 0:
+            continue
+        low = err.lower()
+        if any(m in low for m in _UNSUPPORTED_MARKERS):
+            tail = [ln for ln in err.strip().splitlines()
+                    if any(m in ln.lower()
+                           for m in _UNSUPPORTED_MARKERS)]
+            result = (False,
+                      "platform lacks CPU multiprocess collectives: "
+                      + (tail[-1].strip() if tail else err[-200:]))
+            break
+    _CAPABILITY["result"] = result
+    return result
+
+
 def test_two_process_global_mesh_collective(tmp_path):
+    ok, reason = _cpu_multiprocess_collectives_supported()
+    if not ok:
+        pytest.skip(reason)
     import time
 
     addr = f"127.0.0.1:{_free_port()}"

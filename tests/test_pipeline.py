@@ -1,0 +1,513 @@
+"""Pipelined survey engine (ISSUE 4 tentpole): parallel/pipeline.py,
+utils/profiling.py:StageTimeline, and the pipelined default path of
+robust/runner.py.
+
+Gates, in order:
+
+- the prefetch loader: deterministic epoch order whatever order the
+  background loads finish in, bounded buffering under a slow consumer
+  (the queue-bounds acceptance check), per-epoch loader-exception
+  capture;
+- the threaded journal writer: byte-identical lines vs the direct
+  fsynced ``EpochJournal.append``, drain-as-durability-barrier,
+  writer failures surfaced (never silently dropped records);
+- the stage timeline: interval-union overlap accounting and the slog
+  summary event;
+- the runner: pipelined vs sequential runs produce BYTE-IDENTICAL
+  journals on a clean run, on a fault-injected run (NaN epoch +
+  truncated file), and across a real-SIGKILL resume; dispatch-ahead
+  consumes deferred device values correctly and in order;
+- the batched runner: pipelined prefetch + writer-drain path matches
+  the sequential oracle's journal bytes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from scintools_tpu.io import MalformedInputError
+from scintools_tpu.parallel.checkpoint import EpochJournal
+from scintools_tpu.parallel.pipeline import (AsyncJournalWriter,
+                                             DeferredResult,
+                                             PrefetchLoader,
+                                             finalize_result)
+from scintools_tpu.robust import faults, run_survey, run_survey_batched
+from scintools_tpu.utils import slog
+from scintools_tpu.utils.profiling import StageTimeline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestPrefetchLoader:
+    def test_deterministic_order_and_values(self):
+        def mk(i):
+            def load():
+                time.sleep(0.002 * ((i * 7) % 3))  # jittered finish
+                return i * 10
+            return load
+
+        with PrefetchLoader([(f"e{i}", mk(i)) for i in range(12)],
+                            depth=3, workers=3) as pl:
+            out = list(pl)
+        assert [e for e, _ in out] == [f"e{i}" for i in range(12)]
+        assert [it.payload for _, it in out] == \
+            [i * 10 for i in range(12)]
+        assert all(it.ok for _, it in out)
+
+    def test_noncallable_payloads_pass_through(self):
+        with PrefetchLoader([("a", 1), ("b", [2, 3])], depth=2) as pl:
+            out = list(pl)
+        assert [(e, it.payload) for e, it in out] == \
+            [("a", 1), ("b", [2, 3])]
+
+    def test_load_fn_maps_payloads(self):
+        with PrefetchLoader([("a", 2), ("b", 3)], depth=2,
+                            load_fn=lambda p: p * p) as pl:
+            out = {e: it.payload for e, it in pl}
+        assert out == {"a": 4, "b": 9}
+
+    def test_error_captured_per_epoch_not_raised(self):
+        def boom():
+            raise MalformedInputError("f.dynspec", "truncated")
+
+        epochs = [("e0", lambda: 1), ("e1", boom), ("e2", lambda: 3)]
+        with PrefetchLoader(epochs, depth=2) as pl:
+            out = list(pl)
+        assert out[0][1].ok and out[2][1].ok
+        assert not out[1][1].ok
+        assert isinstance(out[1][1].error, MalformedInputError)
+
+    def test_bounded_depth_under_slow_consumer(self):
+        """Acceptance: prefetch queue bounds respected — a slow
+        consumer never sees more than ``depth`` epochs buffered."""
+        loaded = []
+
+        def mk(i):
+            def load():
+                loaded.append(i)
+                return i
+            return load
+
+        pl = PrefetchLoader([(i, mk(i)) for i in range(24)], depth=3,
+                            workers=2)
+        it = iter(pl)
+        time.sleep(0.1)                    # loaders run way ahead...
+        assert len(loaded) <= 3            # ...but only to the bound
+        seen_max = 0
+        for _ in it:
+            time.sleep(0.002)              # slow consumer
+            seen_max = max(seen_max, pl.buffered())
+        assert seen_max <= 3, seen_max
+        assert sorted(loaded) == list(range(24))
+        pl.close()
+
+    def test_timeline_records_load_spans(self):
+        tl = StageTimeline()
+        with PrefetchLoader([("e0", lambda: 1)], depth=1,
+                            timeline=tl) as pl:
+            list(pl)
+        assert tl.summary()["stage_busy_s"].get("load", 0) >= 0
+        assert any(s == "load" for s in tl.stages())
+
+
+class TestAsyncJournalWriter:
+    FIELDS = dict(status="ok", tier="jax_fused", retries=0)
+
+    def test_byte_identical_to_direct_append(self, tmp_path):
+        direct = EpochJournal(tmp_path / "direct.jsonl")
+        for i in range(6):
+            direct.append(f"e{i}", **self.FIELDS,
+                          result={"v": i * 0.5, "nan": float("nan")})
+        with AsyncJournalWriter(tmp_path / "async.jsonl") as w:
+            for i in range(6):
+                w.append(f"e{i}", **self.FIELDS,
+                         result={"v": i * 0.5, "nan": float("nan")})
+        assert (tmp_path / "async.jsonl").read_bytes() == \
+            (tmp_path / "direct.jsonl").read_bytes()
+
+    def test_drain_is_durability_barrier(self, tmp_path):
+        j = EpochJournal(tmp_path / "j.jsonl")
+        w = AsyncJournalWriter(j)
+        for i in range(100):
+            w.append(f"e{i}", **self.FIELDS)
+        w.drain()
+        assert len(j.records()) == 100      # every line on disk
+        w.close()
+
+    def test_writer_failure_surfaces(self, tmp_path):
+        w = AsyncJournalWriter(tmp_path / "j.jsonl")
+        # sabotage the path AFTER construction: appends now hit a
+        # directory, the writer thread fails, drain must re-raise
+        w.journal.path = os.fspath(tmp_path)
+        w.append("e0", **self.FIELDS)
+        with pytest.raises(RuntimeError, match="journal writer"):
+            w.drain()
+            w.append("e1", **self.FIELDS)   # or the next append
+            w.drain()
+
+    def test_records_readable_by_epoch_journal(self, tmp_path):
+        j = EpochJournal(tmp_path / "j.jsonl")
+        with AsyncJournalWriter(j) as w:
+            w.append("e0", status="ok", result={"eta": 1.5e-3})
+            w.append("e1", status="quarantined", error="bad")
+        recs = j.records()
+        assert recs["e0"]["result"]["eta"] == 1.5e-3
+        assert recs["e1"]["status"] == "quarantined"
+
+
+class TestStageTimeline:
+    def test_overlap_accounting(self):
+        tl = StageTimeline()
+        tl.record("e0", "load", 0.0, 1.0)
+        tl.record("e0", "compute", 0.5, 1.5)
+        tl.record("e1", "load", 1.0, 1.2)   # overlaps e0 compute
+        s = tl.summary()
+        assert s["wall_s"] == 1.5
+        assert s["stage_busy_s"] == {"compute": 1.0, "load": 1.2}
+        # union busy = 1.5; total stage busy = 2.2
+        assert s["busy_s"] == 1.5
+        assert s["overlap_frac"] == pytest.approx(1 - 1.5 / 2.2,
+                                                  abs=1e-3)
+        # device (compute) covered [0.5, 1.5] of a 1.5 s wall
+        assert s["device_idle_s"] == pytest.approx(0.5)
+
+    def test_sequential_run_has_zero_overlap(self):
+        tl = StageTimeline()
+        tl.record("e0", "load", 0.0, 1.0)
+        tl.record("e0", "compute", 1.0, 2.0)
+        assert tl.summary()["overlap_frac"] == 0.0
+
+    def test_empty_and_report_and_slog(self):
+        tl = StageTimeline()
+        assert tl.summary()["n_spans"] == 0
+        tl.record("e0", "compute", 0.0, 1.0)
+        out = tl.log_summary(event="test.pipeline_timeline", tag="x")
+        assert out["n_epochs"] == 1
+        recs = slog.recent(event="test.pipeline_timeline")
+        assert recs and recs[-1]["tag"] == "x"
+        assert "compute" in tl.report()
+
+    def test_span_context_threads(self):
+        tl = StageTimeline()
+        with tl.span("e0", "load"):
+            time.sleep(0.002)
+        assert tl.summary()["stage_busy_s"]["load"] > 0
+
+
+def _journal_bytes(workdir):
+    with open(os.path.join(workdir, "journal.jsonl"), "rb") as fh:
+        return fh.read()
+
+
+def _cheap_process(payload, tier=None):
+    if not np.isfinite(payload).all():
+        raise MalformedInputError("<mem>", "non-finite epoch")
+    rng = np.random.default_rng(int(payload.sum() * 1000) % (2**31))
+    return {"v": float(rng.normal()), "m": float(np.mean(payload)),
+            "tier_used": tier}
+
+
+class TestPipelinedVsSequentialJournals:
+    """Acceptance: byte-identical journals between the pipelined
+    runner and the sequential oracle — clean, fault-injected, and
+    (below, in TestKillAndResumePipelined) SIGKILL-resumed."""
+
+    def _epochs(self, tmp_path, n=8, faulted=True):
+        rng = np.random.default_rng(7)
+        payloads = [rng.normal(10.0, 1.0, (8, 8)) for _ in range(n)]
+        if faulted:
+            # NaN epoch (process-level MalformedInputError) ...
+            payloads[2] = faults.inject_nan_pixels(payloads[2], 0.05,
+                                                   seed=2)
+        epochs = []
+        for i, p in enumerate(payloads):
+            path = tmp_path / f"e{i}.npy"
+            np.save(path, p)
+            if faulted and i == 5:
+                # ... and a truncated FILE (loader-level failure)
+                faults.corrupt_file_tail(path, drop_bytes=200)
+
+            def load(path=path):
+                try:
+                    return np.load(path)
+                except ValueError as e:
+                    raise MalformedInputError(os.fspath(path),
+                                              f"truncated: {e}")
+
+            epochs.append((f"p{i}", load))
+        return epochs
+
+    @pytest.mark.parametrize("faulted", [False, True])
+    def test_byte_identical_journals(self, tmp_path, faulted):
+        epochs = self._epochs(tmp_path, faulted=faulted)
+        seq = run_survey(epochs, _cheap_process, tmp_path / "seq",
+                         pipeline=False)
+        pipe = run_survey(epochs, _cheap_process, tmp_path / "pipe",
+                          pipeline=True, prefetch=3, inflight=2)
+        assert _journal_bytes(tmp_path / "seq") == \
+            _journal_bytes(tmp_path / "pipe")
+        assert json.dumps(pipe["results"], sort_keys=True) == \
+            json.dumps(seq["results"], sort_keys=True)
+        if faulted:
+            assert pipe["summary"]["n_quarantined"] == 2
+            out = {o.epoch: o for o in pipe["outcomes"]}
+            assert "MalformedInputError" in out["p2"].error_class
+            assert "MalformedInputError" in out["p5"].error_class
+        # outcome order matches input order in BOTH modes
+        assert [o.epoch for o in pipe["outcomes"]] == \
+            [e for e, _ in epochs]
+
+    def test_pipelined_resume_skips_done(self, tmp_path):
+        epochs = self._epochs(tmp_path, n=4, faulted=False)
+        first = run_survey(epochs, _cheap_process, tmp_path / "r")
+        calls = {"n": 0}
+
+        def counting(payload, tier=None):
+            calls["n"] += 1
+            return _cheap_process(payload, tier=tier)
+
+        second = run_survey(epochs, counting, tmp_path / "r")
+        assert calls["n"] == 0
+        assert second["summary"]["n_resumed"] == 4
+        assert second["results"] == first["results"]
+
+    def test_mid_journal_resume_preserves_order(self, tmp_path):
+        """Resume with SOME epochs journaled: fresh work drains the
+        window before a resumed epoch is recorded, so the outcome
+        order still matches the input order."""
+        epochs = self._epochs(tmp_path, n=6, faulted=False)
+        run_survey(epochs[1:4], _cheap_process, tmp_path / "w")
+        out = run_survey(epochs, _cheap_process, tmp_path / "w",
+                         prefetch=2, inflight=2)
+        assert [o.epoch for o in out["outcomes"]] == \
+            [e for e, _ in epochs]
+        assert out["summary"]["n_resumed"] == 3
+        assert out["summary"]["n_ok"] == 3
+
+
+class TestDispatchAhead:
+    def test_deferred_results_fenced_in_order(self, tmp_path):
+        """process returns device values still in flight; the window
+        keeps K in flight and results land in epoch order with host
+        scalars in the journal."""
+        import jax.numpy as jnp
+
+        max_pending = {"n": 0}
+        pending = {"n": 0}
+
+        def process(payload, tier=None):
+            pending["n"] += 1
+            max_pending["n"] = max(max_pending["n"], pending["n"])
+            arr = jnp.asarray(payload)
+
+            def finalize(arr=arr):
+                pending["n"] -= 1
+                return {"s": (arr * 2).sum()}
+
+            return DeferredResult(finalize_fn=finalize)
+
+        epochs = [(f"e{i}", np.full((4, 4), float(i)))
+                  for i in range(6)]
+        out = run_survey(epochs, process, tmp_path / "w",
+                         pipeline=True, inflight=3)
+        assert out["summary"]["n_ok"] == 6
+        for i in range(6):
+            assert out["results"][f"e{i}"]["s"] == 32.0 * i
+        assert max_pending["n"] >= 2       # genuinely dispatch-ahead
+        recs = EpochJournal(tmp_path / "w" / "journal.jsonl").records()
+        assert [k for k in recs] == [f"e{i}" for i in range(6)]
+
+    def test_finalize_result_fences_device_values(self):
+        import jax.numpy as jnp
+
+        out = finalize_result({"x": jnp.float32(2.5),
+                               "arr": jnp.arange(3.0),
+                               "nested": {"y": np.float64(1.0)},
+                               "s": "keep", "n": None})
+        assert out == {"x": 2.5, "arr": [0.0, 1.0, 2.0],
+                       "nested": {"y": 1.0}, "s": "keep", "n": None}
+        assert isinstance(out["x"], float)
+
+    def test_stateful_validator_forces_in_order_fencing(self,
+                                                        tmp_path):
+        """A validate hook (possibly stateful) disables dispatch-ahead
+        unless defer_validate=True — process/validate call order then
+        matches the sequential oracle exactly."""
+        order = []
+
+        def process(payload, tier=None):
+            order.append(("p", str(payload), tier))
+            return {"v": float(payload)}
+
+        def validate(result):
+            order.append(("v", str(int(result["v"]))))
+            return True
+
+        epochs = [(f"e{i}", i) for i in range(4)]
+        run_survey(epochs, process, tmp_path / "w", validate=validate,
+                   pipeline=True, inflight=3)
+        # strict alternation: each epoch validated before the next
+        # dispatch (sequential-oracle call order)
+        kinds = [k for k, *_ in order]
+        assert kinds == ["p", "v"] * 4
+
+
+class TestBatchedPipelined:
+    def _epochs(self, n=7):
+        return [(f"b{i}", np.full((3, 3), float(i))) for i in range(n)]
+
+    def _process_batch(self, payloads, tier=None):
+        return [{"m": float(np.mean(p)), "ok": 0} for p in payloads]
+
+    def test_journal_parity_and_lane_semantics(self, tmp_path):
+        epochs = self._epochs()
+        seq = run_survey_batched(epochs, self._process_batch,
+                                 tmp_path / "seq", batch_size=3,
+                                 pipeline=False)
+        pipe = run_survey_batched(epochs, self._process_batch,
+                                  tmp_path / "pipe", batch_size=3,
+                                  pipeline=True)
+        assert _journal_bytes(tmp_path / "seq") == \
+            _journal_bytes(tmp_path / "pipe")
+        assert seq["summary"]["n_batches"] == \
+            pipe["summary"]["n_batches"] == 3
+        assert json.dumps(pipe["results"], sort_keys=True) == \
+            json.dumps(seq["results"], sort_keys=True)
+
+    def test_loader_failure_quarantines_epoch_only(self, tmp_path):
+        epochs = self._epochs(4)
+
+        def boom():
+            raise MalformedInputError("f", "truncated")
+
+        epochs[1] = ("b1", boom)
+        out = run_survey_batched(epochs, self._process_batch,
+                                 tmp_path / "w", batch_size=2,
+                                 pipeline=True)
+        assert out["summary"]["n_quarantined"] == 1
+        assert out["summary"]["n_ok"] == 3
+        outc = {o.epoch: o for o in out["outcomes"]}
+        assert outc["b1"].status == "quarantined"
+        assert "MalformedInputError" in outc["b1"].error_class
+
+
+class TestRunPsrfluxSurvey:
+    """dynspec.py:run_psrflux_survey — the Dynspec-level entry to the
+    pipelined engine: lazy psrflux loaders, malformed-file quarantine,
+    byte-identical pipelined/sequential journals, resume."""
+
+    def test_end_to_end_with_malformed_file(self, tmp_path):
+        from scintools_tpu.dynspec import run_psrflux_survey
+        from scintools_tpu.io import write_psrflux
+        from scintools_tpu.io.psrflux import RawDynSpec
+
+        rng = np.random.default_rng(0)
+        files = []
+        for i in range(3):
+            p = tmp_path / f"e{i}.dynspec"
+            write_psrflux(RawDynSpec(
+                dyn=rng.normal(10, 1, (32, 16)),
+                times=np.arange(16) * 10.0,
+                freqs=1300.0 + np.arange(32.0)), p)
+            files.append(p)
+        bad = tmp_path / "bad.dynspec"
+        bad.write_text("# MJD0: 60000\nnot a dynspec\n")
+        files.insert(1, bad)
+
+        pipe = run_psrflux_survey(files, tmp_path / "pipe",
+                                  n_iter=25)
+        seq = run_psrflux_survey(files, tmp_path / "seq",
+                                 n_iter=25, pipeline=False)
+        assert pipe["summary"]["n_ok"] == 3
+        assert pipe["summary"]["n_quarantined"] == 1
+        assert _journal_bytes(tmp_path / "pipe") == \
+            _journal_bytes(tmp_path / "seq")
+        out = {o.epoch: o for o in pipe["outcomes"]}
+        assert out["bad.dynspec"].status == "quarantined"
+        assert "MalformedInputError" in out["bad.dynspec"].error_class
+        assert "tau" in pipe["results"]["e0.dynspec"]
+        resumed = run_psrflux_survey(files, tmp_path / "pipe",
+                                     n_iter=25)
+        assert resumed["summary"]["n_resumed"] == 4
+
+
+_KILL_DRIVER = r"""
+import json, os, sys
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+from scintools_tpu.robust import run_survey
+
+workdir, kill_after, pipeline = (sys.argv[1], int(sys.argv[2]),
+                                 sys.argv[3] == "1")
+count = {{"n": 0}}
+
+
+def process(payload, tier=None):
+    if kill_after >= 0 and count["n"] == kill_after:
+        os.kill(os.getpid(), 9)          # real SIGKILL mid-epoch
+    count["n"] += 1
+    rng = np.random.default_rng(int(payload))
+    return {{"v": float(rng.normal()),
+             "s": float(np.sin(int(payload) * 1.7))}}
+
+
+epochs = [(f"e{{i}}", i) for i in range(8)]
+out = run_survey(epochs, process, workdir, pipeline=pipeline)
+with open(os.path.join(workdir, "final.json"), "w") as fh:
+    json.dump({{k: out["results"][k]
+               for k in sorted(out["results"])}}, fh, sort_keys=True)
+print("RESUMED", out["summary"]["n_resumed"])
+"""
+
+
+class TestKillAndResumePipelined:
+    """Acceptance: a PIPELINED survey killed with SIGKILL mid-epoch
+    resumes from its journal and reproduces — byte-identically — both
+    the sequential oracle's results and its journal."""
+
+    def _run(self, script, workdir, kill_after, pipeline):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, script, str(workdir), str(kill_after),
+             "1" if pipeline else "0"],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO)
+
+    def test_sigkill_resume_byte_identical_across_modes(self,
+                                                        tmp_path):
+        script = tmp_path / "driver.py"
+        script.write_text(_KILL_DRIVER.format(repo=REPO))
+
+        r = self._run(script, tmp_path / "killed", kill_after=4,
+                      pipeline=True)
+        assert r.returncode == -signal.SIGKILL
+        n_done = len(EpochJournal(tmp_path / "killed"
+                                  / "journal.jsonl"))
+        assert n_done < 8                  # died mid-run
+
+        r = self._run(script, tmp_path / "killed", kill_after=-1,
+                      pipeline=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        r = self._run(script, tmp_path / "pipe", kill_after=-1,
+                      pipeline=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+        r = self._run(script, tmp_path / "seq", kill_after=-1,
+                      pipeline=False)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        resumed = (tmp_path / "killed" / "final.json").read_bytes()
+        pipe = (tmp_path / "pipe" / "final.json").read_bytes()
+        seq = (tmp_path / "seq" / "final.json").read_bytes()
+        assert resumed == seq              # SIGKILL-resume == oracle
+        assert pipe == seq                 # pipelined == oracle
+        # uninterrupted journals byte-identical across modes too
+        assert _journal_bytes(tmp_path / "pipe") == \
+            _journal_bytes(tmp_path / "seq")
