@@ -1298,9 +1298,16 @@ def bench_survey_pipeline(jax, jnp):
     BYTE-identical on the clean run and on a fault-injected run (one
     truncated psrflux file + one NaN epoch); the SIGKILL-resume
     byte-identity is pinned in tier-1 (tests/test_pipeline.py).
-    ``overlap_frac`` / ``device_idle_s`` come from the
-    StageTimeline profiler (utils/profiling.py) attached to the
-    pipelined run."""
+
+    **Observability gate (ISSUE 5)**: the pipelined run is timed
+    twice more — observability OFF (metrics registry disabled, no
+    timeline/heartbeat/report) vs fully ON (metrics + StageTimeline +
+    heartbeat + run_report) — best-of-``SCINTOOLS_BENCH_OBS_REPEATS``
+    each; both epochs/s figures land in the JSON with
+    ``obs_overhead_frac`` (acceptance: <3%). The ON run's
+    ``run_report.json`` is schema-validated and its timeline exported
+    + validated as Chrome-trace JSON in-bench; ``overlap_frac`` /
+    ``device_idle_s`` come from that run."""
     import shutil
     import tempfile
 
@@ -1308,8 +1315,12 @@ def bench_survey_pipeline(jax, jnp):
     from scintools_tpu.fit.batch import scint_params_batch
     from scintools_tpu.io import MalformedInputError, write_psrflux
     from scintools_tpu.io.psrflux import RawDynSpec, load_psrflux
+    from scintools_tpu.obs import metrics as obs_metrics
+    from scintools_tpu.obs.report import validate_run_report
+    from scintools_tpu.obs.trace import validate_chrome_trace
     from scintools_tpu.robust import faults, run_survey
     from scintools_tpu.robust.ladder import TIER_NUMPY
+    from scintools_tpu.utils import slog
     from scintools_tpu.utils.profiling import StageTimeline
 
     B = 48
@@ -1368,18 +1379,50 @@ def bench_survey_pipeline(jax, jnp):
                              os.path.join(root, workdir), **kw)
             return time.perf_counter() - t0, out
 
-        t_seq, out_seq = timed_run("seq", pipeline=False)
-        tl = StageTimeline(device_stage="dispatch")
-        t_pipe, out_pipe = timed_run("pipe", pipeline=True,
-                                     prefetch=6, loader_workers=4,
-                                     inflight=2, timeline=tl)
+        pipe_kw = dict(pipeline=True, prefetch=6, loader_workers=4,
+                       inflight=2)
+        repeats = int(os.environ.get("SCINTOOLS_BENCH_OBS_REPEATS",
+                                     2))
+        t_seq, out_seq = timed_run("seq", pipeline=False, report=False)
+
+        # ---- pipelined, observability OFF (the throughput oracle the
+        # obs-overhead gate is judged against) ------------------------
+        obs_metrics.set_enabled(False)
+        try:
+            t_pipe = np.inf
+            for k in range(repeats):
+                t_k, out_pipe = timed_run(f"pipe{k}", report=False,
+                                          **pipe_kw)
+                t_pipe = min(t_pipe, t_k)
+        finally:
+            obs_metrics.set_enabled(True)
+
+        # ---- pipelined, FULL observability: metrics + timeline +
+        # heartbeat + run_report ---------------------------------------
+        t_obs, tl = np.inf, None
+        for k in range(repeats):
+            tl_k = StageTimeline(device_stage="dispatch")
+            t_k, out_obs = timed_run(
+                f"obs{k}", timeline=tl_k,
+                heartbeat={"every_n": 8, "every_s": 10.0}, **pipe_kw)
+            if t_k < t_obs:
+                t_obs, tl, obs_dir = t_k, tl_k, f"obs{k}"
         with open(os.path.join(root, "seq", "journal.jsonl"),
                   "rb") as fh:
             j_seq = fh.read()
-        with open(os.path.join(root, "pipe", "journal.jsonl"),
+        with open(os.path.join(root, "pipe0", "journal.jsonl"),
                   "rb") as fh:
             j_pipe = fh.read()
         stages = tl.summary()
+
+        # the observability artifacts must be real: schema-valid
+        # run_report, loadable Chrome-trace JSON
+        with open(os.path.join(root, obs_dir, "run_report.json")) as fh:
+            validate_run_report(json.load(fh))
+        trace_path = tl.export_trace(
+            os.path.join(root, "pipeline_trace.json"))
+        with open(trace_path) as fh:
+            trace_events = validate_chrome_trace(json.load(fh))
 
         # ---- fault-injected parity: one truncated file, one NaN
         # epoch — both paths must quarantine identically, byte for
@@ -1412,6 +1455,17 @@ def bench_survey_pipeline(jax, jnp):
             "sequential_epochs_per_sec": round(B / t_seq, 2),
             "pipelined_epochs_per_sec": round(B / t_pipe, 2),
             "speedup": round(t_seq / t_pipe, 2),
+            # observability-overhead gate (ISSUE 5: <3%): full
+            # metrics + timeline + heartbeat + run_report vs obs-off,
+            # best-of-N each
+            "pipelined_obs_s": round(t_obs, 3),
+            "pipelined_obs_epochs_per_sec": round(B / t_obs, 2),
+            "obs_overhead_frac": round((t_obs - t_pipe) / t_pipe, 4),
+            "obs_repeats": repeats,
+            "run_report_valid": True,       # validate_run_report above
+            "trace_valid": True,            # validate_chrome_trace
+            "trace_events": len(trace_events),
+            "heartbeats": len(slog.recent(event="survey.heartbeat")),
             "overlap_frac": stages.get("overlap_frac"),
             "device_idle_s": stages.get("device_idle_s"),
             "stage_busy_s": stages.get("stage_busy_s"),

@@ -2198,7 +2198,14 @@ def run_psrflux_survey(dynfiles, workdir, crop=None, alpha=5 / 3,
 
     ``pipeline=False`` is the sequential oracle (identical journal
     bytes); remaining ``runner_kw`` pass through to
-    :func:`~scintools_tpu.robust.runner.run_survey`."""
+    :func:`~scintools_tpu.robust.runner.run_survey` — notably the
+    observability knobs (docs/observability.md): ``heartbeat=True``
+    (or a cadence dict) for live ``survey.heartbeat`` progress
+    events, ``report=False`` to suppress the ``run_report.json`` +
+    ``run_report.md`` artifact the runner writes into ``workdir`` by
+    default, and a ``timeline`` whose spans (tagged with per-epoch
+    trace IDs) export to a trace viewer via
+    ``timeline.export_trace(path)``."""
     from .fit.batch import scint_params_batch
     from .robust import run_survey
     from .robust.ladder import TIER_NUMPY

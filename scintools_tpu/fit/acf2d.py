@@ -195,7 +195,10 @@ def _batch_program(key, builder):
 
     fn = _SOLVER_CACHE.get(key)
     if fn is None:
+        from ..obs import retrace as _retrace
+
         ACF2D_CACHE_STATS["builder_calls"] += 1
+        _retrace.record_build("fit.acf2d_batch", key)
         fn = jax.jit(jax.vmap(builder()))
         if len(_SOLVER_CACHE) >= 16:
             _SOLVER_CACHE.pop(next(iter(_SOLVER_CACHE)))

@@ -171,6 +171,9 @@ def make_acf1d_batch(nt, nf, dt, df, alpha=5 / 3, n_iter=100,
            int(n_iter), bool(bartlett), bool(weighted))
     fit = _ACF1D_BATCH_CACHE.get(key)
     if fit is None:
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("fit.acf1d_batch", key)
         fit_one = make_acf1d_fit_one(nt, nf, dt, df, alpha=alpha,
                                      n_iter=n_iter, bartlett=bartlett,
                                      weighted=weighted)

@@ -1,0 +1,36 @@
+"""Unified observability layer (ISSUE 5 tentpole).
+
+One subsystem replacing the scattered probes that grew through PRs
+1–4 (``verbose`` prints, per-module ``*_CACHE_STATS`` dicts,
+bench-only timing splits):
+
+- :mod:`~scintools_tpu.obs.metrics` — thread-safe process-wide
+  metrics registry (counters/gauges/histograms, JSON snapshot +
+  Prometheus text export) fed by the survey runner, the pipeline
+  primitives, the fallback ladder, and the journal;
+- :mod:`~scintools_tpu.obs.trace` — Chrome-trace/Perfetto JSON export
+  of ``StageTimeline`` spans with per-epoch trace IDs
+  (``StageTimeline.export_trace``);
+- :mod:`~scintools_tpu.obs.retrace` — per-site jit build accounting
+  over every cached program factory, with :func:`retrace_guard` as
+  the tier-1 retrace-regression gate;
+- :mod:`~scintools_tpu.obs.heartbeat` — cadence-gated live progress
+  events for long runs;
+- :mod:`~scintools_tpu.obs.report` — the end-of-run ``run_report``
+  artifact (JSON + markdown), schema-validated.
+
+See docs/observability.md for the event catalog, metric names, the
+trace-viewer walkthrough, and the RunReport schema.
+"""
+
+from . import heartbeat, metrics, report, retrace, trace  # noqa: F401
+from .heartbeat import Heartbeat, as_heartbeat  # noqa: F401
+from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, counter, gauge, histogram,
+                      set_enabled)
+from .report import (build_run_report, render_markdown,  # noqa: F401
+                     validate_run_report, write_run_report)
+from .retrace import (RetraceRegression, compile_counts,  # noqa: F401
+                      record_build, retrace_guard)
+from .trace import (chrome_trace_events, validate_chrome_trace,  # noqa: F401
+                    write_chrome_trace)

@@ -1,0 +1,91 @@
+"""Live heartbeat for long survey runs.
+
+A 10³-epoch archival survey on a quiet log is indistinguishable from
+a hung one. The heartbeat emits one structured slog event
+(``survey.heartbeat``) every N completed epochs or T seconds —
+whichever comes first — carrying throughput, ETA, and the
+quarantine/fallback tallies, so ``tail -f $SCINTOOLS_LOG | grep
+heartbeat`` is a progress bar and a stall detector at once.
+
+Wired into ``robust/runner.py``: ``run_survey(...,
+heartbeat=True)`` (or a cadence dict ``{"every_n": 50,
+"every_s": 60}``, or a prebuilt :class:`Heartbeat`). Off by default —
+the cadence check itself is two comparisons per epoch, but the
+*events* are user-visible output a library must not emit unasked.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils import slog
+
+
+class Heartbeat:
+    """Cadence-gated progress emitter.
+
+    ``beat(done, **stats)`` is called once per completed epoch (cheap
+    when not due); an event is emitted when ``done`` advanced by
+    ``every_n`` since the last emit OR ``every_s`` wall seconds
+    passed, and always when ``force=True`` (the runner forces a final
+    beat so every run ends with a fresh snapshot). ``total`` enables
+    the ETA estimate. Returns the emitted record (or None)."""
+
+    def __init__(self, every_n=25, every_s=30.0, total=None,
+                 event="survey.heartbeat"):
+        self.every_n = max(1, int(every_n))
+        self.every_s = float(every_s)
+        self.total = total
+        self.event = event
+        self.emitted = 0
+        self._t0 = None
+        self._last_t = None
+        self._last_n = 0
+
+    def beat(self, done, force=False, **stats):
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0 = self._last_t = now
+        if force and self.emitted and self._last_n == done:
+            return None               # cadence already emitted this n
+        due = (force or done - self._last_n >= self.every_n
+               or now - self._last_t >= self.every_s)
+        if not due:
+            return None
+        elapsed = now - self._t0
+        eps = done / elapsed if elapsed > 0 and done else None
+        rec = {"done": int(done), "elapsed_s": round(elapsed, 3)}
+        if self.total is not None:
+            rec["total"] = int(self.total)
+        if eps is not None:
+            rec["epochs_per_sec"] = round(eps, 3)
+            if self.total is not None:
+                rec["eta_s"] = round(
+                    max(0, self.total - done) / eps, 1)
+        rec.update(stats)
+        slog.log_event(self.event, **rec)  # obs-event-ok: survey.heartbeat
+        self.emitted += 1
+        self._last_t = now
+        self._last_n = done
+        return rec
+
+
+def as_heartbeat(spec, total=None):
+    """Normalise the runner's ``heartbeat`` argument: ``None``/False →
+    no heartbeat; ``True`` → default cadence; a dict → cadence kwargs;
+    a :class:`Heartbeat` → used as-is. ``total`` fills the epoch count
+    when the spec didn't set one."""
+    if spec is None or spec is False:
+        return None
+    if spec is True:
+        return Heartbeat(total=total)
+    if isinstance(spec, dict):
+        kw = dict(spec)
+        kw.setdefault("total", total)
+        return Heartbeat(**kw)
+    if isinstance(spec, Heartbeat):
+        if spec.total is None:
+            spec.total = total
+        return spec
+    raise TypeError(f"heartbeat must be None/bool/dict/Heartbeat, "
+                    f"got {type(spec).__name__}")

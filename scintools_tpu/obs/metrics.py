@@ -1,0 +1,339 @@
+"""Thread-safe metrics registry: counters, gauges, histograms.
+
+The survey stack grew ad-hoc probes — ``ACF2D_CACHE_STATS`` dicts,
+bench-only timing splits, slog events carrying one-off numbers. This
+module is the one place run-level quantities accumulate: epochs
+processed/quarantined, fallback-tier transitions, journal bytes and
+fsyncs, prefetch-queue depth, device-idle seconds, jit builds. Two
+export views, both schema-stable:
+
+- :meth:`MetricsRegistry.snapshot` — a JSON-able dict (consumed by
+  the RunReport, obs/report.py);
+- :meth:`MetricsRegistry.to_prometheus` — the Prometheus text
+  exposition format, so a long survey can be scraped by dropping the
+  string behind any HTTP handler.
+
+Design constraints (docs/observability.md):
+
+- **hot-path cheap** — one lock acquisition per update on the
+  metric's own lock; the survey loop updates a handful of metrics per
+  epoch, so the cost is microseconds against millisecond epochs (the
+  bench gate pins <3% overhead with full observability on);
+- **process-wide default** — :data:`REGISTRY` plus the module-level
+  :func:`counter`/:func:`gauge`/:func:`histogram` helpers, mirroring
+  how ``utils/slog.py`` exposes one process sink;
+- **switchable** — :func:`set_enabled` (False) turns every update
+  into a no-op without unwiring call sites, which is how the bench
+  measures the observability-off baseline;
+- **labels** — ``counter(name).labels(tier="jax_fused").inc()``
+  keeps per-tier / per-site breakdowns under one metric name, exported
+  Prometheus-style as ``name{tier="jax_fused"}``.
+
+No dependencies beyond the standard library.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+#: default histogram buckets [seconds]: spans the ~0.2 ms journal
+#: fsync through multi-second epoch loads.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+def _label_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _full_name(name, key):
+    if not key:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+class _Metric:
+    """Base: a named family of label-children sharing one lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help="", registry=None):
+        self.name = name
+        self.help = help
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._children = {}
+
+    def _enabled(self):
+        return self._registry is None or self._registry.enabled
+
+    def labels(self, **labels):
+        """A child bound to one label set (created on first use)."""
+        return _Child(self, _label_key(labels))
+
+    def _items(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class _Child:
+    """View of one label set of a metric; forwards every update."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric, key):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, n=1):
+        self._metric._inc(self._key, n)
+
+    def dec(self, n=1):
+        self._metric._inc(self._key, -n)
+
+    def set(self, value):
+        self._metric._set(self._key, value)
+
+    def observe(self, value):
+        self._metric._observe(self._key, value)
+
+    @property
+    def value(self):
+        return self._metric._get(self._key)
+
+
+class Counter(_Metric):
+    """Monotonic counter. ``inc(n)``; negative increments rejected."""
+
+    kind = "counter"
+
+    def inc(self, n=1):
+        self._inc((), n)
+
+    def _inc(self, key, n):
+        if not self._enabled():
+            return
+        if n < 0:
+            raise ValueError("counters only go up (use a gauge)")
+        with self._lock:
+            self._children[key] = self._children.get(key, 0) + n
+
+    def _get(self, key=()):
+        with self._lock:
+            return self._children.get(key, 0)
+
+    @property
+    def value(self):
+        return self._get()
+
+
+class Gauge(_Metric):
+    """Last-write-wins instantaneous value; ``set``/``inc``/``dec``."""
+
+    kind = "gauge"
+
+    def set(self, value):
+        self._set((), value)
+
+    def inc(self, n=1):
+        self._inc((), n)
+
+    def dec(self, n=1):
+        self._inc((), -n)
+
+    def _set(self, key, value):
+        if not self._enabled():
+            return
+        with self._lock:
+            self._children[key] = float(value)
+
+    def _inc(self, key, n):
+        if not self._enabled():
+            return
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + n
+
+    def _get(self, key=()):
+        with self._lock:
+            return self._children.get(key, 0.0)
+
+    @property
+    def value(self):
+        return self._get()
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram: per-label ``count``/``sum`` plus
+    cumulative bucket counts (Prometheus ``le`` convention, implicit
+    ``+Inf`` bucket)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", registry=None,
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help=help, registry=registry)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def observe(self, value):
+        self._observe((), value)
+
+    def _observe(self, key, value):
+        if not self._enabled():
+            return
+        value = float(value)
+        with self._lock:
+            st = self._children.get(key)
+            if st is None:
+                st = self._children[key] = {
+                    "count": 0, "sum": 0.0,
+                    "bucket_counts": [0] * (len(self.buckets) + 1)}
+            st["count"] += 1
+            st["sum"] += value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    st["bucket_counts"][i] += 1
+                    break
+            else:
+                st["bucket_counts"][-1] += 1
+
+    def _get(self, key=()):
+        with self._lock:
+            st = self._children.get(key)
+            return dict(st) if st else {"count": 0, "sum": 0.0,
+                                        "bucket_counts": []}
+
+    def _cumulative(self, st):
+        """``{le_label: cumulative_count}`` including ``+Inf``."""
+        out = {}
+        running = 0
+        for b, n in zip(self.buckets, st["bucket_counts"]):
+            running += n
+            out[repr(b)] = running
+        out["+Inf"] = running + st["bucket_counts"][-1]
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide metric store. ``counter``/``gauge``/``histogram``
+    return the existing metric for a repeated name (same-kind check),
+    so call sites never coordinate creation."""
+
+    def __init__(self, enabled=True):
+        self._lock = threading.Lock()
+        self._metrics = {}
+        self.enabled = bool(enabled)
+
+    def set_enabled(self, flag):
+        """Toggle every update under this registry (False = all
+        ``inc``/``set``/``observe`` become no-ops; reads still work)."""
+        self.enabled = bool(flag)
+
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help=help,
+                                              registry=self, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):
+        return self._get_or_create(Histogram, name, help,
+                                   buckets=buckets)
+
+    def reset(self):
+        """Drop every metric (test isolation; the enabled flag is
+        kept)."""
+        with self._lock:
+            self._metrics = {}
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self):
+        """JSON-able dict of everything:
+        ``{"counters": {full_name: value}, "gauges": {...},
+        "histograms": {full_name: {"count", "sum", "buckets"}}}``.
+        Round-trips through ``json.dumps``/``loads`` unchanged (tests
+        pin this)."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.metrics():
+            for key, val in m._items():
+                full = _full_name(m.name, key)
+                if m.kind == "counter":
+                    out["counters"][full] = val
+                elif m.kind == "gauge":
+                    out["gauges"][full] = val
+                else:
+                    out["histograms"][full] = {
+                        "count": val["count"],
+                        "sum": val["sum"],
+                        "buckets": m._cumulative(val)}
+        return out
+
+    def to_prometheus(self):
+        """Prometheus text exposition format (one ``# HELP``/``# TYPE``
+        header per metric family, histogram ``_bucket``/``_sum``/
+        ``_count`` expansion)."""
+        lines = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, val in m._items():
+                if m.kind in ("counter", "gauge"):
+                    lines.append(f"{_full_name(m.name, key)} {val}")
+                    continue
+                for le, n in m._cumulative(val).items():
+                    lkey = key + (("le", le),)
+                    lines.append(
+                        f"{_full_name(m.name + '_bucket', lkey)} {n}")
+                lines.append(
+                    f"{_full_name(m.name + '_sum', key)} {val['sum']}")
+                lines.append(
+                    f"{_full_name(m.name + '_count', key)} "
+                    f"{val['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self, **kw):
+        return json.dumps(self.snapshot(), **kw)
+
+
+#: the process-wide default registry every library call site uses.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name, help=""):
+    return REGISTRY.counter(name, help=help)
+
+
+def gauge(name, help=""):
+    return REGISTRY.gauge(name, help=help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS):
+    return REGISTRY.histogram(name, help=help, buckets=buckets)
+
+
+def set_enabled(flag):
+    REGISTRY.set_enabled(flag)
+
+
+def enabled():
+    return REGISTRY.enabled
+
+
+def snapshot():
+    return REGISTRY.snapshot()

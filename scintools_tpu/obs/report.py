@@ -1,0 +1,216 @@
+"""End-of-run RunReport: one machine-readable artifact per survey.
+
+A survey run currently scatters its story across the journal, the
+slog stream, and whatever the caller printed. The RunReport collects
+the run's outcome into one JSON document (``run_report.json``) plus a
+human-rendered markdown table (``run_report.md``), written into the
+run's ``workdir`` by ``robust/runner.py:run_survey`` /
+``run_survey_batched`` (and therefore by
+``dynspec.py:run_psrflux_survey``) and consumed by bench.py, which
+schema-validates it in-run.
+
+Schema v1 (validated by :func:`validate_run_report`, pinned in
+tier-1):
+
+=================  =======  ==================================
+field              type     meaning
+=================  =======  ==================================
+schema_version     int      always 1
+runner             str      producing entry point
+generated_t        float    unix time of assembly
+n_epochs           int      epochs scanned (incl. resumed)
+n_ok               int      fresh successful epochs
+n_quarantined      int      quarantined (incl. resumed-quar.)
+n_resumed          int      taken verbatim from the journal
+retries            int      total failed ladder attempts
+tier_counts        dict     fresh completions per tier
+wall_s             float    wall-clock of the run loop
+epochs_per_sec     float?   fresh epochs / wall_s (None if 0)
+quarantined        list     per-epoch {epoch, error_class,
+                            error, tier}
+timeline           dict?    StageTimeline.summary() or None
+jit_builds         dict     per-site {builds, distinct_keys}
+metrics            dict?    MetricsRegistry.snapshot() or None
+=================  =======  ==================================
+
+Optional extras (``n_batches`` from the batched runner, caller
+``extra`` fields) ride along unvalidated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..utils import slog
+from . import metrics as _metrics
+from . import retrace as _retrace
+
+SCHEMA_VERSION = 1
+
+_REQUIRED = {
+    "schema_version": int,
+    "runner": str,
+    "generated_t": (int, float),
+    "n_epochs": int,
+    "n_ok": int,
+    "n_quarantined": int,
+    "n_resumed": int,
+    "retries": int,
+    "tier_counts": dict,
+    "wall_s": (int, float),
+    "epochs_per_sec": (int, float, type(None)),
+    "quarantined": list,
+    "timeline": (dict, type(None)),
+    "jit_builds": dict,
+    "metrics": (dict, type(None)),
+}
+
+
+def build_run_report(summary, outcomes=(), wall_s=0.0, timeline=None,
+                     runner="run_survey", extra=None):
+    """Assemble the report dict from the runner's tally ``summary``,
+    its ordered ``outcomes`` (:class:`EpochOutcome`-like, for the
+    quarantine detail), the run's wall seconds, and an optional
+    timeline summary dict. Metrics and jit-build accounting are read
+    from the process-wide registries."""
+    quarantined = []
+    for o in outcomes:
+        status = getattr(o, "status", None)
+        error_cls = getattr(o, "error_class", "")
+        if status == "quarantined" or (status == "resumed"
+                                       and error_cls):
+            quarantined.append({
+                "epoch": str(getattr(o, "epoch", "?")),
+                "error_class": error_cls,
+                "error": getattr(o, "error", ""),
+                "tier": getattr(o, "tier", "")})
+    fresh = max(0, int(summary.get("n_epochs", 0))
+                - int(summary.get("n_resumed", 0)))
+    eps = round(fresh / wall_s, 3) if wall_s > 0 and fresh else None
+    rep = {
+        "schema_version": SCHEMA_VERSION,
+        "runner": str(runner),
+        "generated_t": round(time.time(), 3),
+        "n_epochs": int(summary.get("n_epochs", 0)),
+        "n_ok": int(summary.get("n_ok", 0)),
+        "n_quarantined": int(summary.get("n_quarantined", 0)),
+        "n_resumed": int(summary.get("n_resumed", 0)),
+        "retries": int(summary.get("retries", 0)),
+        "tier_counts": {str(k): int(v) for k, v in
+                        dict(summary.get("tier_counts", {})).items()},
+        "wall_s": round(float(wall_s), 4),
+        "epochs_per_sec": eps,
+        "quarantined": quarantined,
+        "timeline": dict(timeline) if timeline else None,
+        "jit_builds": _retrace.snapshot(),
+        "metrics": (_metrics.REGISTRY.snapshot()
+                    if _metrics.REGISTRY.enabled else None),
+    }
+    if "n_batches" in summary:
+        rep["n_batches"] = int(summary["n_batches"])
+    if extra:
+        rep.update(extra)
+    return rep
+
+
+def validate_run_report(report):
+    """Schema-v1 validation (the tier-1 gate and bench.py share it):
+    required fields present with the right types, tier counts and
+    quarantine entries well-formed, JSON-serialisable. Raises
+    :class:`ValueError` listing every problem; returns the report."""
+    problems = []
+    if not isinstance(report, dict):
+        raise ValueError("run report must be a dict")
+    for key, typ in _REQUIRED.items():
+        if key not in report:
+            problems.append(f"missing field {key!r}")
+        elif not isinstance(report[key], typ):
+            problems.append(
+                f"field {key!r} has type "
+                f"{type(report[key]).__name__}")
+    if isinstance(report.get("schema_version"), int) \
+            and report["schema_version"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {report['schema_version']} != "
+            f"{SCHEMA_VERSION}")
+    for k, v in dict(report.get("tier_counts") or {}).items():
+        if not isinstance(v, int):
+            problems.append(f"tier_counts[{k!r}] not an int")
+    for i, q in enumerate(report.get("quarantined") or []):
+        if not isinstance(q, dict) or "epoch" not in q \
+                or "error_class" not in q:
+            problems.append(f"quarantined[{i}] malformed: {q!r}")
+    try:
+        json.dumps(report)
+    except (TypeError, ValueError) as e:
+        problems.append(f"not JSON-serialisable: {e}")
+    if problems:
+        raise ValueError("invalid run report: " + "; ".join(problems))
+    return report
+
+
+def render_markdown(report):
+    """Human view of the report: a summary table, the per-tier
+    completions, and (when any) the quarantine list."""
+    r = report
+    lines = [
+        f"# Survey run report ({r['runner']})", "",
+        "| quantity | value |", "|---|---|",
+        f"| epochs | {r['n_epochs']} |",
+        f"| ok | {r['n_ok']} |",
+        f"| quarantined | {r['n_quarantined']} |",
+        f"| resumed | {r['n_resumed']} |",
+        f"| retries | {r['retries']} |",
+        f"| wall_s | {r['wall_s']} |",
+        f"| epochs/s | {r['epochs_per_sec']} |",
+    ]
+    tl = r.get("timeline") or {}
+    if tl:
+        lines += [f"| overlap_frac | {tl.get('overlap_frac')} |",
+                  f"| device_idle_s | {tl.get('device_idle_s')} |"]
+    if r.get("tier_counts"):
+        lines += ["", "## Completions per tier", "",
+                  "| tier | epochs |", "|---|---|"]
+        lines += [f"| {t} | {n} |"
+                  for t, n in r["tier_counts"].items()]
+    if r.get("jit_builds"):
+        lines += ["", "## Compiled programs", "",
+                  "| site | builds | distinct keys |", "|---|---|---|"]
+        lines += [f"| {s} | {d['builds']} | {d['distinct_keys']} |"
+                  for s, d in r["jit_builds"].items()]
+    if r["quarantined"]:
+        lines += ["", "## Quarantined epochs", "",
+                  "| epoch | error class | error |", "|---|---|---|"]
+        lines += [f"| {q['epoch']} | {q['error_class']} | "
+                  f"{str(q['error'])[:80]} |"
+                  for q in r["quarantined"]]
+    return "\n".join(lines) + "\n"
+
+
+def write_run_report(workdir, report, name="run_report"):
+    """Write ``<workdir>/<name>.json`` (+ ``.md``) atomically (write
+    to a temp name, ``os.replace``), emit a ``survey.run_report`` slog
+    event, and return the JSON path. Never raises into the survey —
+    a report that cannot be written is a warning, the journal already
+    holds the results."""
+    json_path = os.path.join(os.fspath(workdir), name + ".json")
+    try:
+        for suffix, text in ((".json", json.dumps(report, indent=1)),
+                             (".md", render_markdown(report))):
+            path = os.path.join(os.fspath(workdir), name + suffix)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(text)
+            os.replace(tmp, path)
+    except OSError as e:
+        import sys
+
+        print(f"Warning: run report write failed ({e})",
+              file=sys.stderr)
+        return None
+    slog.log_event("survey.run_report", path=json_path,
+                   n_ok=report.get("n_ok"),
+                   n_quarantined=report.get("n_quarantined"))
+    return json_path

@@ -1,0 +1,114 @@
+"""Chrome-trace (Perfetto-loadable) export of survey stage spans.
+
+``StageTimeline`` (utils/profiling.py) records ``(stage, epoch, t0,
+t1)`` wall-clock spans from the prefetch loader threads, the dispatch
+loop, the fence points, and the journal writer thread. This module
+turns that span list into the Chrome Trace Event JSON format — the
+``{"traceEvents": [...]}`` array of ``"ph": "X"`` complete events —
+which loads directly in ``chrome://tracing`` and https://ui.perfetto.dev,
+so a pipelined survey run is inspectable on a real timeline instead of
+through aggregate overlap fractions.
+
+Layout conventions (pinned by tests/test_obs.py):
+
+- one process (``pid`` = the recording process), one *track* (tid)
+  per stage — load/dispatch/fence/journal each get their own named
+  row, with ``"M"`` (metadata) ``process_name``/``thread_name``
+  events emitted first;
+- ``ts``/``dur`` are microseconds relative to the earliest span, and
+  the ``"X"`` events are sorted by ``ts``;
+- each event's ``args`` carries the epoch id and its per-epoch
+  ``trace_id`` (threaded through the runner via
+  ``StageTimeline.assign_trace``), so every row of one epoch's
+  lifecycle is searchable by one string in the trace viewer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def chrome_trace_events(spans, trace_ids=None, pid=None,
+                        process_name="scintools_tpu survey"):
+    """Build the Chrome-trace event list from ``(stage, epoch, t0,
+    t1)`` spans (absolute ``perf_counter`` seconds). ``trace_ids``
+    optionally maps epoch id → trace-id string. Returns a list of
+    event dicts: metadata events first, then the ``"X"`` spans sorted
+    by ``ts``."""
+    spans = list(spans)
+    if pid is None:
+        pid = os.getpid()
+    stages = sorted({s for s, _, _, _ in spans})
+    tids = {stage: i + 1 for i, stage in enumerate(stages)}
+    events = [{"name": "process_name", "ph": "M", "pid": pid,
+               "tid": 0, "args": {"name": process_name}}]
+    for stage in stages:
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tids[stage], "args": {"name": stage}})
+    if not spans:
+        return events
+    t_base = min(t0 for _, _, t0, _ in spans)
+    xs = []
+    for stage, epoch, t0, t1 in spans:
+        args = {"epoch": str(epoch)}
+        if trace_ids:
+            tid_str = trace_ids.get(epoch, trace_ids.get(str(epoch)))
+            if tid_str is not None:
+                args["trace_id"] = str(tid_str)
+        xs.append({
+            "name": stage, "cat": "survey", "ph": "X",
+            "ts": round((t0 - t_base) * 1e6, 3),
+            "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+            "pid": pid, "tid": tids[stage], "args": args})
+    xs.sort(key=lambda e: (e["ts"], e["tid"]))
+    return events + xs
+
+
+def write_chrome_trace(path, spans, trace_ids=None, pid=None,
+                       process_name="scintools_tpu survey"):
+    """Write ``spans`` as a Chrome-trace JSON object file
+    (``{"traceEvents": [...], "displayTimeUnit": "ms"}``) and return
+    ``path``. The file loads as-is in chrome://tracing / Perfetto."""
+    doc = {"traceEvents": chrome_trace_events(
+        spans, trace_ids=trace_ids, pid=pid,
+        process_name=process_name),
+        "displayTimeUnit": "ms"}
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return os.fspath(path)
+
+
+def validate_chrome_trace(doc):
+    """Structural check of a Chrome-trace document (the bench and the
+    tier-1 tests share it): ``traceEvents`` present; every ``"X"``
+    event carries name/ts/dur/pid/tid with ``ts`` sorted and
+    non-negative ``dur``; every (pid, tid) used by an ``"X"`` event
+    has a matching ``thread_name`` metadata event. Raises
+    :class:`ValueError` on the first problem; returns the event
+    list."""
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError("not a Chrome-trace object "
+                         "(missing traceEvents)")
+    events = doc["traceEvents"]
+    named = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            named.add((e["pid"], e["tid"]))
+    last_ts = None
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        for k in ("name", "ts", "dur", "pid", "tid"):
+            if k not in e:
+                raise ValueError(f"X event missing {k!r}: {e}")
+        if e["dur"] < 0 or e["ts"] < 0:
+            raise ValueError(f"negative ts/dur: {e}")
+        if (e["pid"], e["tid"]) not in named:
+            raise ValueError(
+                f"X event on unnamed track pid={e['pid']} "
+                f"tid={e['tid']}")
+        if last_ts is not None and e["ts"] < last_ts:
+            raise ValueError("X events not sorted by ts")
+        last_ts = e["ts"]
+    return events

@@ -88,11 +88,21 @@ class EpochJournal:
 
     def append(self, epoch, **fields):
         """Durably journal one completed epoch (flush + fsync)."""
+        from ..obs import metrics as _metrics
+
         line = self.format_line(epoch, **fields)
         with open(self.path, "a") as fh:
             fh.write(line + "\n")
             fh.flush()
             os.fsync(fh.fileno())
+        _metrics.counter(
+            "survey_journal_bytes_total",
+            help="bytes appended to the epoch journal",
+        ).inc(len(line.encode()) + 1)
+        _metrics.counter(
+            "survey_journal_fsyncs_total",
+            help="journal fsync barriers taken",
+        ).inc()
 
     def records(self):
         """``{epoch_id: record}`` for every intact journaled line.

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .checkpoint import EpochJournal
+from ..obs import metrics as _metrics
 
 
 @dataclass
@@ -154,6 +155,10 @@ class PrefetchLoader:
                 out = LoadedEpoch(epoch_id, error=e)
             t1 = time.perf_counter()
             out.load_s = t1 - t0
+            _metrics.histogram(
+                "survey_load_seconds",
+                help="background epoch load+preprocess wall time",
+            ).observe(out.load_s)
             if self._timeline is not None:
                 self._timeline.record(epoch_id, self._stage, t0, t1)
             slot.put(out)
@@ -171,6 +176,10 @@ class PrefetchLoader:
             item = head.get()          # blocks until ITS load is done
             self._order.popleft()
             self._slots.release()      # free the buffer slot
+            _metrics.gauge(
+                "survey_prefetch_queue_depth",
+                help="epochs loaded-or-loading ahead of the consumer",
+            ).set(self.buffered())
             yield item.epoch, item
 
     def buffered(self):
@@ -251,10 +260,19 @@ class AsyncJournalWriter:
             try:
                 lines = [self.journal.format_line(epoch, **fields)
                          for epoch, fields in batch]
+                data = "".join(line + "\n" for line in lines)
                 with open(self.journal.path, "a") as fh:
-                    fh.write("".join(line + "\n" for line in lines))
+                    fh.write(data)
                     fh.flush()
                     os.fsync(fh.fileno())
+                _metrics.counter(
+                    "survey_journal_bytes_total",
+                    help="bytes appended to the epoch journal",
+                ).inc(len(data.encode()))
+                _metrics.counter(
+                    "survey_journal_fsyncs_total",
+                    help="journal fsync barriers taken",
+                ).inc()
                 if self._timeline is not None:
                     self._timeline.record(batch[0][0], self._stage,
                                           t0, time.perf_counter())

@@ -87,6 +87,13 @@ def make_fused_grid_search_sharded(mesh, tau, fd, n_edges, nf, nt,
         # batch. Skipped on CPU (virtual meshes), where XLA cannot
         # alias it and warns on every compile.
         kwargs["donate_argnums"] = (0,)
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.fused_grid_search_sharded",
+        (tau.tobytes(), fd.tobytes(), int(n_edges), int(nf), int(nt),
+         int(npad), bool(coher), float(tau_mask), float(fw),
+         int(iters)))
     return jax.jit(fn,
                    in_shardings=chunk_shardings(mesh, (3, 2, 2)),
                    out_shardings=chunk_shardings(mesh,
@@ -204,6 +211,11 @@ def make_acf2d_fit_sharded(mesh, nt_crop, nf_crop, ar, alpha, theta,
         fresnel_method=fresnel_method, alpha_varies=alpha_varies)
     sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     ndev = int(np.prod(list(mesh.shape.values())))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.acf2d_fit_sharded",
+        (nt_crop, nf_crop, tuple(vary), n_iter, precision, ndev))
     return jax.jit(jax.vmap(fit_one),
                    in_shardings=(sh,) * 6), ndev
 
@@ -311,4 +323,10 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
         # skipped on CPU/virtual meshes where XLA cannot alias it and
         # warns on every compile
         kwargs["donate_argnums"] = (0,)
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.survey_step",
+        (nf, nt, float(dt), float(df), float(alpha), n_iter,
+         bartlett, weighted, window, float(window_frac)))
     return jax.jit(step, in_shardings=(dyn_sh,), **kwargs)

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from . import faults
+from ..obs import metrics as _metrics
 from ..utils import slog
 
 TIER_FUSED = "jax_fused"
@@ -103,6 +104,10 @@ def _record(report, epoch, stage, tier, exc, retry):
            "error": str(exc)[:300], "retry": retry}
     report.attempts.append(rec)
     report.retries += 1
+    _metrics.counter(
+        "survey_fallback_transitions_total",
+        help="failed ladder attempts (per tier that failed)",
+    ).labels(tier=str(tier)).inc()
     slog.log_failure("robust.fallback", epoch=epoch, stage=stage,
                      error=exc, tier=tier, retry=retry)
 

@@ -50,9 +50,13 @@ from __future__ import annotations
 
 import collections
 import os
+import time
 from dataclasses import asdict, dataclass, field
 
 from . import ladder as _ladder
+from ..obs import heartbeat as _hb
+from ..obs import metrics as _metrics
+from ..obs import report as _report
 from ..parallel.checkpoint import EpochJournal
 from ..utils import slog
 
@@ -105,12 +109,13 @@ def _load_inline(payload, load_fn):
 
 class _Recorder:
     """Shared bookkeeping for both runner entries: tallies, ordered
-    outcomes, results, and journal appends (direct or via the async
-    writer)."""
+    outcomes, results, journal appends (direct or via the async
+    writer), per-epoch metrics, and the heartbeat cadence."""
 
-    def __init__(self, journal, writer, tiers):
+    def __init__(self, journal, writer, tiers, heartbeat=None):
         self.journal = journal
         self.writer = writer
+        self.heartbeat = heartbeat
         self.outcomes = []
         self.results = {}
         self.tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
@@ -123,6 +128,16 @@ class _Recorder:
         else:
             self.journal.append(key, **fields)
 
+    def beat(self, force=False):
+        """One heartbeat tick (emits only when the cadence is due)."""
+        if self.heartbeat is None:
+            return
+        t = self.tally
+        self.heartbeat.beat(
+            len(self.outcomes), force=force, ok=t["n_ok"],
+            quarantined=t["n_quarantined"], resumed=t["n_resumed"],
+            retries=t["retries"])
+
     def resumed(self, epoch_id, rec):
         out = EpochOutcome(epoch=epoch_id, status="resumed",
                            tier=rec.get("tier", ""),
@@ -134,7 +149,11 @@ class _Recorder:
         else:
             self.results[str(epoch_id)] = out.result
         self.tally["n_resumed"] += 1
+        _metrics.counter("survey_epochs_resumed_total",
+                         help="epochs taken verbatim from the journal"
+                         ).inc()
         self.outcomes.append(out)
+        self.beat()
         return out
 
     def record(self, out):
@@ -148,12 +167,17 @@ class _Recorder:
             self.results[key] = out.result
             self._append(key, status="ok", tier=out.tier,
                          retries=out.retries, result=out.result)
+            _metrics.counter("survey_epochs_ok_total",
+                             help="fresh successful epochs").inc()
         else:
             self.tally["n_quarantined"] += 1
             self._append(key, status="quarantined", tier=out.tier,
                          retries=out.retries, error=out.error,
                          error_class=out.error_class)
+            _metrics.counter("survey_epochs_quarantined_total",
+                             help="fresh quarantined epochs").inc()
         self.outcomes.append(out)
+        self.beat()
         return out
 
 
@@ -161,7 +185,7 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
                retries=1, validate=None, journal_name="journal.jsonl",
                resume=True, pipeline=True, prefetch=4, inflight=2,
                loader_workers=2, load_fn=None, defer_validate=False,
-               timeline=None):
+               timeline=None, heartbeat=None, report=True):
     """Process ``epochs`` — an iterable of ``(epoch_id, payload)`` —
     fault-tolerantly, journaling each completion to
     ``workdir/journal_name``.
@@ -193,6 +217,18 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
     (a :class:`~scintools_tpu.utils.profiling.StageTimeline`) records
     per-epoch load/dispatch/fence/journal spans.
 
+    **Observability** (scintools_tpu/obs, docs/observability.md):
+    per-epoch counters and journal/prefetch metrics accumulate in the
+    process metrics registry; ``heartbeat`` (True, a cadence dict
+    ``{"every_n":, "every_s":}``, or a prebuilt
+    :class:`~scintools_tpu.obs.heartbeat.Heartbeat`) emits live
+    ``survey.heartbeat`` progress events; with a ``timeline``, each
+    epoch is assigned a deterministic trace ID and the spans export
+    as Chrome-trace JSON via ``timeline.export_trace(path)``; and
+    ``report=True`` (default) writes the schema-validated
+    ``run_report.json`` + ``run_report.md`` artifact into
+    ``workdir``.
+
     Returns ``{"results": {epoch_id: result_dict},
     "outcomes": [EpochOutcome...], "summary": {...}}`` where summary
     counts ok/quarantined/resumed epochs, per-tier completions, and
@@ -203,7 +239,9 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
     journal = EpochJournal(os.path.join(workdir, journal_name))
     done = journal.records() if resume else {}
     epochs = list(epochs)
+    heartbeat = _hb.as_heartbeat(heartbeat, total=len(epochs))
 
+    t_run0 = time.perf_counter()
     with slog.span("survey.robust_run", n_epochs=len(epochs),
                    workdir=os.fspath(workdir),
                    pipeline=bool(pipeline)):
@@ -211,28 +249,60 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
             rec = _run_pipelined(
                 epochs, process, journal, done, tiers, retries,
                 validate, prefetch, inflight, loader_workers, load_fn,
-                defer_validate, timeline)
+                defer_validate, timeline, heartbeat)
         else:
             rec = _run_sequential(epochs, process, journal, done,
                                   tiers, retries, validate, load_fn,
-                                  timeline)
+                                  timeline, heartbeat)
         slog.log_event("survey.robust_summary", **{
             k: v for k, v in rec.tally.items() if k != "tier_counts"},
             tier_counts=dict(rec.tally["tier_counts"]))
-    if timeline is not None:
-        timeline.log_summary()
+    wall_s = time.perf_counter() - t_run0
+    rec.beat(force=True)              # final fresh progress snapshot
+    tl_summary = _finish_timeline(timeline)
+    if report:
+        _report.write_run_report(workdir, _report.build_run_report(
+            rec.tally, rec.outcomes, wall_s=wall_s,
+            timeline=tl_summary, runner="run_survey"))
     return {"results": rec.results, "outcomes": rec.outcomes,
             "summary": rec.tally}
 
 
+def _finish_timeline(timeline):
+    """Emit the timeline's slog summary and mirror its headline
+    numbers into the metrics registry; returns the summary dict (None
+    without a timeline)."""
+    if timeline is None:
+        return None
+    s = timeline.log_summary()
+    _metrics.gauge("survey_device_idle_seconds",
+                   help="wall time no device-stage span covered"
+                   ).set(s.get("device_idle_s", 0.0))
+    _metrics.gauge("survey_overlap_frac",
+                   help="pipeline stage-overlap fraction"
+                   ).set(s.get("overlap_frac", 0.0))
+    return s
+
+
+def _trace_id(index, epoch_id):
+    """Deterministic per-epoch trace ID: stable across reruns and
+    across pipelined/sequential modes (resume byte-identity must not
+    depend on when a run happened), unique within a run."""
+    return f"{index:05d}/{epoch_id}"
+
+
 def _run_sequential(epochs, process, journal, done, tiers, retries,
-                    validate, load_fn, timeline):
+                    validate, load_fn, timeline, heartbeat=None):
     """The strictly sequential oracle: load, process, fsync — one
     epoch at a time on the calling thread (the pre-pipeline PR-2
     loop; kept as the parity/throughput baseline)."""
-    rec = _Recorder(journal, None, tiers)
+    rec = _Recorder(journal, None, tiers, heartbeat=heartbeat)
     for epoch_id, payload in epochs:
         rec.tally["n_epochs"] += 1
+        if timeline is not None:
+            timeline.assign_trace(
+                epoch_id, _trace_id(rec.tally["n_epochs"] - 1,
+                                    epoch_id))
         key = str(epoch_id)
         if key in done:
             rec.resumed(epoch_id, done[key])
@@ -253,7 +323,7 @@ def _run_sequential(epochs, process, journal, done, tiers, retries,
 
 def _run_pipelined(epochs, process, journal, done, tiers, retries,
                    validate, prefetch, inflight, loader_workers,
-                   load_fn, defer_validate, timeline):
+                   load_fn, defer_validate, timeline, heartbeat=None):
     """The pipelined engine: bounded prefetch loader feeding a
     dispatch-ahead window of un-fenced epochs, results consumed (and
     journaled via the threaded writer) in strict epoch order.
@@ -271,7 +341,7 @@ def _run_pipelined(epochs, process, journal, done, tiers, retries,
     if validate is not None and not defer_validate:
         inflight = 0
     writer = AsyncJournalWriter(journal, timeline=timeline)
-    rec = _Recorder(journal, writer, tiers)
+    rec = _Recorder(journal, writer, tiers, heartbeat=heartbeat)
     window = collections.deque()   # (epoch_id, payload, value, report)
 
     def consume_one():
@@ -298,6 +368,10 @@ def _run_pipelined(epochs, process, journal, done, tiers, retries,
             loaded = iter(loader)
             for epoch_id, payload in epochs:
                 rec.tally["n_epochs"] += 1
+                if timeline is not None:
+                    timeline.assign_trace(
+                        epoch_id, _trace_id(rec.tally["n_epochs"] - 1,
+                                            epoch_id))
                 key = str(epoch_id)
                 if key in done:
                     # strict order: everything dispatched before this
@@ -390,7 +464,8 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
                        batch_size=32, tiers=_DEFAULT_TIERS, retries=1,
                        validate=None, journal_name="journal.jsonl",
                        resume=True, pipeline=True, prefetch=4,
-                       loader_workers=2, load_fn=None, timeline=None):
+                       loader_workers=2, load_fn=None, timeline=None,
+                       heartbeat=None, report=True):
     """Batched counterpart of :func:`run_survey` for device programs
     that fit a whole epoch stack at once (e.g.
     ``fit/acf2d.py:fit_acf2d_batch`` — one compile, one H2D, one
@@ -418,9 +493,11 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
     at every batch boundary — the PR-2 SIGKILL-resume guarantee is
     unchanged. ``pipeline=False`` is the sequential oracle.
 
-    Journal format, resume semantics, and the return structure are
-    shared with :func:`run_survey` (same ``workdir`` journal resumes
-    either entry); the summary additionally counts ``n_batches``.
+    Journal format, resume semantics, observability wiring
+    (``heartbeat``/``report``/metrics — see :func:`run_survey`), and
+    the return structure are shared with :func:`run_survey` (same
+    ``workdir`` journal resumes either entry); the summary
+    additionally counts ``n_batches``.
     """
     from ..parallel.pipeline import AsyncJournalWriter, PrefetchLoader
 
@@ -434,7 +511,7 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
 
     writer = AsyncJournalWriter(journal, timeline=timeline) \
         if pipeline else None
-    rec = _Recorder(journal, writer, tiers)
+    rec = _Recorder(journal, writer, tiers, heartbeat=None)
     rec.tally["n_batches"] = 0
     outcomes_by_key = {}
 
@@ -445,7 +522,9 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
         rec.record(out)
 
     epochs = list(epochs)
+    rec.heartbeat = _hb.as_heartbeat(heartbeat, total=len(epochs))
     pending = []
+    t_run0 = time.perf_counter()
     try:
         with slog.span("survey.robust_run_batched",
                        n_epochs=len(epochs), batch_size=batch_size,
@@ -462,6 +541,11 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
                 loaded = iter(loader)
             for epoch_id, payload in scan:
                 rec.tally["n_epochs"] += 1
+                if timeline is not None:
+                    timeline.assign_trace(
+                        epoch_id,
+                        _trace_id(rec.tally["n_epochs"] - 1,
+                                  epoch_id))
                 key = str(epoch_id)
                 if key in done:
                     outcomes_by_key[key] = rec.resumed(epoch_id,
@@ -566,9 +650,14 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
     finally:
         if writer is not None:
             writer.close()
-    if timeline is not None:
-        timeline.log_summary()
+    wall_s = time.perf_counter() - t_run0
+    rec.beat(force=True)
+    tl_summary = _finish_timeline(timeline)
     ordered = [outcomes_by_key[str(e)] for e, _ in epochs]
+    if report:
+        _report.write_run_report(workdir, _report.build_run_report(
+            rec.tally, ordered, wall_s=wall_s, timeline=tl_summary,
+            runner="run_survey_batched"))
     return {"results": rec.results, "outcomes": ordered,
             "summary": rec.tally}
 

@@ -333,7 +333,7 @@ def make_eval_fn(tau, fd, edges, iters=200, method="power", squarings=10,
 # on the geometry instead (fit_thetatheta reuses one geometry across
 # all time-chunks of a frequency row).
 def keyed_jit_cache(cache, key, builder, maxsize=32,
-                    donate_argnums=None):
+                    donate_argnums=None, site=None):
     """FIFO-bounded cache of jitted kernels keyed on geometry bytes.
     Shared by the per-chunk and chunk-batched search paths.
 
@@ -343,9 +343,17 @@ def keyed_jit_cache(cache, key, builder, maxsize=32,
     for the whole program. Compiled programs additionally persist
     across *processes* via the XLA compilation cache wired by
     ``backend._maybe_enable_compilation_cache`` (same-geometry reruns
-    skip the compile, not just the retrace)."""
+    skip the compile, not just the retrace).
+
+    ``site`` names this cache in the retrace/compile accounting
+    (obs/retrace.py): every MISS is one recorded program build, which
+    the tier-1 ``retrace_guard`` gate and the RunReport's
+    ``jit_builds`` table read back."""
     fn = cache.get(key)
     if fn is None:
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build(site or "thth.keyed_jit", key)
         kwargs = {}
         if donate_argnums is not None:
             kwargs["donate_argnums"] = donate_argnums
@@ -364,7 +372,9 @@ def _jitted_eval_fn(tau, fd, edges, iters, method="power"):
            method)
     return keyed_jit_cache(
         _EVAL_JIT_CACHE, key,
-        lambda: make_eval_fn(tau, fd, edges, iters=iters, method=method))
+        lambda: make_eval_fn(tau, fd, edges, iters=iters,
+                             method=method),
+        site="thth.eval")
 
 
 def eval_calc_batch(CS, tau, fd, etas, edges, iters=200, backend=None,

@@ -228,7 +228,7 @@ def _jitted_multi_eval(tau, fd, edges, method):
     return keyed_jit_cache(
         _MULTI_JIT_CACHE, key,
         lambda: make_multi_eval_fn(tau, fd, edges, method=method),
-        maxsize=16)
+        maxsize=16, site="thth.multi_eval")
 
 
 def _jitted_fused_eval(tau, fd, edges, shape, npad, coher, tau_mask,
@@ -250,7 +250,8 @@ def _jitted_fused_eval(tau, fd, edges, shape, npad, coher, tau_mask,
     # donate the chunk stack: it is consumed by the pad+fft front end,
     # so XLA may reuse its HBM for the θ-θ batch
     return keyed_jit_cache(_MULTI_JIT_CACHE, key, build, maxsize=16,
-                           donate_argnums=_chunk_donation())
+                           donate_argnums=_chunk_donation(),
+                           site="thth.fused")
 
 
 def _chunk_donation():
@@ -404,7 +405,7 @@ def _jitted_thin_eval(tau, fd, edges, edges_arclet, center_cut):
         _MULTI_JIT_CACHE, key,
         lambda: make_thin_eval_fn(tau, fd, edges, edges_arclet,
                                   center_cut),
-        maxsize=16)
+        maxsize=16, site="thth.thin_eval")
 
 
 def _jitted_fused_thin_eval(tau, fd, edges, edges_arclet, center_cut,
@@ -425,7 +426,8 @@ def _jitted_fused_thin_eval(tau, fd, edges, edges_arclet, center_cut,
             npad=npad, coher=coher, tau_mask=tau_mask, fw=fw)
 
     return keyed_jit_cache(_MULTI_JIT_CACHE, key, build, maxsize=16,
-                           donate_argnums=_chunk_donation())
+                           donate_argnums=_chunk_donation(),
+                           site="thth.fused_thin")
 
 
 def single_search_thin(dspec, freq, time, etas, edges, edgesArclet,

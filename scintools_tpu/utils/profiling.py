@@ -149,7 +149,12 @@ class StageTimeline:
 
     ``log_summary()`` emits the summary as one structured slog event
     (utils/slog.py) so a survey run's pipeline efficiency is
-    greppable next to its quarantine/fallback records.
+    greppable next to its quarantine/fallback records, and
+    ``export_trace(path)`` writes the raw spans as Chrome-trace JSON
+    (obs/trace.py) for chrome://tracing / Perfetto, one named track
+    per stage, each span tagged with its epoch's trace ID
+    (:meth:`assign_trace` — the runner assigns deterministic per-epoch
+    IDs and threads them through loader/dispatch/fence/journal spans).
     """
 
     def __init__(self, device_stage="compute"):
@@ -157,6 +162,7 @@ class StageTimeline:
 
         self.device_stage = device_stage
         self._spans = []                # (stage, epoch, t0, t1)
+        self._trace_ids = {}            # epoch -> trace-id string
         self._lock = threading.Lock()
 
     def record(self, epoch, stage, t0, t1):
@@ -164,6 +170,31 @@ class StageTimeline:
         with self._lock:
             self._spans.append((str(stage), epoch, float(t0),
                                 float(t1)))
+
+    def assign_trace(self, epoch, trace_id):
+        """Bind ``epoch`` to a trace-id string: every span of that
+        epoch (whichever thread recorded it) carries the ID in the
+        exported trace."""
+        with self._lock:
+            self._trace_ids[epoch] = str(trace_id)
+
+    def trace_ids(self):
+        with self._lock:
+            return dict(self._trace_ids)
+
+    def spans(self):
+        """Snapshot of the recorded ``(stage, epoch, t0, t1)`` spans."""
+        with self._lock:
+            return list(self._spans)
+
+    def export_trace(self, path):
+        """Write the recorded spans as a Chrome-trace JSON file
+        (loads in chrome://tracing and ui.perfetto.dev); returns the
+        path. See obs/trace.py for the format conventions."""
+        from ..obs.trace import write_chrome_trace
+
+        return write_chrome_trace(path, self.spans(),
+                                  trace_ids=self.trace_ids())
 
     @contextmanager
     def span(self, epoch, stage):

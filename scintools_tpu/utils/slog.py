@@ -8,15 +8,24 @@ not greppable or machine-readable, so this module adds a minimal
 structured logger:
 
 - ``log_event(event, **fields)`` — one JSON object per line with a
-  wall-clock timestamp, to stderr and/or a file;
-- ``configure(path=None, echo=True, enabled=None)`` — process-wide
-  sink; ``SCINTOOLS_LOG=<path>`` enables file logging from the
-  environment;
+  wall-clock timestamp and the emitting ``pid`` (multi-process survey
+  logs stay attributable), to stderr and/or a file;
+- ``configure(path=None, echo=True)`` — process-wide sink;
+  ``SCINTOOLS_LOG=<path>`` enables file logging from the environment;
 - ``span(event, **fields)`` — context manager that logs start/end
-  with duration and error status.
+  with duration and error status;
+- ``reset()`` — clear the in-memory tail and restore the sink to its
+  environment defaults (test isolation; tests/conftest.py applies it
+  around every test).
 
-No dependencies; safe to call from pool workers (line-buffered append
-writes are atomic enough for JSONL at this scale).
+The file sink keeps ONE cached append handle (reopened when the path
+changes or after a fork) instead of reopening per event — at survey
+rates the open/close pair dominated the write. Writes are flushed per
+line and serialised under a lock, so records from the prefetch-loader
+threads and the journal writer interleave whole-line.
+
+No dependencies; safe to call from pool workers (single-write
+appends are atomic enough for JSONL at this scale).
 """
 
 from __future__ import annotations
@@ -24,14 +33,26 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 
-_STATE = {
-    "path": os.environ.get("SCINTOOLS_LOG") or None,
-    "echo": bool(int(os.environ.get("SCINTOOLS_LOG_ECHO", "0"))),
-}
+
+def _env_state():
+    return {
+        "path": os.environ.get("SCINTOOLS_LOG") or None,
+        "echo": bool(int(os.environ.get("SCINTOOLS_LOG_ECHO", "0"))),
+    }
+
+
+_STATE = _env_state()
+
+# cached file-sink handle: {"fh", "path", "pid"} — reopened when the
+# configured path changes, on reset(), or when the pid changed (a
+# fork must not share the parent's buffered handle position)
+_SINK = {"fh": None, "path": None, "pid": None}
+_LOCK = threading.Lock()
 
 # in-memory tail of recent events, kept even with no sink configured:
 # the robust survey layer reads failure records back for its run
@@ -40,14 +61,40 @@ _STATE = {
 _RECENT = deque(maxlen=512)
 
 
+def _close_sink_locked():
+    fh = _SINK["fh"]
+    _SINK.update(fh=None, path=None, pid=None)
+    if fh is not None:
+        try:
+            fh.close()
+        except OSError:  # broad is fine: a failed close of a log
+            # handle must never propagate into the survey
+            pass
+
+
 def configure(path=None, echo=None):
     """Set the process-wide log sink. ``path=None`` keeps the current
     file (env ``SCINTOOLS_LOG`` by default); ``echo`` mirrors events
-    to stderr."""
-    if path is not None:
-        _STATE["path"] = path
-    if echo is not None:
-        _STATE["echo"] = bool(echo)
+    to stderr. Changing the path closes the cached handle so the next
+    event reopens the new file."""
+    with _LOCK:
+        if path is not None:
+            _STATE["path"] = path
+            _close_sink_locked()
+        if echo is not None:
+            _STATE["echo"] = bool(echo)
+
+
+def reset():
+    """Restore the logger to a fresh state: close the cached sink
+    handle, clear the in-memory tail, and re-read the environment
+    defaults. The per-test isolation hook (tests/conftest.py) — a
+    test that filters :func:`recent` sees only its own events."""
+    with _LOCK:
+        _close_sink_locked()
+        _RECENT.clear()
+        _STATE.clear()
+        _STATE.update(_env_state())
 
 
 def enabled():
@@ -85,11 +132,23 @@ def log_failure(event="robust.failure", epoch=None, stage=None,
     log_event(event, **fields)
 
 
+def _sink_handle_locked():
+    """The cached append handle for the configured path, (re)opened
+    when the path or pid changed. Caller holds ``_LOCK``."""
+    path, pid = _STATE["path"], os.getpid()
+    if _SINK["fh"] is None or _SINK["path"] != path \
+            or _SINK["pid"] != pid:
+        _close_sink_locked()
+        _SINK.update(fh=open(path, "a"), path=path, pid=pid)
+    return _SINK["fh"]
+
+
 def log_event(event, **fields):
     """Emit one structured event. Always recorded in the in-memory
     tail (:func:`recent`); written to stderr/file only when a sink is
-    configured."""
-    rec = {"t": round(time.time(), 3), "event": event, **fields}
+    configured. Each record is stamped with the emitting ``pid``."""
+    rec = {"t": round(time.time(), 3), "pid": os.getpid(),
+           "event": event, **fields}
     _RECENT.append(rec)
     if not enabled():
         return
@@ -98,8 +157,10 @@ def log_event(event, **fields):
         print(line, file=sys.stderr)
     if _STATE["path"]:
         try:
-            with open(_STATE["path"], "a") as fh:
+            with _LOCK:
+                fh = _sink_handle_locked()
                 fh.write(line + "\n")
+                fh.flush()
         except OSError as e:  # never let logging kill a survey
             print(f"Warning: structured log write failed ({e})",
                   file=sys.stderr)

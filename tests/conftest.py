@@ -38,11 +38,32 @@ jax.devices()
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from scintools_tpu import obs  # noqa: E402
+from scintools_tpu.utils import slog  # noqa: E402
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: excluded from the tier-1 gate (-m 'not slow')")
+
+
+@pytest.fixture(autouse=True)
+def _isolate_observability():
+    """Per-test observability isolation (ISSUE 5 satellite): reset the
+    slog ring buffer + sink and the metrics registry around EVERY
+    test, so ``slog.recent(event=...)`` filters and metric snapshots
+    see only the current test's records — the old workaround of
+    unique epoch-name prefixes per test file is no longer needed.
+    jit-build accounting (obs.retrace) is deliberately NOT reset: the
+    program caches it mirrors are process-wide, and zeroing the
+    counts while the caches stay warm would let a retrace_guard pass
+    vacuously."""
+    slog.reset()
+    obs.metrics.REGISTRY.reset()
+    obs.metrics.set_enabled(True)
+    yield
+    slog.reset()
 
 
 @pytest.fixture(scope="session")

@@ -16,6 +16,12 @@
   async. Deliberate result-consumption boundaries carry a
   ``# sync-ok: <reason>`` marker; utils/profiling.py (whose job IS
   fencing) is allowlisted.
+- tools/lint_obs_events.py (ISSUE 5 satellite) requires every
+  ``slog.log_event``/``log_failure``/``span`` event name in the
+  package to appear in the documented catalog
+  (docs/observability.md) — the event stream is a stable interface,
+  not a place for drive-by unnamed events. Non-literal names carry
+  an ``# obs-event-ok: <name>`` marker.
 """
 
 import importlib.util
@@ -160,3 +166,75 @@ class TestSyncpoints:
         assert lint._allowlisted(
             os.path.join(REPO, "scintools_tpu", "utils",
                          "profiling.py"), REPO)
+
+
+class TestObsEvents:
+    """tools/lint_obs_events.py (ISSUE 5): every emitted slog event
+    name must be in the docs/observability.md catalog."""
+
+    DOC = os.path.join(REPO, "docs", "observability.md")
+
+    def test_package_events_are_documented(self):
+        lint = _tool("lint_obs_events")
+        violations = lint.scan_tree(
+            os.path.join(REPO, "scintools_tpu"), self.DOC)
+        assert violations == [], (
+            "undocumented / unresolvable slog event names "
+            f"(document them in docs/observability.md): {violations}")
+
+    def test_catalog_parses_known_events(self):
+        lint = _tool("lint_obs_events")
+        names = lint.catalog_names(self.DOC)
+        assert {"robust.quarantine", "robust.fallback",
+                "survey.heartbeat", "survey.run_report",
+                "survey.pipeline_timeline"} <= names
+
+    def test_detector_resolves_literals_and_defaults(self):
+        lint = _tool("lint_obs_events")
+        src = ("from scintools_tpu.utils import slog\n"
+               "def f(event='my.default'):\n"
+               "    slog.log_event(event, a=1)\n"
+               "    slog.log_event('my.literal')\n"
+               "    with slog.span('my.span'):\n"
+               "        pass\n"
+               "    slog.log_failure(epoch='e0')\n")
+        events, violations = lint.scan_source(src)
+        assert violations == []
+        assert {n for _, n in events} == {
+            "my.default", "my.literal", "my.span", "robust.failure"}
+
+    def test_detector_flags_unresolvable_and_accepts_marker(self):
+        lint = _tool("lint_obs_events")
+        src = ("from scintools_tpu.utils import slog\n"
+               "class C:\n"
+               "    def f(self):\n"
+               "        slog.log_event(self.event)\n")
+        events, violations = lint.scan_source(src)
+        assert len(violations) == 1
+        assert "unresolvable" in violations[0][1]
+        marked = src.replace(
+            "slog.log_event(self.event)",
+            "slog.log_event(self.event)  # obs-event-ok: my.marked")
+        events, violations = lint.scan_source(marked)
+        assert violations == []
+        assert events == [(4, "my.marked")]
+
+    def test_detector_ignores_timeline_spans(self):
+        """``StageTimeline.span`` is a stage recorder, not an event
+        emitter — attribute ``span`` calls on non-slog receivers must
+        not be treated as events."""
+        lint = _tool("lint_obs_events")
+        src = ("with timeline.span('e0', 'load'):\n"
+               "    pass\n")
+        events, violations = lint.scan_source(src)
+        assert events == [] and violations == []
+
+    def test_undocumented_event_fails_tree_scan(self, tmp_path):
+        lint = _tool("lint_obs_events")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            "from scintools_tpu.utils import slog\n"
+            "slog.log_event('not.in.catalog')\n")
+        out = lint.scan_tree(str(pkg), self.DOC)
+        assert len(out) == 1 and "not in the catalog" in out[0][2]

@@ -1,0 +1,369 @@
+"""The unified observability layer (ISSUE 5): metrics registry,
+Chrome-trace export, retrace accounting, heartbeat, RunReport.
+
+Covers the tentpole's acceptance surface: registry thread-safety and
+snapshot/Prometheus round-trips, Perfetto/Chrome-trace structural
+validity (sorted ts, matched pid/tid) with per-epoch trace IDs
+threaded through the pipelined runner, the retrace gate tripping on a
+deliberately un-cached wrapper, heartbeat cadence, and the RunReport
+schema under clean and fault-injected runs."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from scintools_tpu.obs import (heartbeat as hb, metrics, report,
+                               retrace, trace)
+from scintools_tpu.robust.runner import run_survey
+from scintools_tpu.utils import slog
+from scintools_tpu.utils.profiling import StageTimeline
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("c_total", help="a counter")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("g")
+        g.set(2.5)
+        g.inc()
+        g.dec(0.5)
+        assert g.value == 3.0
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = reg.snapshot()["histograms"]["h_seconds"]
+        assert snap["count"] == 3
+        assert snap["sum"] == pytest.approx(5.55)
+        assert snap["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 3}
+
+    def test_labels_and_same_name_returns_same_metric(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("t_total").labels(tier="fused").inc(2)
+        reg.counter("t_total").labels(tier="numpy").inc()
+        reg.counter("t_total").inc()            # unlabeled child
+        snap = reg.snapshot()["counters"]
+        assert snap == {"t_total": 1, 't_total{tier="fused"}': 2,
+                        't_total{tier="numpy"}': 1}
+        with pytest.raises(TypeError):
+            reg.gauge("t_total")                # kind mismatch
+
+    def test_thread_safety_exact_counts(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("n_total")
+        h = reg.histogram("obs_seconds")
+        n_threads, per = 8, 2000
+
+        def work():
+            for _ in range(per):
+                c.inc()
+                h.observe(0.01)
+
+        threads = [threading.Thread(target=work)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * per
+        assert reg.snapshot()["histograms"]["obs_seconds"]["count"] \
+            == n_threads * per
+
+    def test_snapshot_json_round_trip(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("a_total").inc(3)
+        reg.gauge("b").set(1.5)
+        reg.histogram("c_seconds").observe(0.2)
+        snap = reg.snapshot()
+        assert json.loads(json.dumps(snap)) == snap
+
+    def test_prometheus_text_format(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("e_total", help="epochs").labels(kind="ok").inc(7)
+        reg.gauge("depth").set(3)
+        reg.histogram("load_seconds", buckets=(0.5,)).observe(0.1)
+        text = reg.to_prometheus()
+        assert "# TYPE e_total counter" in text
+        assert 'e_total{kind="ok"} 7' in text
+        assert "# HELP e_total epochs" in text
+        assert "# TYPE depth gauge" in text
+        assert 'load_seconds_bucket{le="0.5"} 1' in text
+        assert "load_seconds_count 1" in text
+
+    def test_disable_makes_updates_noops(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("x_total")
+        c.inc()
+        reg.set_enabled(False)
+        c.inc(100)
+        reg.gauge("y").set(9)
+        assert c.value == 1
+        reg.set_enabled(True)
+        c.inc()
+        assert c.value == 2
+
+
+class TestChromeTrace:
+    def _spans(self):
+        return [("load", "e0", 1.0, 1.2), ("dispatch", "e0", 1.2, 1.3),
+                ("load", "e1", 1.1, 1.4), ("journal", "e0", 1.3, 1.31)]
+
+    def test_events_sorted_with_matched_pid_tid(self, tmp_path):
+        path = tmp_path / "trace.json"
+        trace.write_chrome_trace(path, self._spans(),
+                                 trace_ids={"e0": "00000/e0"})
+        doc = json.load(open(path))
+        events = trace.validate_chrome_trace(doc)   # raises on fail
+        xs = [e for e in events if e["ph"] == "X"]
+        assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+        # one named track per stage, matched by every X event
+        names = {(e["pid"], e["tid"]): e["args"]["name"]
+                 for e in events
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert set(names.values()) == {"load", "dispatch", "journal"}
+        for e in xs:
+            assert names[(e["pid"], e["tid"])] == e["name"]
+        e0 = [e for e in xs if e["args"]["epoch"] == "e0"]
+        assert all(e["args"]["trace_id"] == "00000/e0" for e in e0)
+
+    def test_validator_rejects_malformed(self):
+        with pytest.raises(ValueError, match="traceEvents"):
+            trace.validate_chrome_trace([])
+        bad = {"traceEvents": [
+            {"name": "x", "ph": "X", "ts": 0, "dur": 1,
+             "pid": 1, "tid": 9}]}
+        with pytest.raises(ValueError, match="unnamed track"):
+            trace.validate_chrome_trace(bad)
+
+    def test_timeline_export_threads_trace_ids(self, tmp_path):
+        """run_survey assigns a deterministic trace ID per epoch; the
+        exported trace carries it on spans recorded by the loader
+        threads, the dispatch loop, AND the journal writer."""
+        tl = StageTimeline(device_stage="dispatch")
+
+        def loader(i):
+            return lambda: float(i)
+
+        epochs = [(f"t{i}", loader(i)) for i in range(6)]
+        run_survey(epochs, lambda p, tier=None: {"v": p},
+                   str(tmp_path), timeline=tl, report=False)
+        assert tl.trace_ids() == {
+            f"t{i}": f"{i:05d}/t{i}" for i in range(6)}
+        path = tl.export_trace(str(tmp_path / "tr.json"))
+        doc = json.load(open(path))
+        xs = trace.validate_chrome_trace(doc)
+        stages_seen = {e["name"] for e in xs if e["ph"] == "X"}
+        assert {"load", "dispatch", "journal"} <= stages_seen
+        tagged = [e for e in xs if e["ph"] == "X"
+                  and "trace_id" in e["args"]]
+        assert tagged, "no span carried a trace id"
+        for e in tagged:
+            idx = int(e["args"]["trace_id"].split("/")[0])
+            assert e["args"]["trace_id"] == f"{idx:05d}/t{idx}"
+            assert e["args"]["epoch"] == f"t{idx}"
+
+
+class TestRetrace:
+    def test_record_and_counts_and_metric(self):
+        before = retrace.compile_counts().get("test.site", 0)
+        retrace.record_build("test.site", key=("a", 1))
+        retrace.record_build("test.site", key=("a", 1))
+        retrace.record_build("test.site", key=("b", 2))
+        snap = retrace.snapshot()["test.site"]
+        assert retrace.compile_counts()["test.site"] - before == 3
+        assert snap["distinct_keys"] >= 2
+        counters = metrics.snapshot()["counters"]
+        assert counters['jit_builds_total{site="test.site"}'] == 3
+
+    def test_guard_passes_on_cached_workload(self):
+        import jax.numpy as jnp
+
+        from scintools_tpu.fit.batch import make_acf1d_batch
+
+        fit = make_acf1d_batch(16, 16, 1.0, 0.1)   # warm (maybe miss)
+        tc = jnp.ones((1, 16))
+        with retrace.retrace_guard():
+            # repeated same-config call must hit _ACF1D_BATCH_CACHE
+            assert make_acf1d_batch(16, 16, 1.0, 0.1) is fit
+            fit(tc, tc)
+
+    def test_guard_trips_on_uncached_wrapper(self):
+        """A factory that rebuilds (and so re-records) per call is
+        exactly the regression the gate exists for."""
+
+        def uncached_factory():
+            retrace.record_build("test.uncached", key=None)
+            return lambda x: x
+
+        uncached_factory()                    # "warm" — but not cached
+        with pytest.raises(retrace.RetraceRegression, match="uncached"):
+            with retrace.retrace_guard(sites=["test.uncached"]):
+                uncached_factory()
+
+    def test_guard_scopes_to_named_sites(self):
+        with retrace.retrace_guard(sites=["test.only_this"]) as grew:
+            retrace.record_build("test.other_site")
+        assert grew == {}
+
+
+class TestHeartbeat:
+    def test_cadence_every_n_and_final_force(self):
+        h = hb.Heartbeat(every_n=4, every_s=3600, total=10)
+        for i in range(1, 11):
+            h.beat(i, ok=i)
+        h.beat(10, force=True, ok=10)
+        recs = slog.recent(event="survey.heartbeat")
+        # due at 4 and 8; 10 only via... not force-deduped since the
+        # cadence never fired at 10
+        assert [r["done"] for r in recs] == [4, 8, 10]
+        assert all(r["total"] == 10 for r in recs)
+        assert recs[-1]["ok"] == 10
+        assert "epochs_per_sec" in recs[-1]
+        assert "eta_s" in recs[-1]
+
+    def test_force_dedup_when_cadence_just_fired(self):
+        h = hb.Heartbeat(every_n=2, every_s=3600)
+        h.beat(2)
+        assert h.beat(2, force=True) is None
+        assert len(slog.recent(event="survey.heartbeat")) == 1
+
+    def test_as_heartbeat_normalisation(self):
+        assert hb.as_heartbeat(None) is None
+        assert hb.as_heartbeat(False) is None
+        h = hb.as_heartbeat(True, total=7)
+        assert isinstance(h, hb.Heartbeat) and h.total == 7
+        h = hb.as_heartbeat({"every_n": 3}, total=9)
+        assert h.every_n == 3 and h.total == 9
+        with pytest.raises(TypeError):
+            hb.as_heartbeat(42)
+
+    def test_runner_emits_heartbeats(self, tmp_path):
+        epochs = [(f"h{i}", float(i)) for i in range(9)]
+        run_survey(epochs, lambda p, tier=None: {"v": p},
+                   str(tmp_path), heartbeat={"every_n": 3},
+                   report=False)
+        recs = slog.recent(event="survey.heartbeat")
+        assert [r["done"] for r in recs] == [3, 6, 9]
+        assert recs[-1]["ok"] == 9 and recs[-1]["quarantined"] == 0
+
+
+class TestRunReport:
+    def _run(self, tmp_path, inject_bad=False, **kw):
+        from scintools_tpu.io import MalformedInputError
+
+        def process(payload, tier=None):
+            if payload is None:
+                raise MalformedInputError("<epoch>", "corrupt epoch")
+            return {"v": payload * 2}
+
+        epochs = [(f"r{i}", None if (inject_bad and i in (2, 5))
+                   else float(i)) for i in range(8)]
+        return run_survey(epochs, process, str(tmp_path), **kw)
+
+    def test_clean_run_report_schema_and_content(self, tmp_path):
+        tl = StageTimeline(device_stage="dispatch")
+        out = self._run(tmp_path, timeline=tl)
+        path = tmp_path / "run_report.json"
+        assert path.exists()
+        rep = json.loads(path.read_text())
+        report.validate_run_report(rep)
+        assert rep["runner"] == "run_survey"
+        assert rep["n_ok"] == 8 and rep["n_quarantined"] == 0
+        assert rep["quarantined"] == []
+        assert rep["tier_counts"]["jax_fused"] == 8
+        assert rep["wall_s"] > 0 and rep["epochs_per_sec"] > 0
+        assert rep["timeline"]["n_epochs"] == 8
+        assert "overlap_frac" in rep["timeline"]
+        assert isinstance(rep["jit_builds"], dict)
+        # metrics snapshot rides along and reflects this run
+        assert rep["metrics"]["counters"][
+            "survey_epochs_ok_total"] == 8
+        md = (tmp_path / "run_report.md").read_text()
+        assert "Survey run report" in md and "| ok | 8 |" in md
+        # the write is announced on the event stream
+        assert slog.recent(event="survey.run_report")
+        assert out["summary"]["n_ok"] == 8
+
+    def test_fault_injected_report_lists_quarantined(self, tmp_path):
+        out = self._run(tmp_path, inject_bad=True)
+        rep = json.loads((tmp_path / "run_report.json").read_text())
+        report.validate_run_report(rep)
+        assert rep["n_ok"] == 6 and rep["n_quarantined"] == 2
+        assert {q["epoch"] for q in rep["quarantined"]} == {"r2", "r5"}
+        assert all(q["error_class"] for q in rep["quarantined"])
+        assert out["summary"]["n_quarantined"] == 2
+
+    def test_resumed_run_report_counts_resumed(self, tmp_path):
+        self._run(tmp_path)
+        self._run(tmp_path)                     # all resumed
+        rep = json.loads((tmp_path / "run_report.json").read_text())
+        report.validate_run_report(rep)
+        assert rep["n_resumed"] == 8 and rep["n_ok"] == 0
+        assert rep["epochs_per_sec"] is None    # no fresh epochs
+
+    def test_report_false_suppresses_artifact(self, tmp_path):
+        self._run(tmp_path, report=False)
+        assert not (tmp_path / "run_report.json").exists()
+
+    def test_validator_rejects_bad_schema(self):
+        good = report.build_run_report(
+            {"n_epochs": 1, "n_ok": 1, "n_quarantined": 0,
+             "n_resumed": 0, "retries": 0, "tier_counts": {}},
+            wall_s=1.0)
+        report.validate_run_report(good)
+        bad = dict(good, n_ok="one")
+        with pytest.raises(ValueError, match="n_ok"):
+            report.validate_run_report(bad)
+        with pytest.raises(ValueError, match="missing"):
+            report.validate_run_report({"schema_version": 1})
+
+    def test_batched_runner_writes_report(self, tmp_path):
+        from scintools_tpu.robust.runner import run_survey_batched
+
+        def process_batch(payloads, tier=None):
+            return [{"v": p, "ok": 0} for p in payloads]
+
+        epochs = [(f"b{i}", float(i)) for i in range(6)]
+        run_survey_batched(epochs, process_batch, str(tmp_path),
+                           batch_size=4)
+        rep = json.loads((tmp_path / "run_report.json").read_text())
+        report.validate_run_report(rep)
+        assert rep["runner"] == "run_survey_batched"
+        assert rep["n_batches"] == 2 and rep["n_ok"] == 6
+
+
+class TestRunnerMetrics:
+    def test_survey_metrics_accumulate(self, tmp_path):
+        self_epochs = [(f"m{i}", float(i)) for i in range(5)]
+        run_survey(self_epochs, lambda p, tier=None: {"v": p},
+                   str(tmp_path), report=False)
+        snap = metrics.snapshot()
+        assert snap["counters"]["survey_epochs_ok_total"] == 5
+        assert snap["counters"]["survey_journal_fsyncs_total"] >= 1
+        assert snap["counters"]["survey_journal_bytes_total"] > 0
+        assert snap["histograms"]["survey_load_seconds"]["count"] == 5
+
+    def test_sequential_oracle_feeds_same_counters(self, tmp_path):
+        epochs = [(f"s{i}", float(i)) for i in range(3)]
+        run_survey(epochs, lambda p, tier=None: {"v": p},
+                   str(tmp_path), pipeline=False, report=False)
+        snap = metrics.snapshot()
+        assert snap["counters"]["survey_epochs_ok_total"] == 3
+        # sequential path fsyncs per line
+        assert snap["counters"]["survey_journal_fsyncs_total"] == 3
+
+
+def test_obs_namespace_exports():
+    import scintools_tpu.obs as obs
+
+    for name in ("REGISTRY", "MetricsRegistry", "Heartbeat",
+                 "retrace_guard", "validate_run_report",
+                 "write_chrome_trace", "validate_chrome_trace",
+                 "record_build", "build_run_report"):
+        assert hasattr(obs, name), name

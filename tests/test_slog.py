@@ -1,27 +1,25 @@
-"""Structured logging (utils/slog.py) and its pipeline wiring."""
+"""Structured logging (utils/slog.py) and its pipeline wiring.
+
+Sink/ring-buffer isolation comes from the autouse
+``_isolate_observability`` fixture (tests/conftest.py) calling
+``slog.reset()`` around every test — no per-file fixture or manual
+state juggling (the pre-ISSUE-5 workaround)."""
 
 import json
 import os
 
-import numpy as np
 import pytest
 
 from scintools_tpu.utils import slog
 
 
-@pytest.fixture(autouse=True)
-def _reset_sink():
-    old = dict(slog._STATE)
-    yield
-    slog._STATE.update(old)
-
-
 class TestSlog:
-    def test_disabled_by_default_noop(self, tmp_path):
-        slog.configure(echo=False)
-        slog._STATE["path"] = None
+    def test_disabled_by_default_noop(self):
+        # fresh (reset) state: no sink, no echo — events only reach
+        # the in-memory tail
         slog.log_event("x", a=1)          # must not raise or write
         assert not slog.enabled()
+        assert slog.recent(event="x")[0]["a"] == 1
 
     def test_jsonl_events_and_span(self, tmp_path):
         path = tmp_path / "log.jsonl"
@@ -38,6 +36,41 @@ class TestSlog:
                           "boom.start", "boom.end"]
         assert lines[2]["ok"] is True and "secs" in lines[2]
         assert lines[4]["ok"] is False and "ValueError" in lines[4]["error"]
+
+    def test_records_stamped_with_pid(self, tmp_path):
+        path = tmp_path / "log.jsonl"
+        slog.configure(path=str(path), echo=False)
+        slog.log_event("who")
+        rec = json.loads(path.read_text().splitlines()[0])
+        assert rec["pid"] == os.getpid()
+        assert slog.recent(event="who")[0]["pid"] == os.getpid()
+
+    def test_sink_handle_cached_and_reopened_on_configure(
+            self, tmp_path):
+        """The file sink keeps one append handle across events (no
+        per-event reopen) and follows a configure() to a new path."""
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        slog.configure(path=str(a), echo=False)
+        slog.log_event("one")
+        fh_first = slog._SINK["fh"]
+        assert fh_first is not None
+        slog.log_event("two")
+        assert slog._SINK["fh"] is fh_first     # cached, not reopened
+        slog.configure(path=str(b))
+        slog.log_event("three")
+        assert slog._SINK["fh"] is not fh_first
+        assert len(a.read_text().splitlines()) == 2
+        assert json.loads(b.read_text())["event"] == "three"
+
+    def test_reset_clears_recent_and_sink(self, tmp_path):
+        slog.configure(path=str(tmp_path / "r.jsonl"), echo=False)
+        slog.log_event("before")
+        assert slog.recent(event="before")
+        slog.reset()
+        assert slog.recent() == []
+        assert slog._SINK["fh"] is None
+        # back to environment defaults (no sink in the test env)
+        assert not slog.enabled()
 
     def test_sort_dyn_emits_decisions(self, tmp_path):
         from scintools_tpu.dynspec import sort_dyn
