@@ -1481,6 +1481,134 @@ def bench_survey_pipeline(jax, jnp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_survey_service(jax, jnp):
+    """Config #5d (ISSUE 6 tentpole): the STREAMING survey daemon
+    (scintools_tpu/serve, docs/serving.md) under a modeled telescope
+    feed — psrflux epochs land in a spool directory at a fixed
+    arrival cadence (``SCINTOOLS_BENCH_ARRIVAL_MS``, default 15;
+    atomic link-into-spool, so the watcher sees complete files) and
+    the daemon streams them through the pipelined fit engine to the
+    journaled results store.
+
+    Recorded per run: steady-state published epochs/s measured from
+    first arrival to last publish (the service figure of merit —
+    arrival-bound when the engine keeps up), the ingest→published
+    end-to-end latency p50/p95 from the daemon's own accounting (the
+    same numbers its heartbeats and /report serve), and the
+    **scrape-under-load overhead**: the identical stream is run once
+    more with a client hammering ``/metrics`` every ~20 ms, and the
+    throughput delta is ``scrape_overhead_frac`` (the live telemetry
+    surface must not stall the pipeline it observes). The scrape
+    response's Prometheus content type is checked in-run."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from scintools_tpu.dynspec import (_psrflux_survey_fns,
+                                       serve_psrflux_survey)
+    from scintools_tpu.io import write_psrflux
+    from scintools_tpu.io.psrflux import RawDynSpec
+
+    B = 32
+    nf, nt = 64, 32
+    n_iter = 40
+    arrival_ms = float(os.environ.get("SCINTOOLS_BENCH_ARRIVAL_MS",
+                                      15))
+    rng = np.random.default_rng(23)
+    root = tempfile.mkdtemp(prefix="bench_service_")
+    try:
+        staging = []
+        for i in range(B):
+            path = os.path.join(root, f"stage{i:03d}.dynspec")
+            write_psrflux(RawDynSpec(
+                dyn=rng.normal(10.0, 1.0, (nf, nt)),
+                times=np.arange(nt) * 10.0,
+                freqs=1300.0 + np.arange(float(nf))), path)
+            staging.append(path)
+        load_fn, process = _psrflux_survey_fns(None, 5 / 3, n_iter)
+        warm_payload = load_fn(staging[0])
+        t0 = time.perf_counter()
+        process(warm_payload)            # compile outside the stream
+        compile_s = time.perf_counter() - t0
+
+        def run(tag, scrape):
+            spool = os.path.join(root, f"spool_{tag}")
+            os.makedirs(spool)
+            svc = serve_psrflux_survey(
+                spool, os.path.join(root, f"run_{tag}"),
+                n_iter=n_iter, poll_s=0.02, heartbeat=False,
+                warmup=lambda: process(warm_payload))
+            stop_scrape = threading.Event()
+            scrape_state = {"n": 0, "content_type": None}
+
+            def scraper():
+                url = (f"http://127.0.0.1:{svc.http_port}"
+                       f"/metrics")
+                while not stop_scrape.is_set():
+                    with urllib.request.urlopen(url, timeout=5) as r:
+                        scrape_state["n"] += 1
+                        scrape_state["content_type"] = \
+                            r.headers.get("Content-Type")
+                        r.read()
+                    stop_scrape.wait(0.02)
+
+            sthread = threading.Thread(target=scraper, daemon=True)
+            if scrape:
+                sthread.start()
+            t_first = time.perf_counter()
+            for i, src in enumerate(staging):
+                # atomic arrival: a link appears complete or not at
+                # all (the real feed renames-into-place the same way)
+                os.link(src, os.path.join(spool,
+                                          f"epoch{i:03d}.dynspec"))
+                time.sleep(arrival_ms / 1e3)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                counts = svc.state_snapshot()["counts"]
+                if counts.get("ok", 0) + counts.get(
+                        "quarantined", 0) >= B:
+                    break
+                time.sleep(0.01)
+            t_done = time.perf_counter()
+            stop_scrape.set()
+            pct = svc.latency_percentiles()
+            counts = svc.state_snapshot()["counts"]
+            svc.stop()
+            return {"wall_s": t_done - t_first, "counts": counts,
+                    "latency": pct, "scrapes": scrape_state["n"],
+                    "content_type": scrape_state["content_type"]}
+
+        plain = run("plain", scrape=False)
+        loaded = run("scrape", scrape=True)
+        eps_plain = B / plain["wall_s"]
+        eps_scrape = B / loaded["wall_s"]
+        return {
+            "epochs": B, "size": f"{nf}x{nt}",
+            "arrival_cadence_ms": arrival_ms,
+            "compile_s": round(compile_s, 3),
+            "epochs_per_sec": round(eps_plain, 2),
+            "latency_p50_s": plain["latency"]["p50_s"],
+            "latency_p95_s": plain["latency"]["p95_s"],
+            "ok": plain["counts"].get("ok", 0),
+            "quarantined": plain["counts"].get("quarantined", 0),
+            "scrape_epochs_per_sec": round(eps_scrape, 2),
+            "scrape_overhead_frac": round(
+                (loaded["wall_s"] - plain["wall_s"])
+                / plain["wall_s"], 4),
+            "metrics_scrapes": loaded["scrapes"],
+            "scrape_latency_p95_s": loaded["latency"]["p95_s"],
+            "scrape_content_type_ok":
+                "version=0.0.4" in (loaded["content_type"] or ""),
+            "stream_fault_gate":
+                "tests/test_serve.py::TestStreamFaults",
+            "sigkill_resume_gate":
+                "tests/test_serve.py::TestKillAndResumeService",
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_scattered_image(jax, jnp):
     """Config #7: the scattered-image interpolation — the reference
     evaluates a host FITPACK bicubic spline at every (tdel_est, fdop)
@@ -1585,6 +1713,7 @@ _EST_S = {
     "acf_fit_batch": {"acc": 120, "cpu": 150},
     "survey":        {"acc": 150, "cpu": 120},
     "survey_pipeline": {"acc": 60, "cpu": 60},
+    "survey_service": {"acc": 60, "cpu": 60},
     "survey_arc":    {"acc": 180, "cpu": 90},
     "sim_batch":     {"acc": 60,  "cpu": 90},
     "robust":        {"acc": 60,  "cpu": 60},
@@ -1715,6 +1844,7 @@ def main():
         ("acf_fit_batch", bench_acf_fit_batch),
         ("survey", bench_survey),
         ("survey_pipeline", bench_survey_pipeline),
+        ("survey_service", bench_survey_service),
         ("acf2d_batch", bench_acf2d_batch),
         ("survey_arc", bench_survey_arc),
         ("sim_batch", bench_sim_batch),

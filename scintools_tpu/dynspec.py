@@ -2206,19 +2206,33 @@ def run_psrflux_survey(dynfiles, workdir, crop=None, alpha=5 / 3,
     default, and a ``timeline`` whose spans (tagged with per-epoch
     trace IDs) export to a trace viewer via
     ``timeline.export_trace(path)``."""
-    from .fit.batch import scint_params_batch
     from .robust import run_survey
+
+    load_fn, process = _psrflux_survey_fns(crop, alpha, n_iter)
+    epochs = [(os.path.basename(os.fspath(f)),
+               _psrflux_loader(f, load_fn)) for f in dynfiles]
+    return run_survey(epochs, process, workdir, pipeline=pipeline,
+                      prefetch=prefetch, inflight=inflight,
+                      loader_workers=loader_workers,
+                      timeline=timeline, **runner_kw)
+
+
+def _psrflux_survey_fns(crop, alpha, n_iter):
+    """The (load_fn, process) pair both psrflux survey entries share:
+    ``load_fn(path)`` parses + crops one epoch (survey-mode errors →
+    :class:`~scintools_tpu.io.MalformedInputError`, the quarantining
+    kind), ``process(payload, tier=...)`` runs the batched-ACF acf1d
+    LM fit (fit/batch.py:scint_params_batch, B=1 lane) on the tier's
+    backend."""
+    from .fit.batch import scint_params_batch
     from .robust.ladder import TIER_NUMPY
 
-    def make_loader(path):
-        def load():
-            ds = load_psrflux(path, survey=True)
-            dyn = np.asarray(ds.dyn, dtype=np.float32)
-            if crop is not None:
-                dyn = dyn[:crop[0], :crop[1]]
-            return dyn, float(ds.dt), float(ds.df)
-
-        return load
+    def load_fn(path):
+        ds = load_psrflux(path, survey=True)
+        dyn = np.asarray(ds.dyn, dtype=np.float32)
+        if crop is not None:
+            dyn = dyn[:crop[0], :crop[1]]
+        return dyn, float(ds.dt), float(ds.df)
 
     def process(payload, tier=None):
         dyn, dt, df = payload
@@ -2227,12 +2241,54 @@ def run_psrflux_survey(dynfiles, workdir, crop=None, alpha=5 / 3,
                                  n_iter=n_iter, backend=backend)
         return {k: float(v[0]) for k, v in out.items()}
 
-    epochs = [(os.path.basename(os.fspath(f)), make_loader(f))
-              for f in dynfiles]
-    return run_survey(epochs, process, workdir, pipeline=pipeline,
-                      prefetch=prefetch, inflight=inflight,
-                      loader_workers=loader_workers,
-                      timeline=timeline, **runner_kw)
+    return load_fn, process
+
+
+def _psrflux_loader(path, load_fn):
+    """Lazy per-file loader (the batch runner's callable-payload
+    shape)."""
+    def load():
+        return load_fn(path)
+
+    return load
+
+
+def serve_psrflux_survey(spool_dir, workdir, crop=None, alpha=5 / 3,
+                         n_iter=100, pattern="*.dynspec",
+                         poll_s=0.2, host="127.0.0.1", port=0,
+                         start=True, **service_kw):
+    """Survey-as-a-service entry (docs/serving.md): watch
+    ``spool_dir`` for arriving psrflux epochs and stream them through
+    the pipelined fit engine for as long as the process lives.
+
+    Each matching file, once COMPLETE (the
+    :class:`~scintools_tpu.serve.SpoolWatcher` admits a file only
+    after its size stops changing — a torn mid-write file is picked
+    up on a later poll), becomes one epoch: parsed in the background
+    prefetch workers, fitted through the fallback ladder
+    (``run_psrflux_survey`` semantics exactly — same ``process``,
+    same quarantine behaviour), published to the append-only
+    ``workdir/results.jsonl`` store, deduped by content hash, and
+    resumed across restarts. The live telemetry listener binds
+    ``host:port`` (``port=0`` = ephemeral; see
+    ``service.http_port``): ``/metrics``, ``/healthz``, ``/readyz``,
+    ``/report``, ``/state``.
+
+    Returns the (started, unless ``start=False``)
+    :class:`~scintools_tpu.serve.SurveyService`; call ``stop()`` for
+    a graceful drain + final RunReport, or just let an orchestrator
+    SIGKILL it — the next start resumes. Remaining ``service_kw``
+    pass to :class:`~scintools_tpu.serve.SurveyService` (``heartbeat``
+    cadence, ``prefetch``/``inflight``/``loader_workers``,
+    ``validate``, ``warmup``)."""
+    from .serve import SpoolWatcher, SurveyService
+
+    load_fn, process = _psrflux_survey_fns(crop, alpha, n_iter)
+    source = SpoolWatcher(spool_dir, pattern=pattern, poll_s=poll_s)
+    service = SurveyService(source, process, workdir,
+                            load_fn=load_fn, http=(host, port),
+                            **service_kw)
+    return service.start() if start else service
 
 
 def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,

@@ -28,8 +28,9 @@ from .heartbeat import Heartbeat, as_heartbeat  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, counter, gauge, histogram,
                       set_enabled)
-from .report import (build_run_report, render_markdown,  # noqa: F401
-                     validate_run_report, write_run_report)
+from .report import (RunReportBuilder, build_run_report,  # noqa: F401
+                     render_markdown, validate_run_report,
+                     write_run_report)
 from .retrace import (RetraceRegression, compile_counts,  # noqa: F401
                       record_build, retrace_guard)
 from .trace import (chrome_trace_events, validate_chrome_trace,  # noqa: F401

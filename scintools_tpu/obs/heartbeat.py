@@ -29,14 +29,26 @@ class Heartbeat:
     ``every_n`` since the last emit OR ``every_s`` wall seconds
     passed, and always when ``force=True`` (the runner forces a final
     beat so every run ends with a fresh snapshot). ``total`` enables
-    the ETA estimate. Returns the emitted record (or None)."""
+    the ETA estimate. Returns the emitted record (or None).
+
+    **Streaming mode** (``streaming=True`` — the serve daemon's
+    mode): an open-ended stream has no meaningful epoch total, so a
+    ``total``-derived ETA would be a bogus countdown to an arbitrary
+    snapshot of the spool. Streaming beats therefore NEVER carry
+    ``total``/``eta_s`` (even if a total was set) and instead report
+    live stream health: throughput (``epochs_per_sec``) plus whatever
+    ``stats_fn`` returns — the daemon supplies backlog depth and the
+    ingest→publish latency percentiles there."""
 
     def __init__(self, every_n=25, every_s=30.0, total=None,
-                 event="survey.heartbeat"):
+                 event="survey.heartbeat", streaming=False,
+                 stats_fn=None):
         self.every_n = max(1, int(every_n))
         self.every_s = float(every_s)
-        self.total = total
+        self.total = None if streaming else total
         self.event = event
+        self.streaming = bool(streaming)
+        self.stats_fn = stats_fn
         self.emitted = 0
         self._t0 = None
         self._last_t = None
@@ -55,6 +67,8 @@ class Heartbeat:
         elapsed = now - self._t0
         eps = done / elapsed if elapsed > 0 and done else None
         rec = {"done": int(done), "elapsed_s": round(elapsed, 3)}
+        if self.streaming:
+            rec["streaming"] = True
         if self.total is not None:
             rec["total"] = int(self.total)
         if eps is not None:
@@ -62,6 +76,8 @@ class Heartbeat:
             if self.total is not None:
                 rec["eta_s"] = round(
                     max(0, self.total - done) / eps, 1)
+        if self.stats_fn is not None:
+            rec.update(self.stats_fn())
         rec.update(stats)
         slog.log_event(self.event, **rec)  # obs-event-ok: survey.heartbeat
         self.emitted += 1
@@ -81,10 +97,11 @@ def as_heartbeat(spec, total=None):
         return Heartbeat(total=total)
     if isinstance(spec, dict):
         kw = dict(spec)
-        kw.setdefault("total", total)
+        if not kw.get("streaming"):
+            kw.setdefault("total", total)
         return Heartbeat(**kw)
     if isinstance(spec, Heartbeat):
-        if spec.total is None:
+        if spec.total is None and not spec.streaming:
             spec.total = total
         return spec
     raise TypeError(f"heartbeat must be None/bool/dict/Heartbeat, "

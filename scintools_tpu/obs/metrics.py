@@ -36,10 +36,36 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 #: default histogram buckets [seconds]: spans the ~0.2 ms journal
 #: fsync through multi-second epoch loads.
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+#: the Prometheus text exposition content type an HTTP scrape
+#: endpoint must answer with (serve/http.py uses it; version 0.0.4 is
+#: the text-format version every Prometheus server speaks).
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: process start (import time of the metrics module — the first
+#: thing any scintools_tpu entry point pulls in), the epoch of the
+#: ``process_uptime_seconds`` gauge.
+_PROCESS_START = time.time()
+
+
+def process_uptime():
+    """Seconds since this process imported the metrics module."""
+    return time.time() - _PROCESS_START
+
+
+def touch_process_metrics(registry=None):
+    """Refresh the process-level gauges (currently
+    ``process_uptime_seconds``) in ``registry`` (default: the
+    process-wide one). Scrape handlers call this immediately before
+    rendering, so the exposition always carries a fresh uptime."""
+    reg = registry if registry is not None else REGISTRY
+    reg.gauge("process_uptime_seconds",
+              help="seconds since process start").set(process_uptime())
 
 
 def _label_key(labels):
@@ -284,13 +310,14 @@ class MetricsRegistry:
         return out
 
     def to_prometheus(self):
-        """Prometheus text exposition format (one ``# HELP``/``# TYPE``
-        header per metric family, histogram ``_bucket``/``_sum``/
-        ``_count`` expansion)."""
+        """Prometheus text exposition format: one ``# HELP`` AND one
+        ``# TYPE`` header per metric family (HELP falls back to the
+        metric name so scrapers that require the pair never see a
+        bare family), histogram ``_bucket``/``_sum``/``_count``
+        expansion. Serve it with :data:`PROMETHEUS_CONTENT_TYPE`."""
         lines = []
         for m in self.metrics():
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {m.help or m.name}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for key, val in m._items():
                 if m.kind in ("counter", "gauge"):

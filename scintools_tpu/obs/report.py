@@ -34,7 +34,15 @@ metrics            dict?    MetricsRegistry.snapshot() or None
 =================  =======  ==================================
 
 Optional extras (``n_batches`` from the batched runner, caller
-``extra`` fields) ride along unvalidated.
+``extra`` fields, the streaming daemon's ``in_progress``/
+``latency``/``backlog``) ride along unvalidated.
+
+The report is **incrementally buildable**: :class:`RunReportBuilder`
+produces schema-valid snapshots of a run that is still in flight —
+the serving daemon (serve/daemon.py) updates one per published epoch
+and its ``/report`` endpoint serves the current snapshot, so the
+report is a live surface rather than a write-at-exit artifact (the
+batch runners keep calling :func:`build_run_report` once at return).
 """
 
 from __future__ import annotations
@@ -113,6 +121,53 @@ def build_run_report(summary, outcomes=(), wall_s=0.0, timeline=None,
     if extra:
         rep.update(extra)
     return rep
+
+
+class RunReportBuilder:
+    """Mid-run RunReport snapshots for a long-lived service.
+
+    ``build_run_report`` needs the run's final wall seconds, which a
+    still-running daemon does not have; the builder carries the run's
+    start instant instead and stamps each snapshot with the elapsed
+    wall time so far, plus an ``in_progress`` marker and any live
+    ``extra`` fields (backlog, latency percentiles). Every snapshot
+    passes :func:`validate_run_report` — a scraper polling
+    ``/report`` sees the same schema the end-of-run artifact has.
+
+    >>> builder = RunReportBuilder(runner="serve_survey")
+    >>> rep = builder.snapshot(rec.tally, rec.outcomes,
+    ...                        extra={"backlog": 3})
+    >>> builder.finalize(workdir, rec.tally, rec.outcomes)
+    """
+
+    def __init__(self, runner="serve_survey", extra=None):
+        self.runner = str(runner)
+        self.extra = dict(extra or {})
+        self._t0 = time.perf_counter()
+
+    def wall_s(self):
+        return time.perf_counter() - self._t0
+
+    def snapshot(self, summary, outcomes=(), timeline=None,
+                 extra=None, in_progress=True):
+        """A schema-valid report of the run SO FAR (validated before
+        it is returned — a malformed snapshot must fail here, not in
+        the scraper)."""
+        merged = {**self.extra, **(extra or {}),
+                  "in_progress": bool(in_progress)}
+        return validate_run_report(build_run_report(
+            summary, outcomes, wall_s=self.wall_s(),
+            timeline=timeline, runner=self.runner, extra=merged))
+
+    def finalize(self, workdir, summary, outcomes=(), timeline=None,
+                 extra=None, name="run_report"):
+        """Write the closing snapshot (``in_progress: false``) as the
+        usual ``run_report.json``/``.md`` pair; returns the JSON
+        path."""
+        return write_run_report(
+            workdir, self.snapshot(summary, outcomes,
+                                   timeline=timeline, extra=extra,
+                                   in_progress=False), name=name)
 
 
 def validate_run_report(report):

@@ -104,16 +104,15 @@ class EpochJournal:
             help="journal fsync barriers taken",
         ).inc()
 
-    def records(self):
-        """``{epoch_id: record}`` for every intact journaled line.
-        Corrupt/torn lines are skipped with a warning; a missing file
-        is an empty journal."""
-        out = {}
+    def _scan(self):
+        """Yield ``(raw_line, record)`` for every intact journaled
+        line in append order; corrupt/torn lines are skipped with a
+        warning, a missing file is an empty journal."""
         if not os.path.exists(self.path):
-            return out
+            return
         with open(self.path) as fh:
-            for i, line in enumerate(fh):
-                line = line.strip()
+            for i, raw in enumerate(fh):
+                line = raw.strip()
                 if not line:
                     continue
                 try:
@@ -124,10 +123,23 @@ class EpochJournal:
                 except (ValueError, KeyError, TypeError) as e:
                     warnings.warn(
                         f"journal {self.path}: skipping corrupt line "
-                        f"{i + 1} ({e})", stacklevel=2)
+                        f"{i + 1} ({e})", stacklevel=3)
                     continue
-                out[rec["epoch"]] = rec
-        return out
+                yield line, rec
+
+    def records(self):
+        """``{epoch_id: record}`` for every intact journaled line
+        (see :meth:`_scan` for the corrupt-line tolerance)."""
+        return {rec["epoch"]: rec for _, rec in self._scan()}
+
+    def valid_lines(self):
+        """The intact raw journal lines (sans newline) in append
+        order — the ATOMIC read view of the journal-as-results-store
+        (serve/store.py): a reader sees only complete, CRC-verified
+        records, never a torn tail a concurrent writer (or a SIGKILL)
+        left behind. Two stores are byte-consistent when their
+        valid_lines match."""
+        return [line for line, _ in self._scan()]
 
     def __contains__(self, epoch):
         return epoch in self.records()

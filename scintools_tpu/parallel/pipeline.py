@@ -88,8 +88,14 @@ class PrefetchLoader:
       unaffected. The runner turns it into the epoch's quarantine
       record (MalformedInputError semantics).
 
-    Use as an iterator or a context manager; ``close()`` cancels
-    outstanding loads (best effort) and joins the workers.
+    ``epochs`` is consumed LAZILY (one item pulled per free buffer
+    slot), so an unbounded/blocking generator — the streaming
+    daemon's spool feed (serve/daemon.py) — works: the feeder thread
+    simply blocks inside the generator until the next epoch arrives.
+    Use as an iterator (batch runs) or via :meth:`poll` (streaming:
+    bounded-latency consumption that never blocks past a deadline);
+    ``close()`` cancels outstanding loads (best effort) and joins the
+    workers.
     """
 
     _SENTINEL = object()
@@ -101,7 +107,7 @@ class PrefetchLoader:
         self._load_fn = load_fn
         self._timeline = timeline
         self._stage = stage
-        self._epochs = iter(list(epochs))
+        self._epochs = iter(epochs)
         # task queue carries (epoch_id, raw_payload, slot) — slot is a
         # one-item queue the feeder inserted into the ordered deque, so
         # results come back in submission order regardless of which
@@ -164,6 +170,15 @@ class PrefetchLoader:
             slot.put(out)
 
     # ---- consumer side ----------------------------------------------
+    def _take_head(self, head):
+        """Pop the completed head slot and free its buffer slot."""
+        self._order.popleft()
+        self._slots.release()
+        _metrics.gauge(
+            "survey_prefetch_queue_depth",
+            help="epochs loaded-or-loading ahead of the consumer",
+        ).set(self.buffered())
+
     def __iter__(self):
         while True:
             while not self._order:
@@ -174,13 +189,41 @@ class PrefetchLoader:
             if head is self._SENTINEL:
                 return
             item = head.get()          # blocks until ITS load is done
-            self._order.popleft()
-            self._slots.release()      # free the buffer slot
-            _metrics.gauge(
-                "survey_prefetch_queue_depth",
-                help="epochs loaded-or-loading ahead of the consumer",
-            ).set(self.buffered())
+            self._take_head(head)
             yield item.epoch, item
+
+    def poll(self, timeout=0.0):
+        """Next ``(epoch_id, LoadedEpoch)`` if one completes within
+        ``timeout`` seconds, else None. Unlike iteration this never
+        blocks past the deadline — the streaming daemon
+        (serve/daemon.py) uses it to keep draining its dispatch-ahead
+        window (bounded ingest→publish latency) while the spool is
+        idle. Returns None indefinitely once the input stream is
+        exhausted (:attr:`exhausted` distinguishes end-of-stream from
+        not-ready) or after :meth:`close`."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        while True:
+            if self._order:
+                head = self._order[0]
+                if head is self._SENTINEL:
+                    return None
+                try:
+                    item = head.get(timeout=max(
+                        0.0, deadline - time.monotonic()))
+                except queue.Empty:
+                    return None
+                self._take_head(head)
+                return item.epoch, item
+            if self._closed.is_set() \
+                    or time.monotonic() >= deadline:
+                return None
+            time.sleep(0.001)
+
+    @property
+    def exhausted(self):
+        """True once every input epoch has been consumed (the feeder
+        reached end-of-stream and the consumer drained the buffer)."""
+        return bool(self._order) and self._order[0] is self._SENTINEL
 
     def buffered(self):
         """Epochs currently loaded-or-loading ahead of the consumer

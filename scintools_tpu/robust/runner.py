@@ -44,6 +44,16 @@ alongside when the loop also carries large array state; the journal
 covers the per-epoch scalar results and progress cursor. Pass a
 :class:`~scintools_tpu.utils.profiling.StageTimeline` as ``timeline``
 to account load/dispatch/fence/journal overlap per epoch.
+
+The per-epoch engine here (``_Recorder`` bookkeeping,
+``_dispatch_first`` dispatch-ahead, ``_consume_deferred`` fencing,
+``_run_one`` ladder dispatch, ``_loader_outcome`` quarantine,
+``_trace_id``) is shared with the STREAMING daemon
+(serve/daemon.py): the batch entries below own the
+"full epoch list up front" loop shape, the daemon drives the same
+pieces incrementally off a spool watcher — so quarantine semantics,
+journal line bytes, and resume behaviour are identical across the
+batch and serving tiers.
 """
 
 from __future__ import annotations

@@ -1,0 +1,31 @@
+"""Survey-as-a-service: streaming ingest daemon + live telemetry
+(ISSUE 6 tentpole; ROADMAP item 2).
+
+The batch stack (robust/runner.py + parallel/pipeline.py) wants the
+full epoch list up front and reports at exit. This package turns the
+same engine into a deployable long-lived process:
+
+- :mod:`~scintools_tpu.serve.watch` — epoch sources: a torn-file-safe
+  polling :class:`SpoolWatcher` over a spool directory, and an
+  in-process :class:`QueueSource` for tests/embedding;
+- :mod:`~scintools_tpu.serve.daemon` — :class:`SurveyService`, the
+  streaming ingest loop: bounded-latency PrefetchLoader →
+  dispatch-ahead processing, content-hash dedupe, per-epoch
+  ingest→dispatch→fence→publish latency accounting;
+- :mod:`~scintools_tpu.serve.store` — :class:`ResultsStore`, the
+  append-only atomically-readable results store on the PR-2
+  CRC-JSONL journal (SIGKILL + restart resumes with no duplicate
+  publishes);
+- :mod:`~scintools_tpu.serve.http` — :class:`TelemetryServer`, the
+  stdlib HTTP listener serving ``/metrics`` (Prometheus), ``/healthz``
+  / ``/readyz`` probes, the live ``/report`` RunReport snapshot, and
+  per-epoch ``/state``.
+
+``dynspec.serve_psrflux_survey`` is the psrflux-file entry point;
+docs/serving.md is the operator walkthrough.
+"""
+
+from .daemon import SurveyService  # noqa: F401
+from .http import TelemetryServer  # noqa: F401
+from .store import ResultsStore, content_hash  # noqa: F401
+from .watch import ArrivedEpoch, QueueSource, SpoolWatcher  # noqa: F401

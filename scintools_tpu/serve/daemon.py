@@ -1,0 +1,520 @@
+"""Long-lived streaming survey daemon (ISSUE 6 tentpole).
+
+``run_survey`` is batch-shaped: the full epoch list up front, one
+report at exit. The serving tier the roadmap asks for is a PROCESS —
+it watches a spool (or an in-process queue) for arriving epochs,
+feeds them incrementally through the same PrefetchLoader →
+dispatch-ahead pipeline the batch runner uses
+(parallel/pipeline.py + robust/runner.py's shared per-epoch engine),
+publishes each result to an append-only, atomically-readable results
+store (serve/store.py, the PR-2 CRC-JSONL journal), and exposes its
+observability surface LIVE over HTTP (serve/http.py) instead of
+write-at-exit. The real-time GPU pulsar pipelines this repo models on
+(Dimoudi et al. arXiv:1711.10855; Adámek et al. arXiv:1804.05335)
+are judged on sustained streaming latency under load; this daemon is
+what lets the process measure and publish that latency while it is
+happening.
+
+Guarantees, all pinned by tests/test_serve.py:
+
+- **bounded ingest→publish latency** — the loop never parks behind
+  the stream: an idle poll tick drains the dispatch-ahead window, so
+  a lull in arrivals fences and publishes everything in flight
+  instead of waiting for the window to fill;
+- **per-epoch end-to-end latency accounting** — every epoch carries
+  an ``ingest → dispatch → fence → publish`` span chain through the
+  shared trace-ID machinery (obs/trace.py tracks), an
+  ``serve_e2e_latency_seconds`` histogram, and p50/p95 percentiles
+  in heartbeats and the live RunReport;
+- **crash = restart** — results are journaled exactly like a PR-2
+  batch run: a SIGKILL loses at most the un-fsynced tail, a
+  restarted daemon re-admits the spool, takes journaled epochs
+  verbatim (nothing published twice), and converges to a
+  byte-consistent results store;
+- **stream fault-hardening** — torn files wait for completion
+  (SpoolWatcher settle logic), duplicates are dropped by content
+  hash (counted in ``serve_duplicates_total``), malformed files
+  quarantine through the fallback ladder, out-of-order arrival is
+  just arrival order.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..obs import heartbeat as _hb
+from ..obs import metrics as _metrics
+from ..obs import report as _report
+from ..parallel.pipeline import AsyncJournalWriter, PrefetchLoader
+from ..robust import runner as _runner
+from ..robust.runner import EpochOutcome
+from ..utils import slog
+from ..utils.profiling import StageTimeline
+from .store import ResultsStore
+
+_STOP = object()
+
+#: e2e latency buckets [seconds]: a streaming epoch should publish
+#: within tens of ms (in-process) to seconds (real fits + spool I/O).
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 15.0, 60.0)
+
+
+class _ServeRecorder(_runner._Recorder):
+    """The runner's recorder with a content-hash column: every
+    journal line the daemon publishes carries the epoch's ``sha``
+    field, so the store's dedupe index survives restart."""
+
+    def __init__(self, journal, writer, tiers, heartbeat=None):
+        super().__init__(journal, writer, tiers, heartbeat=heartbeat)
+        self._sha = {}
+
+    def set_sha(self, key, sha):
+        if sha:
+            self._sha[str(key)] = sha
+
+    def _append(self, key, **fields):
+        sha = self._sha.pop(str(key), None)
+        if sha:
+            fields["sha"] = sha
+        super()._append(key, **fields)
+
+
+class SurveyService:
+    """The streaming survey daemon.
+
+    ``source`` is an epoch source (serve/watch.py:
+    :class:`SpoolWatcher` / :class:`QueueSource`); ``process(payload,
+    tier=...)`` is the per-epoch worker exactly as in
+    :func:`~scintools_tpu.robust.runner.run_survey` (tiered fallback,
+    deferred device values, validator hook all behave identically —
+    the daemon drives the runner's own engine); ``load_fn`` maps the
+    arrived payload (a spool path) to the process payload in the
+    background prefetch workers. Results journal to
+    ``workdir/results.jsonl``; rerunning the same workdir resumes.
+
+    Lifecycle: ``start()`` launches the ingest loop (and the
+    telemetry HTTP listener when ``http`` is not False —
+    ``http=(host, port)``, port 0 = ephemeral, see
+    :attr:`http_port`); ``stop()`` finishes everything admitted,
+    drains the journal writer (durability barrier), writes the final
+    RunReport, and shuts the listener. Use as a context manager for
+    the same pair.
+    """
+
+    def __init__(self, source, process, workdir,
+                 tiers=_runner._DEFAULT_TIERS, retries=1,
+                 validate=None, defer_validate=False, load_fn=None,
+                 prefetch=4, inflight=2, loader_workers=2,
+                 journal_name="results.jsonl", http=("127.0.0.1", 0),
+                 heartbeat=True, warmup=None, stale_after_s=5.0,
+                 report=True):
+        self.source = source
+        self.process = process
+        self.workdir = os.fspath(workdir)
+        self.tiers = tuple(tiers)
+        self.retries = retries
+        self.validate = validate
+        self.load_fn = load_fn
+        self.prefetch = max(1, int(prefetch))
+        self.inflight = max(1, int(inflight))
+        if validate is not None and not defer_validate:
+            self.inflight = 0        # runner semantics: fence per epoch
+        self.loader_workers = max(1, int(loader_workers))
+        self.stale_after_s = float(stale_after_s)
+        self.report = bool(report)
+        self._warmup_fn = warmup
+
+        os.makedirs(self.workdir, exist_ok=True)
+        self.store = ResultsStore(self.workdir, name=journal_name)
+        self._done_records = self.store.records()
+        self.timeline = StageTimeline(device_stage="dispatch")
+        self._writer = AsyncJournalWriter(self.store.journal,
+                                          timeline=self.timeline)
+        self._rec = _ServeRecorder(
+            self.store.journal, self._writer, self.tiers,
+            heartbeat=self._make_heartbeat(heartbeat))
+        self._builder = _report.RunReportBuilder(runner="serve_survey")
+
+        self._lock = threading.Lock()
+        self._inflight_sha = {}
+        self._states = collections.OrderedDict()
+        self._lat = collections.deque(maxlen=4096)
+        self._window = collections.deque()
+        self._fresh_q = queue.Queue()
+        self._index = 0
+        self._warm = False
+        self._stopping = threading.Event()
+        self._done = threading.Event()
+        self._stop_sent = False
+        self._last_tick = time.time()
+        self._error = None
+
+        self._loader = PrefetchLoader(
+            self._fresh_stream(), depth=self.prefetch,
+            workers=self.loader_workers, load_fn=self.load_fn,
+            timeline=self.timeline)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-loop")
+        self._http = None
+        if http:
+            from .http import TelemetryServer
+
+            host, port = http if isinstance(http, (tuple, list)) \
+                else ("127.0.0.1", int(http) if http is not True else 0)
+            self._http = TelemetryServer(self, host=host, port=port)
+
+    # ---- lifecycle --------------------------------------------------
+    def start(self):
+        if self._http is not None:
+            self._http.start()
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=60.0):
+        """Graceful shutdown: finish every admitted epoch, drain the
+        journal writer, write the final RunReport, stop the HTTP
+        listener. Idempotent."""
+        self._stopping.set()
+        if hasattr(self.source, "close"):
+            self.source.close()
+        if self._thread.is_alive() or not self._done.is_set():
+            if self._thread.ident is not None:
+                self._thread.join(timeout=timeout)
+        self._loader.close()
+        if self._http is not None:
+            self._http.close()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("serve loop failed") from err
+        return self
+
+    close = stop
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def wait_idle(self, timeout=30.0, settle_s=0.05):
+        """Block until nothing is queued, loading, or in flight (the
+        test-friendly quiesce point; the stream may deliver more
+        later). Returns True when idle was reached."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.backlog() == 0 and not self._window:
+                time.sleep(settle_s)
+                if self.backlog() == 0 and not self._window:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    # ---- ingest loop ------------------------------------------------
+    def _fresh_stream(self):
+        """The lazy (epoch_id, payload) stream feeding the prefetch
+        loader; ends when the stop sentinel arrives."""
+        while True:
+            item = self._fresh_q.get()
+            if item is _STOP:
+                return
+            yield item
+
+    def _make_heartbeat(self, spec):
+        if spec is None or spec is False:
+            return None
+        kw = {"streaming": True, "event": "serve.heartbeat",
+              "stats_fn": self._live_stats}
+        if isinstance(spec, dict):
+            kw.update(spec)
+        elif isinstance(spec, _hb.Heartbeat):
+            return spec
+        elif spec is not True:
+            raise TypeError(
+                f"heartbeat must be None/bool/dict/Heartbeat, got "
+                f"{type(spec).__name__}")
+        return _hb.Heartbeat(**kw)
+
+    def _loop(self):
+        try:
+            with slog.span("serve.run", workdir=self.workdir):
+                self._warmup()
+                while True:
+                    self._tick()
+                    stopping = self._stopping.is_set()
+                    if not stopping:
+                        self._pull_arrivals()
+                    elif not self._stop_sent:
+                        self._fresh_q.put(_STOP)
+                        self._stop_sent = True
+                    got = self._loader.poll(
+                        timeout=0.02 if self._window else 0.05)
+                    if got is not None:
+                        self._dispatch(*got)
+                    while len(self._window) > self.inflight:
+                        self._consume_one()
+                    if got is None and self._window:
+                        # idle stream → flush the window now: bounded
+                        # ingest→publish latency beats dispatch-ahead
+                        self._consume_one()
+                    self._update_gauges()
+                    if stopping and self._stop_sent \
+                            and self._loader.exhausted \
+                            and not self._window:
+                        break
+            self._writer.close()       # durability barrier (PR-2)
+            self._rec.beat(force=True)
+            if self.report:
+                self._builder.finalize(
+                    self.workdir, dict(self._rec.tally),
+                    list(self._rec.outcomes),
+                    timeline=self.timeline.summary(),
+                    extra=self._live_stats())
+        except Exception as e:  # noqa: BLE001 — the loop must die
+            # loudly: surfaced by /healthz (loop no longer ticking),
+            # re-raised from stop()
+            self._error = e
+            slog.log_failure("serve.loop_error", stage="loop", error=e)
+        finally:
+            self._done.set()
+
+    def _warmup(self):
+        """Optional device-program warm-up: run ``warmup()`` (e.g. a
+        synthetic epoch through ``process``) before serving so
+        ``/readyz`` can go ready ahead of the first real epoch; a
+        warm-up failure is logged, not fatal — the first real epoch
+        warms instead."""
+        if self._warmup_fn is None:
+            return
+        try:
+            self._warmup_fn()
+            self._warm = True
+        except Exception as e:  # noqa: BLE001 — warm-up is advisory
+            slog.log_failure("serve.warmup_error", stage="warmup",
+                             error=e)
+
+    def _tick(self):
+        self._last_tick = time.time()
+
+    def _pull_arrivals(self):
+        while self._fresh_q.qsize() < max(2, self.prefetch):
+            item = self.source.get(timeout=0.0)
+            if item is None:
+                return
+            self._admit(item)
+
+    def _admit(self, item):
+        key = str(item.epoch)
+        with self._lock:
+            if key in self._states:
+                return                       # already seen this run
+            self._index += 1
+            self.timeline.assign_trace(
+                key, _runner._trace_id(self._index - 1, key))
+            now = time.perf_counter()
+            self.timeline.record(key, "ingest", item.t_arrive, now)
+            if key in self._done_records:
+                self._rec.tally["n_epochs"] += 1
+                out = self._rec.resumed(key, self._done_records[key])
+                self._states[key] = {
+                    "status": "resumed",
+                    "result_status": self._done_records[key].get(
+                        "status", "ok"),
+                    "tier": out.tier}
+                return
+            # dedupe against published content AND epochs still in
+            # flight (two copies arriving back-to-back must not both
+            # process just because neither has published yet)
+            dup_of = self.store.known_content(item.sha) \
+                or (item.sha and self._inflight_sha.get(item.sha))
+            if dup_of is not None:
+                _metrics.counter(
+                    "serve_duplicates_total",
+                    help="stream epochs dropped as content "
+                         "duplicates").inc()
+                slog.log_event("serve.duplicate", epoch=key,
+                               duplicate_of=dup_of)
+                self._states[key] = {"status": "duplicate",
+                                     "duplicate_of": dup_of}
+                return
+            _metrics.counter(
+                "serve_epochs_ingested_total",
+                help="fresh epochs admitted into the pipeline").inc()
+            self._rec.tally["n_epochs"] += 1
+            self._rec.set_sha(key, item.sha)
+            if item.sha:
+                self._inflight_sha[item.sha] = key
+            self._states[key] = {"status": "queued",
+                                 "t_ingest": item.t_arrive,
+                                 "sha": item.sha}
+        self._fresh_q.put((key, item.payload))
+
+    def _dispatch(self, eid, loaded):
+        key = str(eid)
+        with self._lock:
+            st = self._states.get(key, {})
+            st["status"] = "in_flight"
+        if not loaded.ok:
+            self._window.append(
+                (key, None,
+                 _runner._loader_outcome(key, loaded.error), None))
+            return
+        with self.timeline.span(key, "dispatch"):
+            entry = _runner._dispatch_first(
+                key, loaded.payload, self.process, self.tiers,
+                self.retries, self.validate)
+        self._window.append(entry)
+
+    def _consume_one(self):
+        epoch_id, payload, value, report = self._window.popleft()
+        if isinstance(value, EpochOutcome):    # already decided
+            out = value
+        else:
+            with self.timeline.span(epoch_id, "fence"):
+                out = _runner._consume_deferred(
+                    epoch_id, payload, value, report, self.process,
+                    self.tiers, self.retries, self.validate)
+        self._publish(out)
+
+    def _publish(self, out):
+        key = str(out.epoch)
+        t0 = time.perf_counter()
+        with self._lock:
+            self._rec.record(out)
+            st = self._states.setdefault(key, {})
+            st["status"] = out.status
+            st["tier"] = out.tier
+            if out.status == "quarantined":
+                st["error_class"] = out.error_class
+            t_pub = time.perf_counter()
+            t_in = st.get("t_ingest")
+            if t_in is not None:
+                lat = t_pub - t_in
+                st["latency_s"] = round(lat, 6)
+                self._lat.append(lat)
+                _metrics.histogram(
+                    "serve_e2e_latency_seconds",
+                    help="ingest-to-published end-to-end latency",
+                    buckets=LATENCY_BUCKETS).observe(lat)
+            self.store.note_published(key, st.get("sha"))
+            self._inflight_sha.pop(st.get("sha"), None)
+        self.timeline.record(key, "publish", t0, time.perf_counter())
+        if out.status == "ok":
+            self._warm = True
+
+    def _update_gauges(self):
+        _metrics.gauge(
+            "serve_backlog_depth",
+            help="epochs arrived but not yet published",
+        ).set(self.backlog())
+
+    # ---- live surfaces (HTTP handlers + heartbeat) ------------------
+    def backlog(self):
+        """Epochs arrived but not yet published: source queue +
+        admitted-but-unloaded + loaded-or-loading + dispatch window."""
+        n = self._fresh_q.qsize() + len(self._window) \
+            + self._loader.buffered()
+        if hasattr(self.source, "backlog"):
+            n += self.source.backlog()
+        return n
+
+    def latency_percentiles(self):
+        """``{"p50_s":, "p95_s":, "n":}`` over the recent
+        ingest→published latencies (None values until the first
+        publish)."""
+        lat = list(self._lat)
+        if not lat:
+            return {"p50_s": None, "p95_s": None, "n": 0}
+        return {"p50_s": round(float(np.percentile(lat, 50)), 6),
+                "p95_s": round(float(np.percentile(lat, 95)), 6),
+                "n": len(lat)}
+
+    def _live_stats(self):
+        stats = {"backlog": self.backlog()}
+        pct = self.latency_percentiles()
+        if pct["n"]:
+            stats["latency_p50_s"] = pct["p50_s"]
+            stats["latency_p95_s"] = pct["p95_s"]
+        return stats
+
+    def healthy(self):
+        """Liveness: the ingest loop is running and recently ticked,
+        and the source's own poll loop (when it has one) is alive.
+        The ``/healthz`` answer."""
+        detail = {
+            "loop_alive": self._thread.is_alive(),
+            "loop_staleness_s": round(time.time() - self._last_tick,
+                                      3),
+            "source_alive": bool(getattr(self.source, "alive",
+                                         lambda: True)()),
+        }
+        if hasattr(self.source, "last_activity"):
+            detail["source_staleness_s"] = round(
+                time.time() - self.source.last_activity(), 3)
+        ok = (detail["loop_alive"] and detail["source_alive"]
+              and detail["loop_staleness_s"] < self.stale_after_s
+              and detail.get("source_staleness_s",
+                             0.0) < self.stale_after_s)
+        detail["ok"] = bool(ok)
+        return detail
+
+    def ready(self):
+        """Readiness: healthy AND the device program is warm (an
+        explicit warm-up ran, or at least one epoch published ok) —
+        an autoscaler must not route work at a process that would
+        stall its first request on a compile. The ``/readyz``
+        answer."""
+        h = self.healthy()
+        detail = {"healthy": h["ok"], "warm": self._warm,
+                  "stopping": self._stopping.is_set()}
+        detail["ok"] = bool(h["ok"] and self._warm
+                            and not detail["stopping"])
+        return detail
+
+    def report_snapshot(self):
+        """The CURRENT RunReport — schema-valid mid-run (the
+        ``/report`` answer)."""
+        with self._lock:
+            tally = dict(self._rec.tally)
+            tally["tier_counts"] = dict(tally.get("tier_counts", {}))
+            outcomes = list(self._rec.outcomes)
+        return self._builder.snapshot(
+            tally, outcomes, timeline=self.timeline.summary(),
+            extra={**self._live_stats(),
+                   "latency": self.latency_percentiles()},
+            in_progress=not self._done.is_set())
+
+    def state_snapshot(self):
+        """Per-epoch status map (the ``/state`` answer):
+        queued / in_flight / ok / quarantined / resumed /
+        duplicate."""
+        with self._lock:
+            epochs = {k: dict(v) for k, v in self._states.items()}
+        counts = {}
+        for st in epochs.values():
+            counts[st["status"]] = counts.get(st["status"], 0) + 1
+        return {"epochs": epochs, "counts": counts,
+                "backlog": self.backlog(),
+                "latency": self.latency_percentiles()}
+
+    def results(self):
+        """Published results via the store's atomic read API."""
+        return self.store.records()
+
+    def export_trace(self, path):
+        """Write the run-so-far stage spans (ingest/load/dispatch/
+        fence/journal/publish tracks, per-epoch trace IDs) as
+        Chrome-trace JSON."""
+        return self.timeline.export_trace(path)
+
+    @property
+    def http_port(self):
+        """Bound telemetry port (None when HTTP is disabled)."""
+        return None if self._http is None else self._http.port

@@ -1,0 +1,143 @@
+"""Live telemetry HTTP listener for the streaming daemon.
+
+The PR-5 observability surfaces were in-process (metrics registry)
+or write-at-exit (run_report.json, trace files). A deployable
+service is scraped and probed from OUTSIDE while it runs; this
+module is that edge — a stdlib :class:`ThreadingHTTPServer` (no new
+dependencies) serving:
+
+==========  =====================================================
+path        answer
+==========  =====================================================
+/metrics    Prometheus text exposition of the process registry
+            (``Content-Type: text/plain; version=0.0.4`` — what a
+            Prometheus scraper requires), uptime gauge refreshed
+            per scrape
+/healthz    liveness — 200 when the ingest loop and the spool
+            watcher are alive and recently ticking, 503 otherwise
+            (an autoscaler restarts on sustained 503)
+/readyz     readiness — 200 only when additionally the device
+            program is WARM (a compile-stall on the first routed
+            epoch is not "ready") and the daemon is not stopping
+/report     the live RunReport snapshot (schema v1, identical to
+            the end-of-run ``run_report.json``, plus
+            ``in_progress``/latency/backlog extras)
+/state      per-epoch status map: queued / in_flight / ok /
+            quarantined / resumed / duplicate, with latency and
+            backlog
+==========  =====================================================
+
+Handler threads only READ daemon state through the snapshot methods
+(every one takes the daemon's lock or tolerates racy scalar reads)
+and never touch in-flight device values — no host syncs, no stalls
+on the pipeline (the bench's scrape-under-load config pins the
+overhead).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..obs import metrics as _metrics
+from ..utils import slog
+
+
+class TelemetryServer:
+    """Owns the listener socket (bound at construction, so an
+    ephemeral ``port=0`` is known before the daemon starts) and the
+    serving thread. ``start()``/``close()`` are idempotent."""
+
+    def __init__(self, service, host="127.0.0.1", port=0):
+        self.service = service
+        handler = _make_handler(service)
+        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            kwargs={"poll_interval": 0.1}, name="serve-http")
+        self._started = False
+
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+            slog.log_event("serve.http", state="started",
+                           host=self.host, port=self.port)
+        return self
+
+    def close(self):
+        if self._started:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10)
+            slog.log_event("serve.http", state="stopped",
+                           port=self.port)
+        self._httpd.server_close()
+        self._started = False
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+
+def _make_handler(service):
+    """A request-handler class bound to one daemon instance."""
+
+    class Handler(BaseHTTPRequestHandler):
+        # access logs belong in metrics, not stderr noise
+        def log_message(self, fmt, *args):
+            return
+
+        def _send(self, code, body, content_type="application/json"):
+            data = body if isinstance(body, bytes) else body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_json(self, code, obj):
+            self._send(code, json.dumps(obj, indent=1))
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            _metrics.counter(
+                "serve_http_requests_total",
+                help="telemetry requests served",
+            ).labels(path=path).inc()
+            try:
+                if path == "/metrics":
+                    _metrics.touch_process_metrics()
+                    self._send(200, _metrics.REGISTRY.to_prometheus(),
+                               _metrics.PROMETHEUS_CONTENT_TYPE)
+                elif path == "/healthz":
+                    detail = service.healthy()
+                    self._send_json(200 if detail["ok"] else 503,
+                                    detail)
+                elif path == "/readyz":
+                    detail = service.ready()
+                    self._send_json(200 if detail["ok"] else 503,
+                                    detail)
+                elif path == "/report":
+                    self._send_json(200, service.report_snapshot())
+                elif path == "/state":
+                    self._send_json(200, service.state_snapshot())
+                else:
+                    self._send_json(404, {
+                        "error": f"unknown path {path!r}",
+                        "paths": ["/metrics", "/healthz", "/readyz",
+                                  "/report", "/state"]})
+            except Exception as e:  # noqa: BLE001 — a handler crash
+                # must answer 500 and never take the serving thread
+                # (or the daemon) down with it
+                slog.log_failure("serve.http_error", stage=path,
+                                 error=e)
+                try:
+                    self._send_json(500, {"error": repr(e)[:300]})
+                except OSError:
+                    pass  # broad-except-ok: client hung up mid-error
+
+    return Handler

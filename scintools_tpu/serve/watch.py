@@ -1,0 +1,217 @@
+"""Epoch sources for the streaming daemon: spool directory + queue.
+
+A long-lived survey service does not get its epoch list up front —
+epochs ARRIVE: a telescope backend (or an rsync from one) drops
+psrflux/FITS files into a spool directory, a test pushes payloads
+into an in-process queue. Both are the same small interface the
+daemon (serve/daemon.py) consumes:
+
+- ``get(timeout)`` → the next :class:`ArrivedEpoch` or None (nothing
+  arrived within the deadline — the daemon uses the idle tick to
+  drain its dispatch window, so ingest→publish latency stays bounded
+  while the spool is quiet);
+- ``backlog()`` → epochs arrived but not yet taken;
+- ``alive()`` / ``last_activity()`` → liveness wiring for the
+  ``/healthz`` probe;
+- ``close()`` → stop producing.
+
+:class:`SpoolWatcher` hardens the filesystem edge against the stream
+fault classes (robust/faults.py injects them in tests):
+
+- **torn files** — a file still being written (size changing between
+  polls, or empty) is NOT admitted; it is picked up on a later poll
+  once its size has been stable for ``settle_polls`` consecutive
+  polls. Writers that rename-into-place (io/psrflux.py's atomic
+  ``write_psrflux``) are admitted on first sight of the rename.
+- **duplicates** — every admitted file is content-hashed (sha256 of
+  the file bytes); the daemon checks the hash against the results
+  store and drops epochs whose content was already published under
+  another name.
+- **out-of-order arrival** — each poll admits newly stable files in
+  sorted-name order, but across polls the stream order is arrival
+  order; the daemon journals in completion order and resumes by
+  epoch key, so ordering is a throughput concern, not a correctness
+  one.
+- **malformed files** — admitted as-is; parsing happens in the
+  pipeline's loader, whose MalformedInputError quarantines the epoch
+  (robust/runner.py semantics) without stalling the stream.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils import slog
+from .store import content_hash
+
+
+@dataclass
+class ArrivedEpoch:
+    """One arrival out of a source: ``epoch`` is the stable key (file
+    basename / caller-chosen id), ``payload`` what the pipeline
+    loader receives (a path for the spool, anything for the queue),
+    ``sha`` the content hash when the source could compute one, and
+    ``t_arrive`` the perf-counter instant the source admitted it (the
+    start of the epoch's ingest→publish latency span)."""
+
+    epoch: str
+    payload: object
+    sha: str = None
+    t_arrive: float = field(default_factory=time.perf_counter)
+
+
+class QueueSource:
+    """In-process epoch source for tests and embedded use: ``put``
+    epochs from any thread, the daemon ``get``s them. ``sha`` is
+    optional (content dedupe only happens when the producer supplies
+    one or ``hash_payloads=True`` hashes the payload repr)."""
+
+    def __init__(self, hash_payloads=False):
+        self._q = queue.Queue()
+        self._hash = bool(hash_payloads)
+        self._closed = threading.Event()
+        self._last = time.time()
+
+    def put(self, epoch, payload, sha=None):
+        if sha is None and self._hash:
+            sha = content_hash(payload)
+        self._q.put(ArrivedEpoch(str(epoch), payload, sha=sha))
+
+    def get(self, timeout=None):
+        try:
+            item = self._q.get(timeout=timeout) if timeout \
+                else self._q.get_nowait()
+        except queue.Empty:
+            return None
+        self._last = time.time()
+        return item
+
+    def backlog(self):
+        return self._q.qsize()
+
+    def alive(self):
+        return not self._closed.is_set()
+
+    def last_activity(self):
+        return self._last
+
+    def close(self):
+        self._closed.set()
+
+
+class SpoolWatcher:
+    """Polling spool-directory source.
+
+    A background thread scans ``spool_dir`` for files matching
+    ``pattern`` every ``poll_s`` seconds. A file is ADMITTED — content
+    hashed, wrapped in an :class:`ArrivedEpoch`, queued for the
+    daemon — once its size is positive and unchanged for
+    ``settle_polls`` consecutive polls (the torn-file guard: a writer
+    mid-stream keeps moving the size, so the file is only picked up
+    complete). Each file is admitted at most once per process; a
+    restarted daemon re-admits everything and relies on the results
+    store to skip what was already published (resume) or already seen
+    under another name (content dedupe).
+    """
+
+    def __init__(self, spool_dir, pattern="*.dynspec", poll_s=0.2,
+                 settle_polls=1, start=True):
+        self.spool_dir = os.fspath(spool_dir)
+        self.pattern = pattern
+        self.poll_s = max(0.01, float(poll_s))
+        self.settle_polls = max(1, int(settle_polls))
+        self._q = queue.Queue()
+        self._seen = {}          # name -> (size, stable_polls)
+        self._admitted = set()
+        self._closed = threading.Event()
+        self._last_poll = time.time()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="spool-watcher")
+        if start:
+            self._thread.start()
+
+    # ---- background poll loop ---------------------------------------
+    def _run(self):
+        while not self._closed.is_set():
+            try:
+                self._poll_once()
+            except OSError as e:
+                # a transient filesystem error (NFS blip, dir swap)
+                # must not kill the watcher; surface and keep polling
+                slog.log_failure("serve.watch_error", stage="poll",
+                                 error=e)
+            self._last_poll = time.time()
+            self._closed.wait(self.poll_s)
+
+    def _poll_once(self):
+        try:
+            names = sorted(
+                n for n in os.listdir(self.spool_dir)
+                if fnmatch.fnmatch(n, self.pattern))
+        except FileNotFoundError:
+            return                       # spool not created yet
+        for name in names:
+            if name in self._admitted:
+                continue
+            path = os.path.join(self.spool_dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue                 # vanished mid-poll
+            prev_size, stable = self._seen.get(name, (None, 0))
+            if size <= 0 or size != prev_size:
+                self._seen[name] = (size, 0)
+                continue
+            stable += 1
+            self._seen[name] = (size, stable)
+            if stable < self.settle_polls:
+                continue
+            self._admit(name, path)
+
+    def _admit(self, name, path):
+        try:
+            with open(path, "rb") as fh:
+                sha = content_hash(fh.read())
+        except OSError as e:
+            slog.log_failure("serve.watch_error", stage="admit",
+                             error=e, epoch=name)
+            return
+        self._admitted.add(name)
+        self._seen.pop(name, None)
+        self._q.put(ArrivedEpoch(name, path, sha=sha))
+        slog.log_event("serve.ingest", epoch=name, path=path,
+                       sha=sha[:12])
+
+    # ---- source interface -------------------------------------------
+    def get(self, timeout=None):
+        try:
+            return self._q.get(timeout=timeout) if timeout \
+                else self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def backlog(self):
+        return self._q.qsize()
+
+    def alive(self):
+        return self._thread.is_alive() and not self._closed.is_set()
+
+    def last_activity(self):
+        """Wall time of the last completed poll (the /healthz
+        staleness input: a wedged watcher stops advancing this)."""
+        return self._last_poll
+
+    def close(self):
+        self._closed.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

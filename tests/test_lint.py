@@ -124,7 +124,11 @@ class TestSyncpoints:
     def test_hot_paths_are_clean(self):
         lint = _tool("lint_syncpoints")
         violations = []
-        for d in ("ops", "fit", "thth", "parallel"):
+        # serve/ joined the scan in ISSUE 6: the daemon's HTTP
+        # handlers and watcher threads must never fence in-flight
+        # device values (a scrape that syncs the dispatch queue
+        # would stall the stream it is observing)
+        for d in ("ops", "fit", "thth", "parallel", "serve"):
             violations.extend(lint.scan_tree(
                 os.path.join(REPO, "scintools_tpu", d)))
         assert violations == [], (
@@ -173,14 +177,22 @@ class TestObsEvents:
     name must be in the docs/observability.md catalog."""
 
     DOC = os.path.join(REPO, "docs", "observability.md")
+    DOCS = (DOC, os.path.join(REPO, "docs", "serving.md"))
 
     def test_package_events_are_documented(self):
         lint = _tool("lint_obs_events")
         violations = lint.scan_tree(
-            os.path.join(REPO, "scintools_tpu"), self.DOC)
+            os.path.join(REPO, "scintools_tpu"), self.DOCS)
         assert violations == [], (
             "undocumented / unresolvable slog event names "
-            f"(document them in docs/observability.md): {violations}")
+            "(document them in docs/observability.md or "
+            f"docs/serving.md): {violations}")
+
+    def test_catalog_accepts_multiple_docs(self):
+        lint = _tool("lint_obs_events")
+        multi = lint.catalog_names(self.DOCS)
+        assert lint.catalog_names(self.DOC) <= multi
+        assert "serve.ingest" in multi
 
     def test_catalog_parses_known_events(self):
         lint = _tool("lint_obs_events")

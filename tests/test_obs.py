@@ -10,6 +10,7 @@ schema under clean and fault-injected runs."""
 
 import json
 import os
+import re
 import threading
 
 import pytest
@@ -359,11 +360,155 @@ class TestRunnerMetrics:
         assert snap["counters"]["survey_journal_fsyncs_total"] == 3
 
 
+class TestPrometheusConformance:
+    """ISSUE 6 satellite: the exposition a real Prometheus server
+    scrapes — `# HELP`/`# TYPE` per family (even help-less ones),
+    line-syntax conformance, histogram expansion, the version-0.0.4
+    content type, and the per-scrape `process_uptime_seconds`
+    refresh."""
+
+    _SAMPLE = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*"              # metric name
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'      # first label
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?' # more labels
+        r" [0-9.+\-eE]+(\+Inf)?$")
+
+    def _populate(self, reg):
+        reg.counter("helped_total", help="has help").inc(3)
+        reg.counter("helpless_total").inc()       # no help given
+        g = reg.gauge("g_value", help="a gauge")
+        g.set(1.5)
+        h = reg.histogram("lat_seconds", help="latency",
+                          buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        reg.counter("labeled_total",
+                    help="with labels").labels(path="/metrics").inc()
+        return reg
+
+    def test_every_family_has_help_and_type(self):
+        reg = self._populate(metrics.MetricsRegistry())
+        text = reg.to_prometheus()
+        lines = text.strip().splitlines()
+        families = {}
+        for ln in lines:
+            if ln.startswith("# TYPE "):
+                name, kind = ln.split()[2:4]
+                families[name] = kind
+        assert families == {
+            "helped_total": "counter", "helpless_total": "counter",
+            "g_value": "gauge", "lat_seconds": "histogram",
+            "labeled_total": "counter"}
+        helped = {ln.split()[2] for ln in lines
+                  if ln.startswith("# HELP ")}
+        assert helped == set(families)            # HELP per family
+        # HELP precedes TYPE precedes samples, per family
+        idx = {ln: i for i, ln in enumerate(lines)}
+        assert idx["# HELP helpless_total helpless_total"] \
+            < idx["# TYPE helpless_total counter"] \
+            < idx["helpless_total 1"]
+
+    def test_sample_line_syntax_and_histogram_expansion(self):
+        reg = self._populate(metrics.MetricsRegistry())
+        lines = reg.to_prometheus().strip().splitlines()
+        samples = [ln for ln in lines if not ln.startswith("#")]
+        for ln in samples:
+            assert self._SAMPLE.match(ln) or "+Inf" in ln, ln
+        names = "\n".join(samples)
+        assert 'lat_seconds_bucket{le="0.1"} 1' in names
+        assert 'lat_seconds_bucket{le="1.0"} 2' in names
+        assert 'lat_seconds_bucket{le="+Inf"} 3' in names
+        assert "lat_seconds_count 3" in names
+        assert 'labeled_total{path="/metrics"} 1' in names
+
+    def test_content_type_and_uptime(self):
+        assert metrics.PROMETHEUS_CONTENT_TYPE.startswith(
+            "text/plain; version=0.0.4")
+        metrics.touch_process_metrics()
+        up1 = metrics.REGISTRY.gauge("process_uptime_seconds").value
+        assert up1 > 0
+        import time as _time
+
+        _time.sleep(0.01)
+        metrics.touch_process_metrics()
+        up2 = metrics.REGISTRY.gauge("process_uptime_seconds").value
+        assert up2 > up1                  # refreshed per scrape
+        assert "process_uptime_seconds" in \
+            metrics.REGISTRY.to_prometheus()
+
+
+class TestStreamingHeartbeat:
+    """ISSUE 6 satellite: unknown-length (streaming) runs emit
+    throughput + live stream stats, never a bogus ETA."""
+
+    def test_streaming_beats_have_no_eta_or_total(self):
+        h = hb.Heartbeat(every_n=2, every_s=3600, total=50,
+                         streaming=True, event="serve.heartbeat",
+                         stats_fn=lambda: {"backlog": 7})
+        assert h.total is None            # total ignored in streaming
+        for i in range(1, 5):
+            h.beat(i)
+        recs = slog.recent(event="serve.heartbeat")
+        assert [r["done"] for r in recs] == [2, 4]
+        for r in recs:
+            assert "eta_s" not in r and "total" not in r
+            assert r["streaming"] is True
+            assert r["backlog"] == 7
+            assert "epochs_per_sec" in r
+
+    def test_as_heartbeat_does_not_force_total_on_streaming(self):
+        h = hb.as_heartbeat({"streaming": True, "every_n": 5},
+                            total=99)
+        assert h.streaming and h.total is None
+        h2 = hb.Heartbeat(streaming=True)
+        assert hb.as_heartbeat(h2, total=99).total is None
+        # batch specs keep the ETA behaviour
+        assert hb.as_heartbeat({"every_n": 5}, total=99).total == 99
+
+    def test_batch_heartbeat_unchanged(self):
+        h = hb.Heartbeat(every_n=1, total=4)
+        h.beat(1)
+        h.beat(2)             # elapsed > 0 → throughput + ETA
+        rec = slog.recent(event="survey.heartbeat")[-1]
+        assert rec["total"] == 4 and "eta_s" in rec
+        assert "streaming" not in rec
+
+
+class TestRunReportBuilder:
+    """ISSUE 6: the RunReport is incrementally buildable — every
+    mid-run snapshot is schema-valid."""
+
+    _SUMMARY = {"n_epochs": 5, "n_ok": 4, "n_quarantined": 1,
+                "n_resumed": 0, "retries": 0,
+                "tier_counts": {"jax_fused": 4}}
+
+    def test_snapshot_mid_run_is_schema_valid(self):
+        b = report.RunReportBuilder(runner="serve_survey")
+        rep = b.snapshot(self._SUMMARY, extra={"backlog": 3})
+        report.validate_run_report(rep)
+        assert rep["runner"] == "serve_survey"
+        assert rep["in_progress"] is True
+        assert rep["backlog"] == 3
+        assert rep["wall_s"] >= 0
+        rep2 = b.snapshot(self._SUMMARY)
+        assert rep2["wall_s"] >= rep["wall_s"]
+
+    def test_finalize_writes_artifact_pair(self, tmp_path):
+        b = report.RunReportBuilder(runner="serve_survey")
+        path = b.finalize(tmp_path, self._SUMMARY)
+        assert path == str(tmp_path / "run_report.json")
+        rep = json.loads((tmp_path / "run_report.json").read_text())
+        report.validate_run_report(rep)
+        assert rep["in_progress"] is False
+        assert (tmp_path / "run_report.md").exists()
+
+
 def test_obs_namespace_exports():
     import scintools_tpu.obs as obs
 
     for name in ("REGISTRY", "MetricsRegistry", "Heartbeat",
                  "retrace_guard", "validate_run_report",
                  "write_chrome_trace", "validate_chrome_trace",
-                 "record_build", "build_run_report"):
+                 "record_build", "build_run_report",
+                 "RunReportBuilder"):
         assert hasattr(obs, name), name

@@ -44,10 +44,18 @@ _EXEMPT = (os.path.join("utils", "slog.py"),)
 
 
 def catalog_names(doc_path):
-    """Backtick-quoted dotted names in the event-catalog doc."""
-    with open(doc_path, encoding="utf-8") as fh:
-        text = fh.read()
-    return set(re.findall(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`", text))
+    """Backtick-quoted dotted names in the event-catalog doc(s) —
+    ``doc_path`` is one path or an iterable of paths (the catalog
+    spans docs/observability.md and docs/serving.md)."""
+    paths = [doc_path] if isinstance(doc_path, (str, os.PathLike)) \
+        else list(doc_path)
+    names = set()
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        names |= set(re.findall(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`",
+                                text))
+    return names
 
 
 def _is_slog_call(node):
@@ -157,8 +165,13 @@ def scan_source(src, filename="<src>"):
 def scan_tree(root, doc_path):
     """Walk ``root`` for python files; return ``[(path, lineno,
     message)]`` violations — unresolvable event names plus any
-    emitted name missing from the catalog at ``doc_path``."""
+    emitted name missing from the catalog at ``doc_path`` (one path
+    or several)."""
     catalog = catalog_names(doc_path)
+    doc_names = ", ".join(
+        os.path.basename(p) for p in
+        ([doc_path] if isinstance(doc_path, (str, os.PathLike))
+         else doc_path))
     out = []
     for dirpath, _, files in os.walk(root):
         for fname in sorted(files):
@@ -177,7 +190,7 @@ def scan_tree(root, doc_path):
                     out.append((
                         path, ln,
                         f"event {name!r} not in the catalog "
-                        f"({os.path.basename(doc_path)}) — document "
+                        f"({doc_names}) — document "
                         f"it or rename to a documented event"))
     return out
 
@@ -186,9 +199,10 @@ def main(argv):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     root = argv[1] if len(argv) > 1 else os.path.join(repo,
                                                       "scintools_tpu")
-    doc = argv[2] if len(argv) > 2 else os.path.join(
-        repo, "docs", "observability.md")
-    violations = scan_tree(root, doc)
+    docs = argv[2:] if len(argv) > 2 else [
+        os.path.join(repo, "docs", "observability.md"),
+        os.path.join(repo, "docs", "serving.md")]
+    violations = scan_tree(root, docs)
     for path, ln, msg in violations:
         print(f"{path}:{ln}: {msg}")
     return 1 if violations else 0
