@@ -969,6 +969,132 @@ def bench_acf2d_batch(jax, jnp):
             "unhealthy_lanes": int(np.count_nonzero(ok0))}
 
 
+def bench_retrieval_batch(jax, jnp):
+    """Config #14 (ISSUE 7 tentpole): campaign-scale device-native
+    PHASE RETRIEVAL — the paper's heaviest compute (per-chunk
+    dominant-eigenvector solves dwarf the curvature search). A
+    4-epoch campaign of half-overlap chunk grids runs as ONE
+    geometry-keyed batched program (pad → CS → θ-θ gather → eigenpair
+    → wavefield row → inverse map → ifft2,
+    thth/retrieval.py:make_chunk_retrieval_fn; per-platform eigenpair
+    formulation) feeding the ON-DEVICE mosaic stitch
+    (thth/retrieval.py:mosaic_device) as an in-flight device array —
+    against the reference shape: LOOPING host
+    ``single_chunk_retrieval`` per chunk + the greedy numpy mosaic.
+
+    Reports the compile/steady split, chunks/s and epochs/s, the
+    per-chunk parity fraction vs the looped path (phase-aligned
+    correlation — eigenvector global phase is arbitrary), the active
+    formulations, and the steady-state retrace count (gate: ZERO —
+    every epoch of a campaign reuses one compiled program). The
+    acceptance gate is steady-state chunks/s ≥5× looped on the 1-core
+    CPU host with parity fraction 1.0."""
+    from scintools_tpu.backend import formulation
+    from scintools_tpu.dynspec import _wavefield_grid
+    from scintools_tpu.obs import retrace
+    from scintools_tpu.thth.core import fft_axis
+    from scintools_tpu.thth.retrieval import (campaign_retrieval_batch,
+                                              mosaic,
+                                              resolve_retrieval_method,
+                                              single_chunk_retrieval)
+
+    E_ep, NF, NT = 4, 288, 288
+    cwf = cwt = 96
+    npad = 3                                     # reference default
+    dt, df, f0 = 2.0, 0.05, 1400.0
+    eta_true = 5e-4                              # us/mHz²
+    rng = np.random.default_rng(23)
+    dyn0 = make_arc_dynspec(NT, NF, dt, df, f0, eta_true,
+                            n_images=48, seed=23)
+    base = np.stack([dyn0 + 1e-5 * (e + 1)
+                     * rng.standard_normal(dyn0.shape)
+                     for e in range(E_ep)])
+    # variant 0 = warm-up/parity input; 1..3 timed (tunnel memoises
+    # bit-identical executions — module docstring)
+    variants = [base + 1e-7 * i for i in range(4)]
+    times = np.arange(NT) * dt
+    freqs = f0 + np.arange(NF) * df
+    fdc = fft_axis(times[:cwt], pad=npad, scale=1e3)
+    edges = np.linspace(-0.9 * fdc.max() / 2, 0.9 * fdc.max() / 2, 48)
+    grids = [np.stack([_wavefield_grid(d, cwf, cwt) for d in v])
+             for v in variants]                  # (E, ncf, nct, f, t)
+    ncf, nct = grids[0].shape[1:3]
+    n_chunks = E_ep * ncf * nct
+    edges_rows = np.tile(edges, (ncf, 1))
+    etas_rows = np.full(ncf, eta_true)
+
+    # ---- looped host baseline: per-chunk retrieval + numpy mosaic ---
+    tsl = [times[ct * (cwt // 2): ct * (cwt // 2) + cwt]
+           for ct in range(nct)]
+    fsl = [freqs[cf * (cwf // 2): cf * (cwf // 2) + cwf]
+           for cf in range(ncf)]
+
+    def run_looped(g, keep=False):
+        wfs, chunks_out = [], []
+        for e in range(E_ep):
+            Ec = np.zeros((ncf, nct, cwf, cwt), dtype=complex)
+            for cf in range(ncf):
+                for ct2 in range(nct):
+                    Ec[cf, ct2] = single_chunk_retrieval(
+                        g[e, cf, ct2], edges, tsl[ct2], fsl[cf],
+                        eta_true, npad=npad, backend="numpy")[0]
+            wfs.append(mosaic(Ec))
+            if keep:
+                chunks_out.append(Ec)
+        return (wfs, chunks_out) if keep else wfs
+
+    _, loop_chunks = run_looped(grids[0], keep=True)
+    t_loop = _time_variants(run_looped, [(g,) for g in grids[1:]],
+                            repeats=2)
+
+    # ---- batched device campaign (retrieval + device mosaic) --------
+    method = resolve_retrieval_method(None, len(edges))
+
+    def run_batched(g):
+        wf, ok = campaign_retrieval_batch(
+            g, edges_rows, etas_rows, dt, df, npad=npad)
+        return wf, ok                            # wf fetch forces it
+
+    t0 = time.perf_counter()
+    _, ok0 = run_batched(grids[0])
+    t_compile = time.perf_counter() - t0
+    builds0 = retrace.compile_counts()
+    t_steady = _time_variants(lambda g: run_batched(g),
+                              [(g,) for g in grids[1:]], repeats=3)
+    grew = {s: n - builds0.get(s, 0)
+            for s, n in retrace.compile_counts().items()
+            if n != builds0.get(s, 0)}
+    steady_retraces = sum(grew.values())
+
+    # ---- per-chunk parity vs the looped host path (variant 0) -------
+    Ec_b, _ = campaign_retrieval_batch(
+        grids[0], edges_rows, etas_rows, dt, df, npad=npad,
+        stitch=False)
+    agree = []
+    for e in range(E_ep):
+        for cf in range(ncf):
+            for ct2 in range(nct):
+                a = loop_chunks[e][cf, ct2]
+                b = Ec_b[e, cf, ct2]
+                num = np.abs(np.vdot(b, a))
+                den = (np.linalg.norm(a) * np.linalg.norm(b) + 1e-300)
+                agree.append(num / den > 0.99)
+    return {"epochs": E_ep, "chunks": n_chunks,
+            "grid": f"{ncf}x{nct}", "chunk": f"{cwf}x{cwt}",
+            "eig_formulation": method,
+            "cs_formulation": formulation("ops.cs"),
+            "looped_s": round(t_loop, 3),
+            "compile_s": round(t_compile, 3),
+            "steady_s": round(t_steady, 3),
+            "chunks_per_sec": round(n_chunks / t_steady, 1),
+            "epochs_per_sec": round(E_ep / t_steady, 2),
+            "looped_chunks_per_sec": round(n_chunks / t_loop, 1),
+            "speedup_vs_looped": round(t_loop / t_steady, 2),
+            "parity_frac": round(float(np.mean(agree)), 3),
+            "steady_retraces": int(steady_retraces),
+            "quarantined": int(np.count_nonzero(ok0))}
+
+
 def bench_survey_arc(jax, jnp):
     """Config #5b: the survey's per-epoch ARC fit — BASELINE #5 is
     "sharded sspec + arc fit", and the plain `survey` config covers
@@ -1720,6 +1846,7 @@ _EST_S = {
     "acf_fit":       {"acc": 60,  "cpu": 60},
     "acf2d":         {"acc": 150, "cpu": 60},
     "acf2d_batch":   {"acc": 150, "cpu": 200},
+    "retrieval_batch": {"acc": 60, "cpu": 60},
     "scatim":        {"acc": 60,  "cpu": 60},
 }
 
@@ -1841,6 +1968,7 @@ def main():
     plan = [
         ("north_star", bench_north_star),
         ("sspec_thth", bench_sspec_thth),
+        ("retrieval_batch", bench_retrieval_batch),
         ("acf_fit_batch", bench_acf_fit_batch),
         ("survey", bench_survey),
         ("survey_pipeline", bench_survey_pipeline),

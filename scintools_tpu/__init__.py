@@ -26,7 +26,8 @@ def __getattr__(name):
     # Lazy imports keep `import scintools_tpu` light.
     try:
         if name in ("Dynspec", "BasicDyn", "MatlabDyn", "SimDyn", "HoloDyn",
-                    "sort_dyn"):
+                    "sort_dyn", "run_psrflux_survey",
+                    "serve_psrflux_survey", "run_wavefield_survey"):
             from . import dynspec as _d
             return getattr(_d, name)
         if name == "Simulation":

@@ -188,3 +188,184 @@ def eager_backend(backend=None):
     return backend
 
 
+# ---------------------------------------------------------------------------
+# Per-platform formulation dispatch
+# ---------------------------------------------------------------------------
+#
+# Several hot kernels have more than one mathematically-equivalent
+# *formulation* whose winner depends on the platform: the conjugate
+# spectrum as rfft2+Hermitian-gather vs complex fft2 (ops/sspec.py),
+# the scattered-image / arc-profile interpolation as coalesced gathers
+# vs MXU tent/Keys matmuls (ops/scatim.py, ops/normsspec.py), the θ-θ
+# eigensolver as a VMEM Pallas squaring kernel vs the XLA warm-start
+# η-scan vs a cold power iteration (thth/batch.py, thth/retrieval.py),
+# and buffer donation (useful on accelerators, a compile warning on
+# CPU). Before this registry each of those was an ad-hoc
+# ``jax.default_backend() == ...`` branch buried in its module; the
+# registry makes the choice one inspectable, overridable table:
+#
+# - each op module REGISTERS its formulations and per-platform
+#   defaults at import (:func:`register_formulation`);
+# - call sites resolve the active choice with :func:`formulation`;
+# - an operator can pin a choice process-wide
+#   (:func:`set_formulation`) or from the environment
+#   (``SCINTOOLS_FORMULATION_<OP>`` with ``.``→``_``, e.g.
+#   ``SCINTOOLS_FORMULATION_OPS_CS=fft2``), and
+#   :func:`measure_formulation` installs a MEASURED override by
+#   timing the candidate closures on the live platform (the bench's
+#   gather-vs-matmul splits, promoted to a mechanism).
+#
+# Resolution order: measured/manual override > environment >
+# per-platform table > registered default.
+
+_FORMULATIONS = {}            # op -> {default, choices, platforms, doc}
+_FORMULATION_OVERRIDES = {}   # op -> choice (set_formulation/measured)
+
+
+def register_formulation(op, default, choices, platforms=None, doc=""):
+    """Register (idempotently) the formulation table for ``op``.
+
+    ``choices`` is the tuple of valid formulation names, ``default``
+    the platform-independent fallback, ``platforms`` an optional
+    ``{platform: choice}`` map keyed by jax backend names ('cpu',
+    'tpu', 'gpu')."""
+    choices = tuple(choices)
+    platforms = dict(platforms or {})
+    if default not in choices:
+        raise ValueError(f"{op}: default {default!r} not in {choices}")
+    for plat, choice in platforms.items():
+        if choice not in choices:
+            raise ValueError(
+                f"{op}: platform {plat!r} choice {choice!r} not in "
+                f"{choices}")
+    _FORMULATIONS[op] = {"default": default, "choices": choices,
+                         "platforms": platforms, "doc": doc}
+
+
+def formulation_platform():
+    """The platform key used by :func:`formulation` when none is
+    given: the default jax backend name, or 'cpu' when jax is
+    unavailable (the numpy fallback runs on the host)."""
+    try:
+        return get_jax().default_backend()
+    except Exception:  # pragma: no cover - jax is baked into the image
+        return "cpu"
+
+
+def _env_formulation(op):
+    return os.environ.get(
+        "SCINTOOLS_FORMULATION_" + op.replace(".", "_").upper())
+
+
+def formulation(op, platform=None):
+    """Resolve the active formulation name for a registered ``op``.
+
+    Order: :func:`set_formulation`/:func:`measure_formulation`
+    override > ``SCINTOOLS_FORMULATION_<OP>`` env var > per-platform
+    table entry for ``platform`` (default: the live jax backend) >
+    registered default. Unknown ops and invalid override values raise
+    — a typo'd formulation must be loud, not a silent fall-through to
+    the slow path."""
+    rec = _FORMULATIONS.get(op)
+    if rec is None:
+        raise KeyError(f"unregistered formulation op {op!r} "
+                       f"(known: {sorted(_FORMULATIONS)})")
+    for source, choice in (("override", _FORMULATION_OVERRIDES.get(op)),
+                           ("env", _env_formulation(op))):
+        if choice is not None:
+            if choice not in rec["choices"]:
+                raise ValueError(
+                    f"{op}: {source} formulation {choice!r} not one "
+                    f"of {rec['choices']}")
+            return choice
+    if platform is None:
+        platform = formulation_platform()
+    return rec["platforms"].get(platform, rec["default"])
+
+
+def set_formulation(op, choice=None):
+    """Pin (or with ``choice=None`` clear) a process-wide formulation
+    override for ``op``. Validated against the registered choices."""
+    rec = _FORMULATIONS.get(op)
+    if rec is None:
+        raise KeyError(f"unregistered formulation op {op!r}")
+    if choice is None:
+        _FORMULATION_OVERRIDES.pop(op, None)
+        return
+    if choice not in rec["choices"]:
+        raise ValueError(f"{op}: {choice!r} not one of "
+                         f"{rec['choices']}")
+    _FORMULATION_OVERRIDES[op] = choice
+
+
+def measure_formulation(op, candidates, repeats=2):
+    """Install a MEASURED override: time each candidate closure on the
+    live platform and pin the fastest.
+
+    ``candidates`` is ``{choice: thunk}`` where each thunk runs one
+    representative workload of that formulation end-to-end (including
+    its result fetch — the caller owns making the timing honest). Each
+    thunk is called once for warm-up (compile) and then ``repeats``
+    times; the per-choice time is the best repeat. Returns
+    ``(winner, {choice: best_seconds})`` and leaves the winner pinned
+    via :func:`set_formulation` (clear with
+    ``set_formulation(op, None)``)."""
+    import time
+
+    rec = _FORMULATIONS.get(op)
+    if rec is None:
+        raise KeyError(f"unregistered formulation op {op!r}")
+    unknown = set(candidates) - set(rec["choices"])
+    if unknown:
+        raise ValueError(f"{op}: unknown candidate(s) {sorted(unknown)}")
+    timings = {}
+    for choice, thunk in candidates.items():
+        thunk()                              # warm-up / compile
+        best = float("inf")
+        for _ in range(max(1, int(repeats))):
+            t0 = time.perf_counter()
+            thunk()
+            best = min(best, time.perf_counter() - t0)
+        timings[choice] = best
+    winner = min(timings, key=timings.get)
+    set_formulation(op, winner)
+    from .utils import slog
+
+    slog.log_event("backend.formulation_measured", op=op,
+                   winner=winner,
+                   timings={k: round(v, 6) for k, v in timings.items()})
+    return winner, timings
+
+
+def formulation_snapshot():
+    """JSON-able view of every registered op: its choices, table, and
+    the choice that would resolve right now (for run reports/bench)."""
+    out = {}
+    for op, rec in sorted(_FORMULATIONS.items()):
+        out[op] = {
+            "choices": list(rec["choices"]),
+            "default": rec["default"],
+            "platforms": dict(rec["platforms"]),
+            "override": _FORMULATION_OVERRIDES.get(op)
+            or _env_formulation(op),
+            "active": formulation(op),
+        }
+    return out
+
+
+# Buffer donation is itself a per-platform formulation: donated HBM is
+# recycled into program intermediates on accelerators, but XLA on CPU
+# cannot alias the buffers and warns on every compile.
+register_formulation(
+    "jit.donate", default="on", choices=("on", "off"),
+    platforms={"cpu": "off"},
+    doc="donate consumed input stacks to jitted programs")
+
+
+def donation_argnums(argnums):
+    """``argnums`` when the 'jit.donate' formulation is active on this
+    platform, else None — the shared gate for every factory that
+    donates its input stack."""
+    return tuple(argnums) if formulation("jit.donate") == "on" else None
+
+

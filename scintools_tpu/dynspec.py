@@ -1660,15 +1660,17 @@ class Dynspec:
                     mesh, tau, fd, len(self.edges))
             _SHARDED_GRID_CACHE[key] = fn
         if thin:
-            eigs = np.asarray(fn(
+            eigs = np.asarray(fn(  # sync-ok: grid results feed the
+                # host peak fit right below — consumption boundary
                 jnp.asarray(np.stack(cs_list)),
                 jnp.asarray(np.stack(edges_list)),
                 jnp.asarray(np.stack(arclet_list)),
                 jnp.asarray(np.stack(etas_list))))[:B]
         else:
-            eigs = np.asarray(fn(jnp.asarray(np.stack(cs_list)),
-                                 jnp.asarray(np.stack(edges_list)),
-                                 jnp.asarray(np.stack(etas_list))))[:B]
+            eigs = np.asarray(fn(  # sync-ok: same boundary as above
+                jnp.asarray(np.stack(cs_list)),
+                jnp.asarray(np.stack(edges_list)),
+                jnp.asarray(np.stack(etas_list))))[:B]
 
         from .robust import guards
 
@@ -1869,18 +1871,85 @@ class Dynspec:
 
     def calc_wavefield(self, verbose=False, pool=None, gs=False,
                        memmap=False, niter=1, mesh=None,
-                       gs_mesh=None):
+                       gs_mesh=None, device_mosaic=False):
         """Mosaic the retrieval chunks into the wavefield
         (dynspec.py:1828-1852). ``pool`` forwards to the retrieval
         fan-out (numpy backend); ``mesh`` shards the jax retrieval
         batch over the device mesh. ``gs_mesh`` (a data-axis-1 mesh,
         ``make_mesh(n, seq=n)``) shards the GS refinement's FFT loop —
         a separate knob because the retrieval grid wants chunk
-        fan-out while GS wants one wavefield split over devices."""
+        fan-out while GS wants one wavefield split over devices.
+        ``device_mosaic=True`` stitches with the jitted device scan
+        (thth/retrieval.py:mosaic_device; the greedy numpy loop stays
+        the oracle) — :meth:`retrieve_wavefield` is the fully
+        device-native path where the chunks never visit the host."""
         if not hasattr(self, "chunks"):
             self.thetatheta_chunks(verbose=verbose, memmap=memmap,
                                    pool=pool, mesh=mesh)
-        self.wavefield = thth_ret.mosaic(self.chunks)
+        if device_mosaic and self.backend == "jax":
+            self.wavefield = thth_ret.mosaic_device(
+                np.asarray(self.chunks))
+        else:
+            self.wavefield = thth_ret.mosaic(self.chunks)
+        if gs:
+            self.gerchberg_saxton(verbose=verbose, niter=niter,
+                                  mesh=gs_mesh)
+        return self.wavefield
+
+    def _retrieval_grid_inputs(self):
+        """Half-overlap retrieval grid + per-frequency-row scaled
+        geometry (the ``thetatheta_chunks`` row inputs, packaged for
+        the campaign program): ``(chunks[ncf, nct, cwf, cwt],
+        edges_rows[ncf, n_edges], etas_rows[ncf])``."""
+        chunks = np.zeros((self.ncf_ret, self.nct_ret, self.cwf,
+                           self.cwt))
+        edges_rows = np.zeros((self.ncf_ret, len(self.edges)))
+        etas_rows = np.zeros(self.ncf_ret)
+        for cf in range(self.ncf_ret):
+            freq2 = None
+            for ct in range(self.nct_ret):
+                dspec2, freq2, _ = self._chunk(cf, ct, fit=False)
+                chunks[cf, ct] = dspec2
+            freq = freq2.mean()
+            etas_rows[cf] = self.ththeta * (self.fref / freq) ** 2
+            edges_rows[cf] = self.edges * (freq / self.fref)
+        return chunks, edges_rows, etas_rows
+
+    def retrieve_wavefield(self, verbose=False, mesh=None, gs=False,
+                           niter=1, gs_mesh=None, method=None):
+        """DEVICE-NATIVE phase retrieval + mosaic: the half-overlap
+        chunk grid retrieval (one geometry-keyed batched program,
+        per-row η/edges traced) feeds the jitted mosaic stitch as an
+        in-flight device array — chunk wavefields never round-trip to
+        host (jax backend; numpy falls back to
+        ``calc_wavefield``'s looped path). Sets ``self.wavefield``
+        and the per-chunk health grid ``self.wavefield_ok``
+        (robust/guards.py bitmask — quarantined chunks are zero-
+        filled with neighbours untouched). ``method`` picks the
+        eigenpair formulation (None → per-platform dispatch,
+        ``backend.formulation('thth.retrieval_eig')``)."""
+        if not hasattr(self, "ththeta"):
+            self.fit_thetatheta(verbose=verbose, mesh=mesh)
+        if self.backend != "jax":
+            self.wavefield_ok = np.zeros(
+                (self.ncf_ret, self.nct_ret), dtype=int)
+            return self.calc_wavefield(verbose=verbose, gs=gs,
+                                       niter=niter, gs_mesh=gs_mesh)
+        chunks, edges_rows, etas_rows = self._retrieval_grid_inputs()
+        dt = self.times[1] - self.times[0]
+        df = self.freqs[1] - self.freqs[0]
+        wf, ok = thth_ret.campaign_retrieval_batch(
+            chunks[None], edges_rows, etas_rows, dt, df,
+            npad=self.npad, tau_mask=self.thth_tau_mask,
+            method=method, mesh=mesh)
+        self.wavefield = wf[0]
+        self.wavefield_ok = ok[0]
+        from .utils import slog
+
+        slog.log_event("thth.retrieve_wavefield",
+                       ncf=self.ncf_ret, nct=self.nct_ret,
+                       n_quarantined=int(np.count_nonzero(ok)),
+                       shape=list(self.wavefield.shape))
         if gs:
             self.gerchberg_saxton(verbose=verbose, niter=niter,
                                   mesh=gs_mesh)
@@ -2289,6 +2358,163 @@ def serve_psrflux_survey(spool_dir, workdir, crop=None, alpha=5 / 3,
                             load_fn=load_fn, http=(host, port),
                             **service_kw)
     return service.start() if start else service
+
+
+def _wavefield_grid(dyn, cwf, cwt):
+    """Half-overlap retrieval grid of a raw dynspec (the
+    ``Dynspec._chunk(fit=False)`` slicing, standalone): mean-subtract
+    + NaN-fill each chunk. Returns ``chunks[ncf, nct, cwf, cwt]``."""
+    nf, nt = dyn.shape
+    ncf = nf // (cwf // 2) - 1
+    nct = nt // (cwt // 2) - 1
+    if ncf < 1 or nct < 1:
+        raise ValueError(f"dynspec {dyn.shape} too small for "
+                         f"{cwf}x{cwt} half-overlap chunks")
+    chunks = np.zeros((ncf, nct, cwf, cwt))
+    for cf in range(ncf):
+        for ct in range(nct):
+            sl = np.array(dyn[cf * (cwf // 2): cf * (cwf // 2) + cwf,
+                              ct * (cwt // 2): ct * (cwt // 2) + cwt],
+                          dtype=float)
+            sl -= np.nanmean(sl)
+            chunks[cf, ct] = np.nan_to_num(sl)
+    return chunks
+
+
+def _wavefield_survey_fns(edges, eta, cwf, cwt, npad, tau_mask,
+                          method, workdir, save_wavefields):
+    """The (load passthrough, process) pair of the wavefield survey:
+    ``process(payload, tier=...)`` retrieves one epoch's stitched
+    campaign wavefield on the tier's path and returns JSON-able
+    scalars (+ an atomically-written ``.npy`` artifact). Tiers:
+
+    - ``jax_fused`` — batched device retrieval
+      (thth/retrieval.py:campaign_retrieval_batch, per-platform
+      eigenpair formulation) + the DEVICE mosaic; chunks stay on
+      device end-to-end.
+    - ``jax_staged`` — the same batched device retrieval, stitched by
+      the greedy numpy ``mosaic`` oracle (separates a mosaic-kernel
+      failure from a retrieval failure).
+    - ``numpy`` — looped host ``single_chunk_retrieval`` + numpy
+      mosaic (the reference path).
+    """
+    import hashlib
+
+    from .parallel.checkpoint import atomic_write_bytes
+    from .robust.ladder import TIER_NUMPY, TIER_STAGED
+    from .thth.retrieval import (campaign_retrieval_batch,
+                                 single_chunk_retrieval)
+
+    edges = np.asarray(edges, dtype=float)
+    wf_dir = os.path.join(workdir, "wavefields")
+
+    def process(payload, tier=None):
+        dyn, times, freqs = payload
+        epoch_key = hashlib.sha256(
+            np.ascontiguousarray(dyn).tobytes()).hexdigest()[:16]
+        dt = float(times[1] - times[0])
+        df = float(freqs[1] - freqs[0])
+        chunks = _wavefield_grid(np.asarray(dyn, dtype=float),
+                                 cwf, cwt)
+        ncf, nct = chunks.shape[:2]
+        fref = float(np.asarray(freqs, dtype=float).mean())
+        # per-frequency-row scaled geometry (Dynspec row_inputs)
+        etas_rows = np.zeros(ncf)
+        edges_rows = np.zeros((ncf, len(edges)))
+        for cf in range(ncf):
+            fsl = np.asarray(freqs[cf * (cwf // 2):
+                                   cf * (cwf // 2) + cwf], dtype=float)
+            etas_rows[cf] = eta * (fref / fsl.mean()) ** 2
+            edges_rows[cf] = edges * (fsl.mean() / fref)
+        n_quar = 0
+        if tier == TIER_NUMPY:
+            Ec = np.zeros((ncf, nct, cwf, cwt), dtype=complex)
+            for cf in range(ncf):
+                fsl = freqs[cf * (cwf // 2): cf * (cwf // 2) + cwf]
+                for ct in range(nct):
+                    tsl = times[ct * (cwt // 2):
+                                ct * (cwt // 2) + cwt]
+                    Ec[cf, ct] = single_chunk_retrieval(
+                        chunks[cf, ct], edges_rows[cf], tsl, fsl,
+                        etas_rows[cf], npad=npad, tau_mask=tau_mask,
+                        backend="numpy")[0]
+            n_quar = int(sum(not np.any(Ec[cf, ct])
+                             for cf in range(ncf)
+                             for ct in range(nct)))
+            from .thth.retrieval import mosaic
+
+            wf = mosaic(Ec)
+        elif tier == TIER_STAGED:
+            Ec, ok = campaign_retrieval_batch(
+                chunks[None], edges_rows, etas_rows, dt, df,
+                npad=npad, tau_mask=tau_mask, method=method,
+                stitch=False)
+            n_quar = int(np.count_nonzero(ok))
+            from .thth.retrieval import mosaic
+
+            wf = mosaic(Ec[0])
+        else:
+            wf_b, ok = campaign_retrieval_batch(
+                chunks[None], edges_rows, etas_rows, dt, df,
+                npad=npad, tau_mask=tau_mask, method=method)
+            n_quar = int(np.count_nonzero(ok))
+            wf = wf_b[0]
+        wf = np.asarray(wf, dtype=complex)
+        blob = wf.tobytes()
+        rec = {"n_chunks": int(ncf * nct), "ncf": ncf, "nct": nct,
+               "n_quarantined": n_quar,
+               "wf_power": float(np.mean(np.abs(wf) ** 2)),
+               "wf_sha": hashlib.sha256(blob).hexdigest()}
+        if save_wavefields:
+            os.makedirs(wf_dir, exist_ok=True)
+            fname = f"{epoch_key}.npy"
+            import io as _io
+
+            buf = _io.BytesIO()
+            np.save(buf, wf)
+            atomic_write_bytes(os.path.join(wf_dir, fname),
+                               buf.getvalue())
+            rec["file"] = os.path.join("wavefields", fname)
+        return rec
+
+    return process
+
+
+def run_wavefield_survey(epochs, workdir, edges, eta, cwf, cwt,
+                         npad=3, tau_mask=0.0, method=None,
+                         save_wavefields=True, **runner_kw):
+    """Campaign-scale PHASE-RETRIEVAL survey: every epoch's complex
+    wavefield retrieved and mosaic-stitched through the full
+    ladder/journal/resume/report stack
+    (robust/runner.py:run_survey) — the flagship θ-θ product
+    (PAPER.md L2: "chunked phase retrieval, mosaic stitch") as a
+    first-class survey workload (ROADMAP item 3).
+
+    ``epochs`` is an iterable of ``(epoch_id, payload)`` where the
+    payload (or the value of a CALLABLE lazy loader — loaded in the
+    pipelined runner's background prefetch queue) is
+    ``(dyn[nf, nt], times[nt], freqs[nf])``. All epochs must share
+    one chunk geometry (``cwf``/``cwt``/``edges`` — the campaign
+    premise), so the whole survey reuses ONE compiled retrieval
+    program and one mosaic program: zero steady-state retraces
+    (pinned by tests/test_retrieval_batch.py). ``eta`` is the
+    campaign curvature at the epoch band centre (per-row frequency
+    scaling is applied per epoch exactly as
+    ``Dynspec.thetatheta_chunks`` does).
+
+    Per-epoch results journal to ``workdir/journal.jsonl`` (scalars:
+    chunk counts, quarantine count, wavefield power + sha) and each
+    stitched wavefield is written atomically to
+    ``workdir/wavefields/<sha>.npy`` (``save_wavefields=False`` to
+    skip). Tier ladder, quarantine, SIGKILL-resume, heartbeat/report
+    knobs: :func:`~scintools_tpu.robust.runner.run_survey` (tiers
+    documented on :func:`_wavefield_survey_fns`)."""
+    from .robust import run_survey
+
+    process = _wavefield_survey_fns(edges, eta, cwf, cwt, npad,
+                                    tau_mask, method, workdir,
+                                    save_wavefields)
+    return run_survey(epochs, process, workdir, **runner_kw)
 
 
 def sort_dyn(dynfiles, outdir=None, min_nsub=10, min_nchan=50,

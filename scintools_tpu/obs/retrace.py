@@ -9,9 +9,12 @@ the CPU host). The existing probes — ``ACF2D_CACHE_STATS``,
 individually. This module generalises the pattern:
 
 - every cached program factory calls :func:`record_build` exactly on
-  a cache MISS (``thth.core.keyed_jit_cache(site=...)``,
+  a cache MISS (``thth.core.keyed_jit_cache(site=...)`` — including
+  the retrieval sites ``thth.retrieval_grid`` /
+  ``thth.retrieval_vlbi`` / ``thth.mosaic`` —
   ``fit/acf2d.py:_batch_program``, ``fit/batch.py:make_acf1d_batch``,
-  the ``parallel/survey.py`` sharded-step factories);
+  the ``parallel/survey.py`` sharded-step factories incl.
+  ``parallel.retrieval_sharded``);
 - :func:`compile_counts` / :func:`snapshot` expose per-site build
   counts and distinct-geometry counts (also mirrored into the metrics
   registry as ``jit_builds_total{site=...}``, so the RunReport and

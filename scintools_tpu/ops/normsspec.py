@@ -15,7 +15,17 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..backend import get_xp, resolve_backend, get_jax
+from ..backend import get_xp, register_formulation, resolve_backend, \
+    get_jax
+from ..backend import formulation as _formulation
+
+# formulation table (backend.py registry): arc-profile row resampling
+# as MXU tent-weight slabs vs index-arithmetic gather interpolation
+register_formulation(
+    "ops.arc_profile_interp", default="tent",
+    choices=("tent", "gather"), platforms={"cpu": "gather"},
+    doc="arc-normalised profile interpolation: tent-weight matmul "
+        "slabs vs the uniform-grid gather interp")
 
 
 @dataclass
@@ -232,8 +242,9 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
     # matmuls vs 0.12 s as the index-arithmetic gather interp
     # (scaled_row_interp's uniform branch, identical np.interp
     # semantics). One geometry-keyed compiled program either way
-    # (ops/fitarc.py:_ARC_PROFILE_CACHE).
-    if jax.default_backend() == "cpu":
+    # (ops/fitarc.py:_ARC_PROFILE_CACHE). Dispatched through the
+    # per-platform formulation registry (backend.py).
+    if _formulation("ops.arc_profile_interp") == "gather":
         uniform = False              # route through the gather interp
     if pallas:
         from .arc_pallas import (make_arc_profile_pallas_fn,

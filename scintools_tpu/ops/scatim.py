@@ -29,25 +29,35 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..backend import get_jax, resolve_backend
+from ..backend import get_jax, register_formulation, resolve_backend
+from ..backend import formulation as _formulation
 
 # compiled query programs keyed on (grid shape, query shape, method)
 _SCATIM_CACHE = {}
+
+# formulation table (backend.py registry): the dense Keys weights ride
+# the MXU; on CPU they are pure overhead (measured 0.130 s matmul vs
+# 0.0016 s gather on the bench 512×256 grid / 33k queries)
+register_formulation(
+    "ops.scatim_interp", default="matmul",
+    choices=("matmul", "gather"), platforms={"cpu": "gather"},
+    doc="scattered-image cubic interpolation: MXU Keys-weight matmuls "
+        "vs fused coalesced 16-tap gathers")
 
 
 def _resolve_method(method, jax):
     """Formulation policy: ``'matmul'`` builds dense per-axis Keys
     weight matrices that ride the MXU; ``'gather'`` stages the 16-tap
     cubic-convolution stencil as ONE fused program of coalesced flat
-    gathers with float32 accumulation — on CPU the dense weights are
-    pure overhead (measured 0.130 s matmul vs 0.0016 s gather on the
-    bench 512×256 grid / 33k queries). ``'auto'`` picks by backend."""
+    gathers with float32 accumulation. ``'auto'`` resolves through the
+    per-platform formulation registry
+    (``backend.formulation('ops.scatim_interp')``)."""
     if method in ("matmul", "gather"):
         return method
     if method not in (None, "auto"):
         raise ValueError(f"method must be 'auto', 'matmul' or "
                          f"'gather', got {method!r}")
-    return "gather" if jax.default_backend() == "cpu" else "matmul"
+    return _formulation("ops.scatim_interp")
 
 
 def _keys_1d(u, xp=np):

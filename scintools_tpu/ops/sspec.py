@@ -14,8 +14,20 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..backend import get_xp, resolve_backend
+from ..backend import get_xp, register_formulation, resolve_backend
+from ..backend import formulation as _formulation
 from .windows import get_window, apply_window
+
+# formulation table (backend.py registry): the chunk conjugate
+# spectrum of REAL input as a half-spectrum rfft2 + Hermitian gather
+# (~half the FFT flops; PR-4 measurement ~2.8× the CS kernel on CPU)
+# vs the complex fft2 oracle. Every platform currently picks 'rfft';
+# the registry entry exists so a measured override
+# (backend.measure_formulation) or env pin can flip it per host.
+register_formulation(
+    "ops.cs", default="rfft", choices=("rfft", "fft2"),
+    doc="chunk conjugate spectrum: rfft2+Hermitian-gather vs complex "
+        "fft2")
 
 
 def fft_shapes(nf, nt):
@@ -118,7 +130,7 @@ def _full_from_rfft2(H, n2, xp=np):
 
 
 def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
-                                   xp=np, method="rfft"):
+                                   xp=np, method=None, shift=True):
     """Batched device-capable chunk conjugate spectrum: per-chunk mean
     pad → ``fft2`` → ``fftshift`` (the θ-θ search's
     ``chunk_conjugate_spectrum`` for a whole same-geometry chunk stack
@@ -132,15 +144,31 @@ def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
     this with ``xp=jnp`` inside one jitted program, so raw chunks are
     the only host→device transfer.
 
-    ``method="rfft"`` (default) exploits the chunks being REAL: a
-    half-spectrum ``rfft2`` plus a Hermitian-symmetry gather
-    (:func:`_full_from_rfft2`) replaces the full complex ``fft2`` —
-    roughly half the FFT flops of the dominant kernel in the staged
-    sspec_thth path, with bit-level-close output (parity rtol-pinned
-    in tests/test_ops.py). ``method="fft2"`` keeps the complex
-    transform as the oracle; complex-valued inputs (wavefield chunks)
-    always take the ``fft2`` path.
+    ``method=None`` (default) resolves through the per-platform
+    formulation registry (``backend.formulation('ops.cs')`` — 'rfft'
+    everywhere unless overridden). ``method="rfft"`` exploits the
+    chunks being REAL: a half-spectrum ``rfft2`` plus a
+    Hermitian-symmetry gather (:func:`_full_from_rfft2`) replaces the
+    full complex ``fft2`` — roughly half the FFT flops of the
+    dominant kernel in the staged sspec_thth path, with
+    bit-level-close output (parity rtol-pinned in tests/test_ops.py).
+    ``method="fft2"`` keeps the complex transform as the oracle;
+    complex-valued inputs (wavefield chunks) always take the ``fft2``
+    path.
+
+    ``shift=False`` skips the final ``fftshift`` and returns the CS
+    in RAW fft layout: the shift is a pure permutation, so a consumer
+    whose access pattern is an index gather (the batched retrieval,
+    thth/retrieval.py) folds it into its index map instead of paying
+    a full-array memory pass — ``tau_keep`` (defined on the shifted
+    axis) is not supported in that mode.
     """
+    if not shift and tau_keep is not None:
+        raise ValueError("tau_keep indexes the SHIFTED delay axis — "
+                         "fold the mask into the consumer's gather "
+                         "when shift=False")
+    if method is None:
+        method = _formulation("ops.cs")
     padded = pad_chunk_batch(dspecs, npad, xp=xp)
     real_input = not np.issubdtype(
         np.dtype(getattr(padded, "dtype", np.float64)),
@@ -153,6 +181,8 @@ def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
     else:
         raise ValueError(f"unknown conjugate-spectrum method "
                          f"{method!r} (want 'rfft' or 'fft2')")
+    if not shift:
+        return CS
     CS = xp.fft.fftshift(CS, axes=(-2, -1))
     if tau_keep is not None:
         CS = xp.where(xp.asarray(tau_keep)[None, :, None], CS,

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 
 from ..backend import get_jax
+from ..backend import donation_argnums as _donation
 from .mesh import (DATA_AXIS, SEQ_AXIS, batch_freq_sharding,
                    chunk_shardings, replicated)
 from .fft import make_sspec_power_sharded, make_fft2_sharded
@@ -82,11 +83,13 @@ def make_fused_grid_search_sharded(mesh, tau, fd, n_edges, nf, nt,
                                  coher=coher, tau_mask=tau_mask,
                                  fw=fw, iters=iters)
     kwargs = {}
-    if jax.default_backend() != "cpu":
+    donate = _donation((0,))
+    if donate is not None:
         # chunk-stack donation: its HBM is recycled into the θ-θ
         # batch. Skipped on CPU (virtual meshes), where XLA cannot
-        # alias it and warns on every compile.
-        kwargs["donate_argnums"] = (0,)
+        # alias it and warns on every compile ('jit.donate'
+        # formulation, backend.py registry).
+        kwargs["donate_argnums"] = donate
     from ..obs import retrace as _retrace
 
     _retrace.record_build(
@@ -220,6 +223,53 @@ def make_acf2d_fit_sharded(mesh, nt_crop, nf_crop, ar, alpha, theta,
                    in_shardings=(sh,) * 6), ndev
 
 
+def make_retrieval_sharded(mesh, nf_chunk, nt_chunk, dt, df, n_edges,
+                           npad=3, method=None, iters=1024,
+                           warm_iters=64):
+    """Chunk-sharded batched PHASE RETRIEVAL: the whole
+    ``single_chunk_retrieval`` pipeline (pad → CS → θ-θ gather →
+    dominant eigenpair → wavefield row → inverse map → ifft2,
+    thth/retrieval.py:make_chunk_retrieval_fn) as one SPMD program
+    with the chunk axis split over every mesh device —
+    ``fn(chunks[B, nf, nt], edges[B, n_edges], etas[B], tau_mask) →
+    (E_ri[B, 2, nf, nt], ok[B])``. ``ok`` is the per-chunk int32
+    health bitmask (robust/guards.py): input-corrupt lanes come back
+    as zero chunks with their neighbours bitwise untouched.
+
+    Per-chunk traced η/edges mean one compile serves every frequency
+    row AND every epoch of a campaign (the retrieval counterpart of
+    :func:`make_fused_grid_search_sharded`); ``method=None`` resolves
+    the eigenpair formulation per platform
+    (``backend.formulation('thth.retrieval_eig')``). B must be
+    divisible by the mesh device count (pad with dummy chunks; their
+    wavefields are dropped)."""
+    jax = get_jax()
+
+    from ..thth.retrieval import (make_chunk_retrieval_fn,
+                                  resolve_retrieval_method)
+
+    method = resolve_retrieval_method(method, n_edges)
+    fn = make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
+                                 npad=npad, method=method,
+                                 iters=iters, warm_iters=warm_iters)
+    kwargs = {}
+    donate = _donation((0,))
+    if donate is not None:
+        kwargs["donate_argnums"] = donate
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.retrieval_sharded",
+        (int(nf_chunk), int(nt_chunk), float(dt), float(df),
+         int(n_edges), int(npad), method, int(iters),
+         int(warm_iters)))
+    return jax.jit(fn,
+                   in_shardings=chunk_shardings(mesh, (3, 2, 1))
+                   + (None,),              # tau_mask scalar
+                   out_shardings=chunk_shardings(mesh, (4, 1)),
+                   **kwargs)
+
+
 def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
     """Sharded θ-θ eigenvalue curve: ``fn(CS_ri, etas) → eigs`` with
     the η grid split over every device of the mesh (CS replicated;
@@ -318,11 +368,12 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
 
     dyn_sh = batch_freq_sharding(mesh)
     kwargs = {}
-    if jax.default_backend() != "cpu":
+    donate = _donation((0,))
+    if donate is not None:
         # donate the epoch stack (cf. make_fused_grid_search_sharded);
         # skipped on CPU/virtual meshes where XLA cannot alias it and
-        # warns on every compile
-        kwargs["donate_argnums"] = (0,)
+        # warns on every compile ('jit.donate' formulation)
+        kwargs["donate_argnums"] = donate
     from ..obs import retrace as _retrace
 
     _retrace.record_build(

@@ -15,7 +15,10 @@ from .search import (single_search, single_search_thin,
                      multi_chunk_search, multi_chunk_search_thin,
                      fit_eig_peak, chi_par)
 from .retrieval import (single_chunk_retrieval, vlbi_chunk_retrieval,
-                        vlbi_retrieval_batch, mosaic, refine_mosaic,
+                        vlbi_retrieval_batch, chunk_retrieval_batch,
+                        grid_retrieval_batch, campaign_retrieval_batch,
+                        mosaic, mosaic_device, make_mosaic_fn,
+                        resolve_retrieval_method, refine_mosaic,
                         gerchberg_saxton, calc_asymmetry, mask_func,
                         err_string)
 from .plots import plot_func
@@ -32,7 +35,9 @@ __all__ = [
     "make_fused_grid_eval_fn", "fit_eig_peak_device",
     "fit_eig_peak_batch_device",
     "single_chunk_retrieval", "vlbi_chunk_retrieval",
-    "vlbi_retrieval_batch", "mosaic",
+    "vlbi_retrieval_batch", "chunk_retrieval_batch",
+    "grid_retrieval_batch", "campaign_retrieval_batch", "mosaic",
+    "mosaic_device", "make_mosaic_fn", "resolve_retrieval_method",
     "refine_mosaic", "gerchberg_saxton", "calc_asymmetry", "mask_func",
     "err_string", "plot_func",
 ]

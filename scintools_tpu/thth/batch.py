@@ -31,7 +31,17 @@ from __future__ import annotations
 import numpy as np
 
 from .core import th_cents_from_edges, unit_checks
-from ..backend import get_jax
+from ..backend import get_jax, register_formulation
+
+# formulation table (backend.py registry): the fused search's
+# eigensolver stage. 'pallas' additionally requires the padded matrix
+# to fit VMEM (resolve_fused_method keeps that guard).
+register_formulation(
+    "thth.eig", default="warm",
+    choices=("warm", "power", "square", "pallas"),
+    platforms={"tpu": "pallas"},
+    doc="fused θ-θ eigensolver: VMEM Pallas squaring kernel vs XLA "
+        "η-scan warm-start vs cold power iteration")
 
 
 def _geometry(tau, fd, edges):
@@ -442,20 +452,27 @@ def make_thin_eval_fn(tau, fd, edges, edges_arclet, center_cut,
 
 
 def resolve_fused_method(method, n_edges):
-    """'auto' for the FUSED search path: the VMEM Pallas kernel on
-    TPU (when the padded matrix fits), else the η-scan warm-start
-    power iteration. NOTE the staged ``make_multi_eval_fn`` resolves
-    'auto' to the cold 'power' iteration off-TPU for back-compat with
-    its callers; the fused path is new code and defaults to the
+    """'auto' for the FUSED search path, resolved through the
+    per-platform formulation registry (``backend.formulation
+    ('thth.eig')``: the VMEM Pallas kernel on TPU, the η-scan
+    warm-start power iteration elsewhere — overridable per host, see
+    backend.py). A 'pallas' resolution still falls back to 'warm'
+    when the padded matrix exceeds VMEM or Mosaic is unavailable.
+    NOTE the staged ``make_multi_eval_fn`` resolves 'auto' to the
+    cold 'power' iteration off-TPU for back-compat with its callers;
+    the fused path is new code and defaults to the
     ~(iters/warm_iters)× cheaper warm scan."""
-    if method != "auto":
-        return method
-    from .pallas_eig import pallas_available, pad_to_multiple
+    from ..backend import formulation
 
-    n_th = int(n_edges) - 1
-    if pallas_available() and pad_to_multiple(n_th) <= 768:
-        return "pallas"
-    return "warm"
+    if method == "auto":
+        method = formulation("thth.eig")
+    if method == "pallas":
+        from .pallas_eig import pallas_available, pad_to_multiple
+
+        n_th = int(n_edges) - 1
+        if not (pallas_available() and pad_to_multiple(n_th) <= 768):
+            return "warm"
+    return method
 
 
 def _chunk_cs_to_ri(dspecs, npad, tau_keep, power, coher):

@@ -254,6 +254,83 @@ def batched_eig_warmstart(a_ri, mid, squarings=10, iters=24,
     return out[:, :, 0, 0]
 
 
+def _make_warm_vec_kernel(mid, squarings, iters):
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(a_ref, lam_ref, v_ref, vr_scr, vi_scr):
+        k = pl_program_id(0)
+        ar = a_ref[0, 0]
+        ai = a_ref[0, 1]
+
+        def cold(_):
+            return _eig_body(ar, ai, mid, squarings, jax, jnp)
+
+        def warm(_):
+            return _warm_body(ar, ai, vr_scr[:], vi_scr[:], iters, jax,
+                              jnp)
+
+        # chunk axis is the sequential grid axis: the dominant
+        # eigenvector of chunk k (VMEM scratch) warm-starts chunk k+1
+        # — half-overlapping retrieval chunks share most of their
+        # θ-θ structure, the chunk-axis analogue of the η-scan
+        # warm start (same stale/cold-restart policy as
+        # _make_warm_kernel)
+        lam, vr, vi, res = jax.lax.cond(k == 0, cold, warm, None)
+        stale = (k > 0) & ((lam < 0.0)
+                           | (res > 0.03 * jnp.abs(lam) + _EPS))
+        lam, vr, vi, res = jax.lax.cond(
+            stale, cold, lambda _: (lam, vr, vi, res), None)
+        vr_scr[:] = vr
+        vi_scr[:] = vi
+        lam_ref[0, :, :] = jnp.full((8, 128), lam, dtype=jnp.float32)
+        n = vr.shape[0]
+        v_ref[0, 0, :, :] = jnp.broadcast_to(vr[:, 0][None, :],
+                                             (8, n))
+        v_ref[0, 1, :, :] = jnp.broadcast_to(vi[:, 0][None, :],
+                                             (8, n))
+
+    return kernel
+
+
+def batched_eigvec_warmstart(a_ri, mid, squarings=10, iters=24,
+                             interpret=False):
+    """Dominant eigenPAIR of a (B, 2, N, N) float32 batch of hermitian
+    matrices, warm-starting each matrix from its predecessor along the
+    batch axis (the retrieval chunk scan — thth/retrieval.py routes
+    here on TPU). Returns ``(lam[B] float32, v_ri[B, 2, N] float32)``
+    — the eigenvector the curvature-search kernels keep private in
+    VMEM scratch is an OUTPUT here, because the retrieval's wavefield
+    row IS the eigenvector. Same stale-detection / in-kernel cold
+    restart policy (and the same near-degeneracy caveat) as
+    :func:`batched_eig_warmstart`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, two, n, n2 = a_ri.shape
+    assert two == 2 and n == n2, "a_ri must be (B, 2, N, N)"
+
+    lam, v = pl.pallas_call(
+        _make_warm_vec_kernel(int(mid), int(squarings), int(iters)),
+        grid=(B,),
+        in_specs=[pl.BlockSpec((1, 2, n, n), lambda b: (b, 0, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((1, 8, 128), lambda b: (b, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 2, 8, n),
+                                lambda b: (b, 0, 0, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((B, 8, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((B, 2, 8, n), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((n, 1), jnp.float32),
+                        pltpu.VMEM((n, 1), jnp.float32)],
+        interpret=interpret,
+    )(a_ri.astype(jnp.float32))
+    return lam[:, 0, 0], v[:, :, 0, :]
+
+
 def batched_eig_pallas(a_ri, mid, squarings=10, interpret=False):
     """Dominant (largest-algebraic) eigenvalues of a batch of hermitian
     matrices.

@@ -22,7 +22,56 @@ import numpy as np
 from .core import (modeler, rev_map, thth_redmap, unit_checks,
                    fft_axis, keyed_jit_cache)
 from .search import chunk_conjugate_spectrum
-from ..backend import get_jax
+from ..backend import get_jax, register_formulation
+# imported at module level so the 'ops.cs' formulation table is
+# registered before any retrieval entry resolves it
+from ..ops.sspec import chunk_conjugate_spectrum_batch
+from ..utils import slog
+
+# formulation table (backend.py registry): the batched retrieval's
+# dominant-eigenpair stage. 'eigh' is the exact dense solve (LAPACK —
+# the right call on CPU, where the matrices are small and the solve is
+# a fraction of the FFT/scatter work); 'warm' carries the eigenvector
+# across the chunk scan (half-overlapping neighbours differ slightly,
+# so ~warm_iters shifted power steps replace a cold solve — the PR-1
+# η-scan warm start applied to the chunk axis); 'pallas' is the same
+# warm-start iteration as a VMEM-resident Mosaic kernel
+# (thth/pallas_eig.py), chosen on TPU when the padded matrix fits.
+register_formulation(
+    "thth.retrieval_eig", default="eigh",
+    choices=("eigh", "power", "warm", "pallas"),
+    platforms={"tpu": "pallas"},
+    doc="batched retrieval eigenpair: dense eigh vs cold power "
+        "iteration vs chunk-scan warm start vs VMEM Pallas kernel")
+
+# the lax.map group-size policy is a formulation too: accelerators
+# want the largest group that fits HBM (amortise dispatch, saturate
+# the chip), the 1-core CPU host wants a small group whose padded-CS
+# working set stays cache-resident (measured on the retrieval_batch
+# bench geometry: group 10 → 487 chunks/s vs group 25 → 403)
+register_formulation(
+    "thth.retrieval_group", default="hbm",
+    choices=("hbm", "cache"), platforms={"cpu": "cache"},
+    doc="retrieval lax.map group sizing: HBM-sized groups vs "
+        "cache-sized groups")
+
+
+def resolve_retrieval_method(method, n_edges):
+    """Resolve the retrieval eigensolver: ``None``/'auto' consults the
+    per-platform formulation registry; a 'pallas' resolution falls
+    back to the XLA 'warm' scan when Mosaic is unavailable or the
+    padded matrix exceeds VMEM (same guard as the fused search)."""
+    from ..backend import formulation
+
+    if method in (None, "auto"):
+        method = formulation("thth.retrieval_eig")
+    if method == "pallas":
+        from .pallas_eig import pallas_available, pad_to_multiple
+
+        if not (pallas_available()
+                and pad_to_multiple(int(n_edges) - 1) <= 768):
+            return "warm"
+    return method
 
 
 def single_chunk_retrieval(dspec, edges, time, freq, eta, idx_t=0,
@@ -30,7 +79,8 @@ def single_chunk_retrieval(dspec, edges, time, freq, eta, idx_t=0,
                            backend=None):
     """Phase retrieval on one chunk (ththmod.py:1390-1476): rank-1
     θ-θ model → wavefield row → inverse map → ifft2. Failures return a
-    zero chunk so one bad chunk doesn't end retrieval."""
+    zero chunk so one bad chunk doesn't end retrieval (structured
+    ``thth.retrieval_error`` slog record instead of a bare print)."""
     dspec = np.asarray(dspec)
     CS, tau, fd = chunk_conjugate_spectrum(dspec, time, freq, npad=npad,
                                            tau_mask=tau_mask)
@@ -44,9 +94,11 @@ def single_chunk_retrieval(dspec, edges, time, freq, eta, idx_t=0,
         model_E = np.fft.ifft2(np.fft.ifftshift(recov_E))[
             : dspec.shape[0], : dspec.shape[1]]
         model_E *= dspec.shape[0] * dspec.shape[1] / 4
-    except Exception as e:
-        if verbose:
-            print(e, flush=True)
+    except Exception as e:  # noqa: BLE001 — zero-chunk quarantine is
+        # the contract; the slog record keeps the cause machine-readable
+        slog.log_failure("thth.retrieval_error", epoch=None,
+                         stage="retrieval", error=e, tier=None,
+                         retry=0, idx_f=int(idx_f), idx_t=int(idx_t))
         model_E = np.zeros(dspec.shape, dtype=complex)
     return model_E, idx_f, idx_t
 
@@ -81,9 +133,9 @@ def vlbi_chunk_retrieval(dspec_list, edges, time, freq, eta, idx_t=0,
     time = np.asarray(unit_checks(time, "time"), dtype=float)
     freq = np.asarray(unit_checks(freq, "freq"), dtype=float)
     eta = float(unit_checks(eta, "eta"))
-    if verbose:
-        print(f"vlbi_chunk_retrieval: chunk ({idx_f},{idx_t}) "
-              f"n_dish={n_dish} eta={eta:.4g}")
+    slog.log_event("thth.retrieval_chunk", idx_f=int(idx_f),
+                   idx_t=int(idx_t), n_dish=int(n_dish), eta=eta,
+                   path="vlbi")
 
     from .core import fft_axis
     fd = fft_axis(time, pad=npad, scale=1e3)
@@ -185,11 +237,17 @@ def _row_hot(valid, dtype, jnp):
 
 
 def _scatter_inverse(ththE, cents, eta, valid, tau, fd, dtau, dfd,
-                     ntau, nfd, jnp):
+                     ntau, nfd, jnp, row_map=None, col_map=None):
     """Inverse map: weighted scatter with valid×valid bin counts —
     the cropped ``rev_map`` (ththmod.py:176-271, hermetian=False) on
     masked fixed shapes. ``ththE[K, n_th, n_th] → recov[K, ntau,
-    nfd]`` (flatten any extra leading axes into K first)."""
+    nfd]`` (flatten any extra leading axes into K first).
+
+    ``row_map``/``col_map`` (optional int arrays of length
+    ntau/nfd): remap the scatter destinations — the batched
+    retrieval passes the inverse-``ifftshift`` permutations so the
+    recovered spectrum lands directly in RAW fft layout and the
+    downstream ``ifftshift`` memory pass never materialises."""
     K = ththE.shape[0]
     fd_map = cents[None, :] - cents[:, None]
     tau_map = eta * (cents[None, :] ** 2 - cents[:, None] ** 2)
@@ -200,22 +258,137 @@ def _scatter_inverse(ththE, cents, eta, valid, tau, fd, dtau, dfd,
           & valid[None, :] & valid[:, None])
     ix = jnp.where(ok, ix, 0).ravel()
     iy = jnp.where(ok, iy, 0).ravel()
+    if col_map is not None:
+        ix = col_map[ix]
+    if row_map is not None:
+        iy = row_map[iy]
     wv = jnp.where(ok[None], wgt, 0.0).reshape(K, -1)
     cnt = ok.astype(float).ravel()
-    acc = jnp.zeros((K, nfd, ntau), dtype=ththE.dtype)
-    acc = acc.at[:, ix, iy].add(wv)
-    norm = jnp.zeros((nfd, ntau)).at[ix, iy].add(cnt)
-    recov = jnp.nan_to_num(acc / norm[None])
-    return jnp.transpose(recov, (0, 2, 1))      # (K, ntau, nfd)
+    # scatter straight into the (tau, fd) output layout — scattering
+    # transposed indices costs nothing, a post-hoc transpose is a
+    # full-canvas memory pass
+    acc = jnp.zeros((K, ntau, nfd), dtype=ththE.dtype)
+    acc = acc.at[:, iy, ix].add(wv)
+    norm = jnp.zeros((ntau, nfd)).at[iy, ix].add(cnt)
+    return jnp.nan_to_num(acc / norm[None])     # (K, ntau, nfd)
+
+
+def _eig_stage(method, iters, warm_iters, squarings, interpret=False):
+    """Build the dominant-eigenpair stage of the batched retrieval:
+    ``eig(A[B, n, n] hermitian complex) → (w[B] ≥ 0, V[B, n])``.
+
+    - ``'eigh'``: dense hermitian eigendecomposition per chunk
+      (LAPACK-exact; matches scipy eigsh up to eigenvector phase).
+    - ``'power'``: cold Gershgorin-shifted power iteration per chunk
+      (``iters`` matvecs, vmapped).
+    - ``'warm'``: a ``lax.scan`` along the CHUNK axis that carries the
+      dominant eigenvector between consecutive chunks — the PR-1
+      warm-start eigensolver (pallas_eig.py ``_eig_body`` cold start /
+      ``_warm_body`` tracking, the exact bodies the TPU kernel runs)
+      applied to half-overlapping retrieval chunks, whose θ-θ
+      matrices differ slightly: ``warm_iters`` shifted power steps
+      replace a cold solve, with the Rayleigh-residual stale check
+      triggering an in-scan cold restart (f32 — the squaring bodies
+      pin float32 accumulation).
+    - ``'pallas'``: the same warm-start scan as a VMEM-resident Mosaic
+      kernel (``batched_eigvec_warmstart``) — each matrix crosses HBM
+      once and the carried eigenvector lives in VMEM scratch.
+
+    Eigenvector global phase is arbitrary in all four (as in the
+    reference — the mosaic phase-aligns chunks)."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    if method == "eigh":
+        def eig(A):
+            lam_all, V_all = jnp.linalg.eigh(A)
+            return jnp.abs(lam_all[:, -1]), V_all[:, :, -1]
+
+        return eig
+
+    if method == "power":
+        from .core import dominant_eig_power
+
+        def eig(A):
+            def one(a):
+                lam, v = dominant_eig_power(a, iters=iters,
+                                            backend="jax")
+                return lam, v
+
+            w, V = jax.vmap(one)(A)
+            return jnp.abs(w), V
+
+        return eig
+
+    if method == "warm":
+        from .pallas_eig import _eig_body, _warm_body
+
+        def eig(A):
+            n = A.shape[-1]
+            mid = n // 2
+            ar_all = jnp.real(A).astype(jnp.float32)
+            ai_all = jnp.imag(A).astype(jnp.float32)
+
+            def cold(ar, ai):
+                return _eig_body(ar, ai, mid, squarings, jax, jnp)
+
+            def step(carry, x):
+                vr0, vi0 = carry
+                ar, ai = x
+                lam, vr, vi, res = _warm_body(ar, ai, vr0, vi0,
+                                              warm_iters, jax, jnp)
+                # stale warm vector (lost branch / sign flip): cold
+                # restart in-scan — same triggers as the TPU kernel
+                stale = (lam < 0.0) | (res > 0.03 * jnp.abs(lam)
+                                       + 1e-30)
+                lam, vr, vi, res = jax.lax.cond(
+                    stale, lambda _: cold(ar, ai),
+                    lambda _: (lam, vr, vi, res), None)
+                return (vr, vi), (lam, vr[:, 0], vi[:, 0])
+
+            # cold start on chunk 0; the scan revisits it warm (one
+            # cheap extra step, same pattern as the η-scan search)
+            _, vr0, vi0, _ = cold(ar_all[0], ai_all[0])
+            _, (lam, vr, vi) = jax.lax.scan(step, (vr0, vi0),
+                                            (ar_all, ai_all))
+            return jnp.abs(lam), (vr + 1j * vi).astype(A.dtype)
+
+        return eig
+
+    if method != "pallas":
+        raise ValueError(f"unknown retrieval method {method!r} "
+                         "(want 'eigh', 'power', 'warm' or 'pallas')")
+
+    from .pallas_eig import batched_eigvec_warmstart, pad_to_multiple
+
+    def eig(A):
+        n = A.shape[-1]
+        n_pad = pad_to_multiple(n)
+        a_ri = jnp.stack([jnp.real(A), jnp.imag(A)],
+                         axis=1).astype(jnp.float32)
+        a_ri = jnp.pad(a_ri, ((0, 0), (0, 0), (0, n_pad - n),
+                              (0, n_pad - n)))
+        lam, v_ri = batched_eigvec_warmstart(
+            a_ri, n // 2, squarings=squarings, iters=warm_iters,
+            interpret=interpret)
+        V = (v_ri[:, 0, :n] + 1j * v_ri[:, 1, :n]).astype(A.dtype)
+        return jnp.abs(lam), V
+
+    return eig
 
 
 def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
-                            npad=3, method="eigh", iters=1024):
+                            npad=3, method="eigh", iters=1024,
+                            warm_iters=64, squarings=10,
+                            cs_method=None, interpret=False):
     """Build the jitted batched retrieval program
-    ``fn(chunks[B, nf, nt], edges[n_edges], eta) → E_ri[B, 2, nf, nt]``
-    — the whole ``single_chunk_retrieval`` pipeline
-    (ththmod.py:1390-1476) as one device program per frequency row of
-    the retrieval grid.
+    ``fn(chunks[B, nf, nt], edges[B, n_edges], etas[B], tau_mask) →
+    (E_ri[B, 2, nf, nt], ok[B])`` — the whole
+    ``single_chunk_retrieval`` pipeline (ththmod.py:1390-1476) as one
+    device program with PER-CHUNK traced geometry (η and edges ride
+    the batch axis, so one compile serves every frequency row of the
+    retrieval grid AND every epoch of a campaign — callers broadcast
+    shared geometry).
 
     Reproduces the reduced-map semantics with *masked fixed shapes*
     (the reference crops the θ-θ to a data-dependent square,
@@ -227,14 +400,27 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
     its bin-count normalisation to valid×valid pairs — bit-matching
     the cropped ``rev_map`` (ththmod.py:176-271).
 
-    ``method='eigh'`` uses dense hermitian eigendecomposition (exact,
-    matches scipy eigsh); ``'power'`` uses the shifted power iteration
-    (``iters`` matvecs, cheaper on large edges grids). Eigenvector
-    global phase is arbitrary in both (as in the reference — the
-    mosaic phase-aligns chunks).
+    The conjugate-spectrum front end routes through the shared CS
+    formulation (ops/sspec.py:chunk_conjugate_spectrum_batch —
+    'rfft'/'fft2' per ``backend.formulation('ops.cs')`` unless
+    ``cs_method`` pins one); the eigenpair stage is selected by
+    ``method`` (:func:`_eig_stage`: 'eigh'/'power'/'warm'/'pallas' —
+    resolve 'auto' with :func:`resolve_retrieval_method`).
+
+    **Health/quarantine** (robust/guards.py, the PR-2 pattern): each
+    chunk carries an int32 ``ok`` bitmask — ``BAD_INPUT`` for
+    non-finite raw pixels (zeroed before the FFT so one NaN cannot
+    poison its lane's spectrum), ``BAD_CS`` for a non-finite conjugate
+    spectrum, ``BAD_CURVE`` for degenerate geometry (non-finite η or
+    an empty valid θ-θ square). Input/CS-corrupt lanes return a ZERO
+    wavefield chunk — the same zero-fill contract as
+    ``single_chunk_retrieval``'s failure path — with every other lane
+    bitwise untouched.
     """
     jax = get_jax()
     import jax.numpy as jnp
+
+    from ..robust import guards
 
     times = np.arange(nt_chunk) * dt
     freqs = np.arange(nf_chunk) * df
@@ -244,10 +430,106 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
     dtau = np.diff(tau).mean()
     dfd = np.diff(fd).mean()
     n_th = n_edges - 1
-    tril_mask = jnp.asarray(np.tril(np.ones((n_th, n_th))) > 0)
-    anti_eye = jnp.asarray(np.eye(n_th)[::-1] > 0)
+    tril_mask = np.tril(np.ones((n_th, n_th))) > 0
+    anti_eye = np.eye(n_th)[::-1] > 0
+    # index-space shifts: the conjugate spectrum's fftshift, the
+    # pre-ifft2 ifftshift, and the |tau| row mask are all pure
+    # permutations/row selections, so they fold into the gather and
+    # scatter index maps — three full-CS memory passes per chunk
+    # never materialise on device (the shifted-layout semantics stay
+    # bit-identical; the host/VLBI paths keep the explicit shifts)
+    shift_tau = np.fft.fftshift(np.arange(ntau))      # shifted→raw
+    shift_fd = np.fft.fftshift(np.arange(nfd))
+    unshift_tau = np.argsort(np.fft.ifftshift(np.arange(ntau)))
+    unshift_fd = np.argsort(np.fft.ifftshift(np.arange(nfd)))
+    eig = _eig_stage(method, iters, warm_iters, squarings,
+                     interpret=interpret)
 
-    def retrieval(chunks, edges, eta, tau_mask):
+    def front_one(chunk, edges, eta, tau_mask):
+        """One sanitised chunk → masked θ-θ matrix (vmapped over the
+        batch; per-chunk edges/η). The CS stays in raw fft layout —
+        and, on the 'rfft' formulation, as the HALF spectrum: the
+        gather reads ~n_th² points, so the Hermitian tail is folded
+        into the index map (conjugate of the mirrored half-plane
+        entry) instead of ever materialising the full complex CS."""
+        cents = (edges[1:] + edges[:-1]) / 2
+        cents = cents - cents[jnp.argmin(jnp.abs(cents))]
+        th1 = cents[None, :] * jnp.ones((n_th, 1))
+        th2 = th1.T
+        tau_inv = jnp.floor((eta * (th1 ** 2 - th2 ** 2) - tau[0]
+                             + dtau / 2) / dtau).astype(int)
+        fd_inv = jnp.floor(((th1 - th2) - fd[0] + dfd / 2)
+                           / dfd).astype(int)
+        pnts = ((tau_inv > 0) & (tau_inv < ntau)
+                & (fd_inv < nfd) & (fd_inv >= -nfd))
+        ti = jnp.where(pnts, tau_inv, 0)
+        # |tau| >= tau_mask applied per gathered row instead of
+        # zeroing whole CS rows (same semantics, no full-array pass)
+        pnts = pnts & (jnp.abs(jnp.asarray(tau)[ti]) >= tau_mask)
+        rr = jnp.asarray(shift_tau)[ti]
+        cc = jnp.asarray(shift_fd)[fd_inv % nfd]
+        if cs_method == "rfft":
+            # pruned padded rfft2: mean-padding is zeropad(x-µ)+µ and
+            # the FFT of the constant µ-canvas is a pure DC term, so
+            # (a) the axis-1 rfft runs on the nf data rows only (the
+            # zero rows transform to zero — appended, not computed),
+            # (b) µ re-enters as one scalar at H[0,0]. Exact up to
+            # f32 rounding; ~(1+npad)× less axis-1 FFT work.
+            mu = jnp.mean(chunk)
+            r1 = jnp.fft.rfft(chunk - mu, n=nfd, axis=1)
+            r1 = jnp.pad(r1, ((0, npad * nf_chunk), (0, 0)))
+            H = jnp.fft.fft(r1, axis=0)
+            H = H.at[0, 0].add(mu * ntau * nfd)
+            m = nfd // 2 + 1
+            tail = cc >= m
+            # full[r, c] = conj(H[(-r) % ntau, nfd - c]) for c >= m
+            v = H[jnp.where(tail, (ntau - rr) % ntau, rr),
+                  jnp.where(tail, nfd - cc, cc)]
+            vals = jnp.where(tail, jnp.conj(v), v)
+            cs_ok = jnp.all(jnp.isfinite(jnp.real(H))
+                            & jnp.isfinite(jnp.imag(H)))
+        else:
+            CS = chunk_conjugate_spectrum_batch(
+                chunk[None], npad=npad, xp=jnp, method=cs_method,
+                shift=False)[0]
+            vals = CS[rr, cc]
+            cs_ok = jnp.all(jnp.isfinite(jnp.real(CS))
+                            & jnp.isfinite(jnp.imag(CS)))
+        thth = jnp.where(pnts, vals, 0.0)
+        thth = thth * jnp.sqrt(jnp.abs(2 * eta * (th2 - th1)))
+        thth = _hermitian_sym(thth, jnp.asarray(tril_mask),
+                              jnp.asarray(anti_eye), jnp)
+        thth = jnp.nan_to_num(thth)
+        # reduced-map valid square (ththmod.py:151-155), as a mask
+        valid = ((cents ** 2 * eta < jnp.abs(tau).max())
+                 & (jnp.abs(cents) < jnp.abs(fd).max() / 2))
+        thth = thth * valid[None, :] * valid[:, None]
+        return thth, valid, cs_ok
+
+    def back_one(w, V, valid, edges, eta):
+        """Eigenpair → wavefield chunk (vmapped; per-chunk geometry):
+        wavefield row at the cropped path's middle bin → inverse-map
+        scatter (landing directly in raw fft layout) → ifft2
+        (ththmod.py:1445-1468)."""
+        cents = (edges[1:] + edges[:-1]) / 2
+        cents = cents - cents[jnp.argmin(jnp.abs(cents))]
+        row_hot = _row_hot(valid, V.dtype, jnp)
+        ththE = row_hot[:, None] * (jnp.conj(V)
+                                    * jnp.sqrt(w))[None, :]
+        recov = _scatter_inverse(
+            ththE[None], cents, eta, valid, tau, fd, dtau, dfd,
+            ntau, nfd, jnp, row_map=jnp.asarray(unshift_tau),
+            col_map=jnp.asarray(unshift_fd))[0]
+        # ifft2 split per axis with the row crop in between: only
+        # nf_chunk of the (1+npad)·nf output rows survive, so the
+        # second transform runs on 1/(1+npad) of the rows — exact,
+        # the crop commutes with the remaining per-row transform
+        E = jnp.fft.ifft(recov, axis=0)[:nf_chunk]
+        E = jnp.fft.ifft(E, axis=1)[:, :nt_chunk]
+        E = E * (nf_chunk * nt_chunk / 4)
+        return jnp.nan_to_num(E)
+
+    def retrieval(chunks, edges_b, etas_b, tau_mask):
         # trace-time precision pin: on TPU the default f32 matmul
         # drops operands to bf16 on the MXU, and the eigendecomposition
         # underneath the rank-1 model is matmul-built — full f32
@@ -255,70 +537,29 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
         # the platform's FFT precision imposes (tools/tpu_smoke.py
         # gates it); CPU is unaffected (highest is already native)
         with jax.default_matmul_precision("highest"):
-            return _retrieval_body(chunks, edges, eta, tau_mask)
+            return _retrieval_body(chunks, edges_b, etas_b, tau_mask)
 
-    def _retrieval_body(chunks, edges, eta, tau_mask):
-        # --- pad (mean fill) → conjugate spectra (ththmod.py:777-786)
-        mu = jnp.mean(chunks, axis=(1, 2), keepdims=True)
-        support = jnp.pad(jnp.ones((nf_chunk, nt_chunk)),
-                          ((0, npad * nf_chunk), (0, npad * nt_chunk)))
-        padded = jnp.where(
-            support[None] > 0,
-            jnp.pad(chunks, ((0, 0), (0, npad * nf_chunk),
-                             (0, npad * nt_chunk))),
-            mu)
-        CS = jnp.fft.fftshift(jnp.fft.fft2(padded), axes=(1, 2))
-        CS = jnp.where(
-            (jnp.abs(jnp.asarray(tau)) >= tau_mask)[None, :, None],
-            CS, 0.0)
-
-        # --- θ-θ build, chunk-minor gather (shared η across the row)
-        cents = (edges[1:] + edges[:-1]) / 2
-        cents = cents - cents[jnp.argmin(jnp.abs(cents))]
-        CS_c = jnp.transpose(CS, (1, 2, 0))          # (ntau, nfd, B)
-        thth = _thth_gather(CS_c, cents, eta, tau, fd, dtau, dfd,
-                            ntau, nfd, jnp)
-        thth = _hermitian_sym(thth, tril_mask, anti_eye, jnp)
-        thth = jnp.nan_to_num(thth)
-        # reduced-map valid square (ththmod.py:151-155), as a mask
-        valid = ((cents ** 2 * eta < jnp.abs(tau).max())
-                 & (jnp.abs(cents) < jnp.abs(fd).max() / 2))
-        thth = thth * valid[None, :, None] * valid[:, None, None]
-
-        # --- dominant eigenpair per chunk (ththmod.py:274-327)
-        A = jnp.transpose(thth, (2, 0, 1))           # (B, n, n)
-        if method == "eigh":
-            lam_all, V_all = jnp.linalg.eigh(A)
-            w = lam_all[:, -1]
-            V = V_all[:, :, -1]
-        else:
-            from .core import dominant_eig_power
-
-            def one(a):
-                lam, v = dominant_eig_power(a, iters=iters,
-                                            backend="jax")
-                return lam, v
-
-            w, V = jax.vmap(one)(A)
-        w = jnp.abs(w)
-        V = V * valid[None, :]
-
-        # --- wavefield row at the cropped path's middle bin ----------
-        row_hot = _row_hot(valid, CS.dtype, jnp)
-        ththE = (row_hot[:, None]
-                 * (jnp.conj(V) * jnp.sqrt(w)[:, None])[:, None, :])
-        # (B, n_row, n_col)
-
-        # --- inverse map (shared masked rev_map scatter) -------------
-        recov = _scatter_inverse(ththE, cents, eta, valid, tau, fd,
-                                 dtau, dfd, ntau, nfd, jnp)
-
-        # --- wavefield chunk (ththmod.py:1462-1468) ------------------
-        E = jnp.fft.ifft2(jnp.fft.ifftshift(recov, axes=(1, 2)),
-                          axes=(1, 2))[:, :nf_chunk, :nt_chunk]
-        E = E * (nf_chunk * nt_chunk / 4)
-        E = jnp.nan_to_num(E)
-        return jnp.stack([E.real, E.imag], axis=1)
+    def _retrieval_body(chunks, edges_b, etas_b, tau_mask):
+        in_ok = guards.chunk_finite_ok(chunks, xp=jnp)
+        chunks = guards.sanitize_chunks(chunks, xp=jnp)
+        thth, valid, cs_ok = jax.vmap(
+            front_one, in_axes=(0, 0, 0, None))(chunks, edges_b,
+                                                etas_b, tau_mask)
+        w, V = eig(thth)                      # (B,), (B, n)
+        V = V * valid
+        E = jax.vmap(back_one)(w, V, valid, edges_b, etas_b)
+        # degenerate geometry: non-finite η or an empty valid square
+        # leaves nothing to retrieve (the host path's thth_redmap
+        # ValueError) — the guards bit says why the chunk is zero
+        geom_ok = (jnp.isfinite(etas_b)
+                   & (jnp.sum(valid, axis=1) >= 3))
+        ok = guards.health_code(input_ok=in_ok, cs_ok=cs_ok,
+                                curve_ok=geom_ok, xp=jnp)
+        # quarantine: corrupt lanes zero-fill (the host failure
+        # contract), neighbours bitwise untouched
+        healthy_in = in_ok & cs_ok
+        E = jnp.where(healthy_in[:, None, None], E, 0.0)
+        return jnp.stack([E.real, E.imag], axis=1), ok
 
     return retrieval
 
@@ -479,7 +720,8 @@ def vlbi_retrieval_batch(dspecs, edges, eta, dt, df, n_dish, npad=3,
     fn = keyed_jit_cache(
         _RETRIEVAL_JIT_CACHE, key,
         lambda: make_vlbi_retrieval_fn(nf_chunk, nt_chunk, dt, df,
-                                       len(edges), n_dish, npad=npad))
+                                       len(edges), n_dish, npad=npad),
+        site="thth.retrieval_vlbi")
     pad = (-B) % ndev
     d_in = np.concatenate([dspecs] + [dspecs[-1:]] * pad) \
         if pad else dspecs
@@ -503,11 +745,13 @@ _RETRIEVAL_JIT_CACHE = {}
 
 def chunk_retrieval_batch(chunks, edges, eta, dt, df, npad=3,
                           tau_mask=0.0, method="eigh", iters=1024,
-                          mesh=None):
+                          warm_iters=64, mesh=None, with_ok=False):
     """Jitted batched retrieval of one frequency row of chunks:
     ``chunks[B, nf, nt]`` → complex wavefield chunks ``[B, nf, nt]``
-    (host numpy). One compile per chunk geometry — edges/η are traced,
-    so every row of the retrieval grid reuses the same program.
+    (host numpy; ``with_ok=True`` additionally returns the per-chunk
+    health bitmask ``ok[B]``, robust/guards.py). One compile per chunk
+    geometry — edges/η are traced, so every row of the retrieval grid
+    reuses the same program.
 
     ``mesh``: optional ``jax.sharding.Mesh`` — the chunk batch axis is
     sharded over EVERY mesh device (the SPMD replacement for the
@@ -524,12 +768,13 @@ def chunk_retrieval_batch(chunks, edges, eta, dt, df, npad=3,
         chunks, np.tile(edges, (B, 1)),
         np.full(B, float(unit_checks(eta, "eta"))), dt, df,
         npad=npad, tau_mask=tau_mask, method=method, iters=iters,
-        mesh=mesh)
+        warm_iters=warm_iters, mesh=mesh, with_ok=with_ok)
 
 
 def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
                          tau_mask=0.0, method="eigh", iters=1024,
-                         mesh=None, group=None):
+                         warm_iters=64, mesh=None, group=None,
+                         with_ok=False, device_out=False):
     """Whole-retrieval-grid program: ``chunks[N, nf, nt]`` with
     PER-CHUNK ``edges_per[N, n_edges]`` and ``etas_per[N]`` → complex
     wavefield chunks ``[N, nf, nt]``. One jitted dispatch for the
@@ -538,7 +783,21 @@ def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
     live intermediates the way bench.py's north-star pipeline does)
     and each group shardable over every mesh device — the end-state
     SPMD form of the reference's retrieval pool.map
-    (dynspec.py:1812-1826).
+    (dynspec.py:1812-1826). A whole campaign flattens its epochs into
+    this same chunk axis (:func:`campaign_retrieval_batch`) — the
+    geometry key is shared, so E epochs cost zero extra compiles.
+
+    ``method``: the eigenpair formulation — ``None``/'auto' resolves
+    per platform through ``backend.formulation('thth.retrieval_eig')``
+    (:func:`resolve_retrieval_method`: dense 'eigh' on CPU, the
+    VMEM Pallas warm-start kernel on TPU, XLA 'warm' chunk-scan
+    fallback). ``with_ok=True`` returns ``(E, ok[N])`` with the
+    per-chunk health bitmask (robust/guards.py — input-corrupt lanes
+    come back as ZERO chunks, neighbours untouched). With
+    ``device_out=True`` the result stays an in-flight device array of
+    stacked (real, imag) floats ``(N, 2, nf, nt)`` — feed it straight
+    to :func:`mosaic_device` so chunks → stitched wavefield never
+    round-trips to host.
 
     ``group`` (chunks live per ``lax.map`` step, the HBM working-set
     knob) defaults to: the whole batch when ≤ max(32, n_devices);
@@ -548,25 +807,38 @@ def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
     jax = get_jax()
     import jax.numpy as jnp
 
+    from ..backend import donation_argnums, formulation
+
     chunks = np.asarray(chunks, dtype=float)
     N, nf_chunk, nt_chunk = chunks.shape
     edges_per = np.asarray(edges_per, dtype=float)
     etas_per = np.asarray(etas_per, dtype=float)
+    method = resolve_retrieval_method(method, edges_per.shape[1])
+    cs_method = formulation("ops.cs")
     ndev = (int(np.prod(list(mesh.shape.values())))
             if mesh is not None else 1)
+    if group is None and formulation("thth.retrieval_group") \
+            == "cache":
+        # cache-sized groups ('thth.retrieval_group' formulation,
+        # CPU): small fixed groups keep each lax.map step's padded-CS
+        # working set cache-resident — measured on the
+        # retrieval_batch bench geometry (100 × 64²-chunk, npad 3):
+        # group 8 → 574 chunks/s vs the HBM-sized group 25 → 403.
+        # The ≤7-lane zero pad is cheaper than the cache misses.
+        group = max(8, ndev)
     if group is None:
-        # zero-waste group choice: one batch when it fits under the
-        # HBM cap; else the largest non-trivial divisor of the
+        # zero-waste HBM group choice: one batch when it fits under
+        # the cap; else the largest non-trivial divisor of the
         # (device-multiple-padded) batch; else balanced ceil groups
         # (pad < n_steps) — never a degenerate group of 1 for a large
-        # batch and never cap-1 discarded retrievals
+        # batch and never cap-1 discarded retrievals.
         cap = max(32, ndev)
         n_p = max(N, 1) + ((-max(N, 1)) % ndev)
         if n_p <= cap:
             group = n_p               # one batch, device-pad only
         else:
-            floor = max(ndev, 8)
-            divisors = [g for g in range(floor, cap + 1)
+            floor_g = max(ndev, 8)
+            divisors = [g for g in range(floor_g, cap + 1)
                         if n_p % g == 0 and g % ndev == 0]
             if divisors:
                 group = divisors[-1]
@@ -578,21 +850,19 @@ def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
     group += (-group) % ndev            # device multiple
     key = ("grid", nf_chunk, nt_chunk, float(dt), float(df),
            edges_per.shape[1], int(npad), method, int(iters),
-           int(group))
+           int(warm_iters), cs_method, int(group))
 
     def build():
-        core = make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df,
-                                       edges_per.shape[1], npad=npad,
-                                       method=method, iters=iters)
-
-        def one(c, e, et, tm):
-            return core(c[None], e, et, tm)[0]
-
-        vm = jax.vmap(one, in_axes=(0, 0, 0, None))
+        core = make_chunk_retrieval_fn(
+            nf_chunk, nt_chunk, dt, df, edges_per.shape[1],
+            npad=npad, method=method, iters=iters,
+            warm_iters=warm_iters, cs_method=cs_method)
         return lambda cg, eg, etg, tm: jax.lax.map(
-            lambda args: vm(*args, tm), (cg, eg, etg))
+            lambda args: core(*args, tm), (cg, eg, etg))
 
-    fn = keyed_jit_cache(_RETRIEVAL_JIT_CACHE, key, build)
+    fn = keyed_jit_cache(_RETRIEVAL_JIT_CACHE, key, build,
+                         donate_argnums=donation_argnums((0,)),
+                         site="thth.retrieval_grid")
 
     pad_n = (-N) % group
     if pad_n:                           # host-side pad: each shard of
@@ -619,9 +889,20 @@ def grid_retrieval_batch(chunks, edges_per, etas_per, dt, df, npad=3,
         etg = put(etg, P(None, axes))
     else:
         cg, eg, etg = map(jnp.asarray, (cg, eg, etg))
-    E_ri = np.asarray(fn(cg, eg, etg, float(tau_mask)))
-    E_ri = E_ri.reshape(ng * group, 2, nf_chunk, nt_chunk)[:N]
-    return E_ri[:, 0] + 1j * E_ri[:, 1]
+    E_ri_dev, ok_dev = fn(cg, eg, etg, float(tau_mask))
+    E_ri_dev = E_ri_dev.reshape(ng * group, 2, nf_chunk,
+                                nt_chunk)[:N]
+    ok_dev = ok_dev.reshape(ng * group)[:N]
+    if device_out:
+        # still in flight: the device-native mosaic (or any other
+        # consumer program) picks these up without a host round trip
+        return (E_ri_dev, ok_dev) if with_ok else E_ri_dev
+    E_ri = np.asarray(E_ri_dev)  # sync-ok: host API — callers
+    # consume numpy wavefield chunks at this boundary
+    E = E_ri[:, 0] + 1j * E_ri[:, 1]
+    if with_ok:
+        return E, np.asarray(ok_dev)  # sync-ok: same host boundary
+    return E
 
 
 # --------------------------------------------------------------------------
@@ -675,6 +956,145 @@ def mosaic(chunks):
 def _masks_array(ncf, nct, cwf, cwt):
     return np.array([[chunk_mask(cf, ct, ncf, nct, cwf, cwt)
                       for ct in range(nct)] for cf in range(ncf)])
+
+
+def make_mosaic_fn(ncf, nct, cwf, cwt):
+    """Build the DEVICE mosaic: the greedy phase-aligned half-overlap
+    stitch (:func:`mosaic`, ththmod.py:1492-1554) as one jitted
+    ``lax.scan`` over the chunk grid, vmapped over a leading epoch
+    axis — ``fn(chunks_ri[E, ncf·nct, 2, cwf, cwt]) →
+    E_ri[E, 2, F, T]``.
+
+    The scan reproduces the greedy algorithm exactly: chunks are
+    visited row-major, each phase-aligned against the canvas
+    accumulated so far (``rot = arg⟨E_old · conj(E_new) · mask⟩``;
+    ``arg 0 = 0`` matches numpy's first-chunk behaviour), so the
+    numpy loop stays the bit-level oracle. Compile time is O(1) in
+    grid size (one scan body), and the input is the stacked
+    (real, imag) float wire format — feed it the still-in-flight
+    product of ``grid_retrieval_batch(device_out=True)`` and the
+    campaign wavefield is stitched without the chunks ever visiting
+    the host."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    masks = _masks_array(ncf, nct, cwf, cwt).reshape(ncf * nct, cwf,
+                                                     cwt)
+    shape = mosaic_shape(ncf, nct, cwf, cwt)
+
+    def one(chunks_ri):
+        flat = chunks_ri[:, 0] + 1j * chunks_ri[:, 1]
+        masks_j = jnp.asarray(masks, dtype=chunks_ri.dtype)
+
+        def body(E, xs):
+            k, chunk, mask = xs
+            r0 = (k // nct) * (cwf // 2)
+            c0 = (k % nct) * (cwt // 2)
+            old = jax.lax.dynamic_slice(E, (r0, c0), (cwf, cwt))
+            rot = jnp.angle(jnp.mean(old * jnp.conj(chunk) * mask))
+            new = old + chunk * mask * jnp.exp(1j * rot)
+            return jax.lax.dynamic_update_slice(E, new, (r0, c0)), None
+
+        E0 = jnp.zeros(shape, dtype=flat.dtype)
+        E, _ = jax.lax.scan(body, E0, (jnp.arange(ncf * nct), flat,
+                                       masks_j))
+        return jnp.stack([E.real, E.imag])
+
+    return jax.vmap(one)
+
+
+_MOSAIC_JIT_CACHE = {}
+
+
+def mosaic_device(chunks, grid_shape=None):
+    """Host entry for the device mosaic: phase-aligned overlap-add of
+    half-overlapping wavefield chunks as ONE jitted program (cached
+    per grid geometry, ``thth.mosaic`` retrace site).
+
+    Accepts either a complex ``(ncf, nct, cwf, cwt)`` host array (the
+    :func:`mosaic` input shape) or the stacked-float device product of
+    ``grid_retrieval_batch(device_out=True)`` — ``(N, 2, cwf, cwt)``
+    with ``grid_shape=(ncf, nct)`` (optionally with a leading epoch
+    axis ``(E, N, 2, cwf, cwt)`` → stitched ``(E, F, T)``). Returns
+    complex numpy. The greedy numpy :func:`mosaic` is the oracle
+    (tests/test_retrieval_batch.py pins parity)."""
+    import jax.numpy as jnp
+
+    epoch_axis = True
+    if grid_shape is None:                      # host complex chunks
+        chunks = np.asarray(chunks)
+        ncf, nct, cwf, cwt = chunks.shape
+        chunks_ri = jnp.asarray(np.stack(
+            [chunks.real, chunks.imag], axis=2).reshape(
+                1, ncf * nct, 2, cwf, cwt))
+        epoch_axis = False
+    else:
+        ncf, nct = map(int, grid_shape)
+        if chunks.ndim == 4:                    # (N, 2, cwf, cwt)
+            chunks_ri = chunks[None]
+            epoch_axis = False
+        else:
+            chunks_ri = chunks
+        if chunks_ri.shape[1] != ncf * nct:
+            raise ValueError(
+                f"got {chunks_ri.shape[1]} chunks for a "
+                f"{ncf}x{nct} grid")
+        cwf, cwt = chunks_ri.shape[-2:]
+    key = ("mosaic", ncf, nct, cwf, cwt)
+    fn = keyed_jit_cache(_MOSAIC_JIT_CACHE, key,
+                         lambda: make_mosaic_fn(ncf, nct, cwf, cwt),
+                         site="thth.mosaic")
+    E_ri = np.asarray(fn(chunks_ri))  # sync-ok: host API — the
+    # stitched wavefield is the consumed end product
+    E = E_ri[:, 0] + 1j * E_ri[:, 1]
+    return E if epoch_axis else E[0]
+
+
+def campaign_retrieval_batch(chunks, edges_per, etas_per, dt, df,
+                             npad=3, tau_mask=0.0, method=None,
+                             iters=1024, warm_iters=64, mesh=None,
+                             group=None, stitch=True):
+    """Campaign-scale phase retrieval: a whole observing campaign's
+    half-overlap chunk grids → per-epoch stitched complex wavefields,
+    with the epoch axis vmapped into the SAME geometry-keyed programs
+    as a single epoch (zero extra compiles; ROADMAP item 3).
+
+    ``chunks[E, ncf, nct, cwf, cwt]`` raw dynspec chunks;
+    ``edges_per`` broadcastable to ``(E, ncf, n_edges)`` (frequency
+    rows may carry scaled edges) and ``etas_per`` to ``(E, ncf)`` —
+    i.e. pass ``(ncf, n_edges)``/``(ncf,)`` when every epoch shares
+    the grid, scalars broadcast too. Returns
+    ``(wavefields[E, F, T] complex, ok[E, ncf, nct])`` when
+    ``stitch`` (device-native mosaic — retrieval output feeds the
+    stitch as an in-flight device array), else
+    ``(chunk wavefields[E, ncf, nct, cwf, cwt], ok)``.
+
+    The chunk axis (E·ncf·nct flattened) shards over ``mesh`` and is
+    walked in HBM-sized groups exactly as
+    :func:`grid_retrieval_batch` (which this wraps)."""
+    chunks = np.asarray(chunks, dtype=float)
+    E_ep, ncf, nct, cwf, cwt = chunks.shape
+    edges_per = np.asarray(edges_per, dtype=float)
+    n_edges = edges_per.shape[-1]
+    edges_b = np.broadcast_to(edges_per,
+                              (E_ep, ncf, n_edges))
+    etas_b = np.broadcast_to(np.asarray(etas_per, dtype=float),
+                             (E_ep, ncf))
+    flat = chunks.reshape(E_ep * ncf * nct, cwf, cwt)
+    edges_flat = np.repeat(edges_b.reshape(E_ep * ncf, n_edges),
+                           nct, axis=0)
+    etas_flat = np.repeat(etas_b.reshape(E_ep * ncf), nct)
+    out = grid_retrieval_batch(
+        flat, edges_flat, etas_flat, dt, df, npad=npad,
+        tau_mask=tau_mask, method=method, iters=iters,
+        warm_iters=warm_iters, mesh=mesh, group=group, with_ok=True,
+        device_out=stitch)
+    E_chunks, ok = out
+    ok = np.asarray(ok).reshape(E_ep, ncf, nct)
+    if not stitch:
+        return (E_chunks.reshape(E_ep, ncf, nct, cwf, cwt), ok)
+    E_ri = E_chunks.reshape(E_ep, ncf * nct, 2, cwf, cwt)
+    return mosaic_device(E_ri, grid_shape=(ncf, nct)), ok
 
 
 def rot_mos(chunks, x):

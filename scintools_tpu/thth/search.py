@@ -258,10 +258,11 @@ def _chunk_donation():
     """Donate the chunk-stack buffer to the fused program on
     accelerators (its HBM is recycled into the θ-θ batch). Skipped on
     CPU, where XLA cannot alias it into the complex intermediates and
-    warns 'donated buffers were not usable' on every compile."""
-    from ..backend import get_jax
+    warns 'donated buffers were not usable' on every compile — the
+    'jit.donate' formulation (backend.py registry)."""
+    from ..backend import donation_argnums
 
-    return (0,) if get_jax().default_backend() != "cpu" else None
+    return donation_argnums((0,))
 
 
 def _stack_chunks(dspecs):

@@ -149,3 +149,94 @@ class TestCompilationCacheGuards:
             == str(2 * 1024 ** 3)
         assert "jax_compilation_cache_max_size" \
             not in fake.config.updates
+
+
+class TestFormulationDispatch:
+    """Per-platform formulation registry (ISSUE 7): one inspectable,
+    overridable table instead of ad-hoc ``default_backend() == ...``
+    branches in each op module."""
+
+    def _registered(self):
+        backend.register_formulation(
+            "test.op", default="a", choices=("a", "b"),
+            platforms={"tpu": "b"})
+
+    def test_resolution_order(self, monkeypatch):
+        self._registered()
+        # platform table beats default; default used off-table
+        assert backend.formulation("test.op", platform="tpu") == "b"
+        assert backend.formulation("test.op", platform="cpu") == "a"
+        # env beats platform
+        monkeypatch.setenv("SCINTOOLS_FORMULATION_TEST_OP", "b")
+        assert backend.formulation("test.op", platform="cpu") == "b"
+        # manual/measured override beats env
+        backend.set_formulation("test.op", "a")
+        try:
+            assert backend.formulation("test.op", platform="tpu") \
+                == "a"
+        finally:
+            backend.set_formulation("test.op", None)
+
+    def test_invalid_values_are_loud(self, monkeypatch):
+        self._registered()
+        with pytest.raises(KeyError, match="unregistered"):
+            backend.formulation("no.such.op")
+        with pytest.raises(ValueError, match="not one of"):
+            backend.set_formulation("test.op", "zzz")
+        monkeypatch.setenv("SCINTOOLS_FORMULATION_TEST_OP", "zzz")
+        with pytest.raises(ValueError, match="env formulation"):
+            backend.formulation("test.op")
+        with pytest.raises(ValueError, match="not in"):
+            backend.register_formulation("bad.op", default="x",
+                                         choices=("y",))
+
+    def test_measured_override_pins_winner(self):
+        import time
+
+        self._registered()
+
+        def slow():
+            time.sleep(0.02)
+
+        try:
+            winner, timings = backend.measure_formulation(
+                "test.op", {"a": slow, "b": lambda: None}, repeats=1)
+            assert winner == "b"
+            assert timings["a"] > timings["b"]
+            assert backend.formulation("test.op", platform="cpu") \
+                == "b"
+            from scintools_tpu.utils import slog
+
+            recs = slog.recent(event="backend.formulation_measured")
+            assert recs and recs[-1]["winner"] == "b"
+        finally:
+            backend.set_formulation("test.op", None)
+
+    def test_known_ops_registered(self):
+        # importing the op modules registers their tables
+        import scintools_tpu.ops.normsspec   # noqa: F401
+        import scintools_tpu.ops.scatim      # noqa: F401
+        import scintools_tpu.ops.sspec       # noqa: F401
+        import scintools_tpu.thth.batch      # noqa: F401
+        import scintools_tpu.thth.retrieval  # noqa: F401
+
+        snap = backend.formulation_snapshot()
+        for op in ("ops.cs", "ops.scatim_interp",
+                   "ops.arc_profile_interp", "thth.eig",
+                   "thth.retrieval_eig", "jit.donate"):
+            assert op in snap, op
+            assert snap[op]["active"] in snap[op]["choices"]
+        # the CPU host routes the MXU formulations to their gather /
+        # host-friendly forms
+        assert snap["ops.scatim_interp"]["active"] == "gather"
+        assert snap["thth.retrieval_eig"]["active"] == "eigh"
+        assert snap["jit.donate"]["active"] == "off"
+
+    def test_donation_argnums_gate(self):
+        # CPU: donation off → None; override flips it
+        assert backend.donation_argnums((0,)) is None
+        backend.set_formulation("jit.donate", "on")
+        try:
+            assert backend.donation_argnums((0, 1)) == (0, 1)
+        finally:
+            backend.set_formulation("jit.donate", None)

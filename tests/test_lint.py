@@ -124,13 +124,20 @@ class TestSyncpoints:
     def test_hot_paths_are_clean(self):
         lint = _tool("lint_syncpoints")
         violations = []
-        # serve/ joined the scan in ISSUE 6: the daemon's HTTP
-        # handlers and watcher threads must never fence in-flight
-        # device values (a scrape that syncs the dispatch queue
-        # would stall the stream it is observing)
-        for d in ("ops", "fit", "thth", "parallel", "serve"):
+        # serve/ joined the scan in ISSUE 6; robust/ and obs/ in
+        # ISSUE 7 (the runner/ladder drive in-flight device values
+        # through the retrieval survey and must never fence them
+        # mid-pipeline)
+        for d in ("ops", "fit", "thth", "parallel", "serve",
+                  "robust", "obs"):
             violations.extend(lint.scan_tree(
                 os.path.join(REPO, "scintools_tpu", d)))
+        # dynspec.py joined in ISSUE 7: the survey entries
+        # (run_psrflux_survey / run_wavefield_survey) and the
+        # device-native retrieval path live here — eager fetches of
+        # in-flight values would serialise the pipelined runner
+        violations.extend(lint.scan_file(
+            os.path.join(REPO, "scintools_tpu", "dynspec.py")))
         assert violations == [], (
             "premature device-sync points in library hot paths "
             f"(fence only at consumption boundaries): {violations}")

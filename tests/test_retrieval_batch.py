@@ -1,0 +1,413 @@
+"""Batched device-native phase retrieval + on-device mosaic (ISSUE 7).
+
+Pins the campaign retrieval stack: batched-vs-looped wavefield parity
+across eigensolver formulations and dtypes, per-chunk quarantine with
+bitwise-untouched neighbours, device-vs-numpy mosaic parity, the
+geometry-keyed compile accounting (a 2-geometry campaign compiles
+exactly twice), and journal/SIGKILL-resume of a wavefield survey run.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from scintools_tpu.backend import set_default_backend
+from scintools_tpu.robust import guards
+from scintools_tpu.thth.retrieval import (campaign_retrieval_batch,
+                                          chunk_retrieval_batch,
+                                          grid_retrieval_batch,
+                                          make_chunk_retrieval_fn,
+                                          make_mosaic_fn, mosaic,
+                                          mosaic_device,
+                                          resolve_retrieval_method,
+                                          single_chunk_retrieval)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ETA_TRUE = 0.3
+
+
+def make_arc_chunks(n_chunks=3, nt=64, nf=64, dt=30.0, df=0.2,
+                    f0=1400.0, npix=8, seed=2):
+    """Small synthetic dynspec chunks carrying a known-curvature arc
+    (the test_thth.py screen, shrunk): parity against the looped host
+    retrieval is only meaningful when the dominant eigenvector is
+    well-separated, i.e. on arc-structured data (pure noise has a
+    near-degenerate top eigenspace where the two formulations may pick
+    different vectors)."""
+    rng = np.random.default_rng(seed)
+    times = np.arange(nt) * dt
+    freqs = f0 + np.arange(nf) * df
+    dfd_pad = 1e3 / (2 * nt * dt)
+    fd_k = np.arange(-npix, npix + 1) * dfd_pad
+    tau_k = ETA_TRUE * fd_k ** 2
+    amps = ((0.05 + 0.3 * rng.random(len(fd_k))
+             * np.exp(-(fd_k / 1.2) ** 2))
+            * np.exp(2j * np.pi * rng.random(len(fd_k))))
+    amps[len(fd_k) // 2] = 3.0
+    F, T = np.meshgrid(freqs - f0, times, indexing="ij")
+    E = np.zeros((nf, nt), dtype=complex)
+    for a, td, fdk in zip(amps, tau_k, fd_k):
+        E += a * np.exp(2j * np.pi * (td * F + fdk * 1e-3 * T))
+    dspec0 = np.abs(E) ** 2
+    chunks = np.stack([dspec0 + 1e-9 * i * rng.standard_normal(
+        dspec0.shape) for i in range(n_chunks)])
+    edges = np.arange(-10.5, 11.5) * dfd_pad
+    return chunks, times, freqs, edges
+
+
+@pytest.fixture(scope="module")
+def arc_batch():
+    set_default_backend("jax")
+    return make_arc_chunks()
+
+
+def _aligned_corr(E_ref, E):
+    """|⟨E, E_ref⟩| / (‖E‖·‖E_ref‖) — eigenvector global phase is
+    arbitrary, so correlate up to one complex rotation."""
+    num = np.abs(np.vdot(E, E_ref))
+    den = np.linalg.norm(E) * np.linalg.norm(E_ref) + 1e-300
+    return num / den
+
+
+class TestBatchedParity:
+    """Batched program vs the looped host ``single_chunk_retrieval``
+    across eigensolver formulations."""
+
+    @pytest.mark.parametrize("method", ["eigh", "power", "warm"])
+    def test_matches_looped_host(self, arc_batch, method):
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        E_host = [single_chunk_retrieval(c, edges, times, freqs,
+                                         ETA_TRUE, npad=1,
+                                         backend="numpy")[0]
+                  for c in chunks]
+        E_batch, ok = chunk_retrieval_batch(
+            chunks, edges, ETA_TRUE, dt, df, npad=1, method=method,
+            with_ok=True)
+        assert ok.tolist() == [guards.OK] * len(chunks)
+        # the warm scan is f32 by construction (the TPU kernel's
+        # bodies); eigh/power run in the ambient x64 here
+        floor = 0.999 if method != "warm" else 0.995
+        for b, ref in enumerate(E_host):
+            corr = _aligned_corr(ref, E_batch[b])
+            assert corr > floor, f"{method} chunk {b}: corr {corr}"
+
+    @pytest.mark.parametrize("method", ["eigh", "power"])
+    def test_f32_program_matches_f64(self, arc_batch, method):
+        """The production (non-x64) path runs float32: feeding the
+        cached program f32 inputs must agree with the f64 trace of
+        the same geometry to single precision."""
+        import jax.numpy as jnp
+
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        B = len(chunks)
+        fn = make_chunk_retrieval_fn(
+            chunks.shape[1], chunks.shape[2], dt, df, len(edges),
+            npad=1, method=method)
+        edges_b = np.tile(edges, (B, 1))
+        etas_b = np.full(B, ETA_TRUE)
+        E64, ok64 = fn(jnp.asarray(chunks),
+                       jnp.asarray(edges_b), jnp.asarray(etas_b), 0.0)
+        E32, ok32 = fn(jnp.asarray(chunks, dtype=jnp.float32),
+                       jnp.asarray(edges_b, dtype=jnp.float32),
+                       jnp.asarray(etas_b, dtype=jnp.float32), 0.0)
+        assert np.asarray(ok64).tolist() == [0] * B
+        assert np.asarray(ok32).tolist() == [0] * B
+        e64 = np.asarray(E64[:, 0] + 1j * E64[:, 1])
+        e32 = np.asarray(E32[:, 0] + 1j * E32[:, 1])
+        for b in range(B):
+            # single-precision FFT + eigendecomposition on a
+            # high-dynamic-range arc leaves ~1% vector drift — the
+            # same envelope tools/tpu_smoke.py gates on-chip
+            assert _aligned_corr(e64[b], e32[b]) > 0.98
+
+    def test_auto_method_resolves_by_platform(self):
+        # CPU host: the registry default is the exact dense solve;
+        # 'pallas' degrades to the XLA warm scan off-TPU
+        assert resolve_retrieval_method(None, 64) == "eigh"
+        assert resolve_retrieval_method("auto", 64) == "eigh"
+        assert resolve_retrieval_method("pallas", 64) == "warm"
+        assert resolve_retrieval_method("power", 64) == "power"
+
+    def test_pallas_interpret_matches_eigh(self, arc_batch):
+        """The vector-output Mosaic kernel (interpret mode on CPU)
+        agrees with the dense solve — the TPU routing is the same
+        kernel on hardware."""
+        import jax.numpy as jnp
+
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        B = len(chunks)
+        edges_b = np.tile(edges, (B, 1))
+        etas_b = np.full(B, ETA_TRUE)
+        args = (jnp.asarray(chunks, dtype=jnp.float32),
+                jnp.asarray(edges_b), jnp.asarray(etas_b), 0.0)
+        fn_ref = make_chunk_retrieval_fn(
+            chunks.shape[1], chunks.shape[2], dt, df, len(edges),
+            npad=1, method="eigh")
+        fn_pal = make_chunk_retrieval_fn(
+            chunks.shape[1], chunks.shape[2], dt, df, len(edges),
+            npad=1, method="pallas", warm_iters=24, interpret=True)
+        E_ref, _ = fn_ref(*args)
+        E_pal, ok = fn_pal(*args)
+        assert np.asarray(ok).tolist() == [0] * B
+        er = np.asarray(E_ref[:, 0] + 1j * E_ref[:, 1])
+        ep = np.asarray(E_pal[:, 0] + 1j * E_pal[:, 1])
+        for b in range(B):
+            assert _aligned_corr(er[b], ep[b]) > 0.99
+
+
+class TestQuarantine:
+    """One corrupt chunk zero-fills with its guards bit set; every
+    other lane is BITWISE what the clean run produced."""
+
+    @pytest.mark.parametrize("poison", [np.nan, -np.inf])
+    def test_bad_chunk_isolated(self, arc_batch, poison):
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        clean, ok0 = chunk_retrieval_batch(
+            chunks, edges, ETA_TRUE, dt, df, npad=1, with_ok=True)
+        bad = chunks.copy()
+        bad[1, 5, 7] = poison
+        got, ok = chunk_retrieval_batch(
+            bad, edges, ETA_TRUE, dt, df, npad=1, with_ok=True)
+        assert ok0.tolist() == [guards.OK] * len(chunks)
+        assert ok[1] & guards.BAD_INPUT
+        assert np.all(got[1] == 0)           # zero-fill contract
+        for b in (0, 2):
+            assert np.array_equal(got[b], clean[b])   # bitwise
+
+    def test_nonfinite_eta_flagged_not_fatal(self, arc_batch):
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        B = len(chunks)
+        etas = np.full(B, ETA_TRUE)
+        etas[2] = np.nan                     # failed upstream η fit
+        E, ok = grid_retrieval_batch(
+            chunks, np.tile(edges, (B, 1)), etas, dt, df, npad=1,
+            with_ok=True)
+        assert ok[2] & guards.BAD_CURVE
+        assert np.all(E[2] == 0)
+        assert ok[0] == guards.OK and ok[1] == guards.OK
+        assert np.any(E[0] != 0)
+
+
+class TestDeviceMosaic:
+    def test_matches_numpy_oracle(self, rng):
+        ncf, nct, cwf, cwt = 3, 4, 16, 16
+        chunks = (rng.normal(size=(ncf, nct, cwf, cwt))
+                  + 1j * rng.normal(size=(ncf, nct, cwf, cwt)))
+        want = mosaic(chunks)
+        got = mosaic_device(chunks)
+        np.testing.assert_allclose(got, want, rtol=1e-9,
+                                   atol=1e-9 * np.abs(want).max())
+
+    def test_single_row_and_column_grids(self, rng):
+        # boundary masks degenerate at grid edges — 1×N and N×1 grids
+        for shape in ((1, 3), (3, 1), (1, 1)):
+            chunks = (rng.normal(size=shape + (8, 8))
+                      + 1j * rng.normal(size=shape + (8, 8)))
+            np.testing.assert_allclose(
+                mosaic_device(chunks), mosaic(chunks), rtol=1e-9,
+                atol=1e-12)
+
+    def test_epoch_batched_stitch(self, rng):
+        ncf, nct, cwf, cwt = 2, 3, 8, 8
+        import jax.numpy as jnp
+
+        eps = (rng.normal(size=(2, ncf, nct, cwf, cwt))
+               + 1j * rng.normal(size=(2, ncf, nct, cwf, cwt)))
+        ri = jnp.asarray(np.stack([eps.real, eps.imag], axis=3)
+                         .reshape(2, ncf * nct, 2, cwf, cwt))
+        got = mosaic_device(ri, grid_shape=(ncf, nct))
+        assert got.shape[0] == 2
+        for e in range(2):
+            np.testing.assert_allclose(got[e], mosaic(eps[e]),
+                                       rtol=1e-9, atol=1e-12)
+
+    def test_device_chain_no_host_roundtrip(self, arc_batch):
+        """grid_retrieval_batch(device_out=True) → mosaic_device
+        equals the all-host composition."""
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        B = len(chunks)
+        grid_shape = (1, B)
+        E_host, _ = grid_retrieval_batch(
+            chunks, np.tile(edges, (B, 1)), np.full(B, ETA_TRUE),
+            dt, df, npad=1, with_ok=True)
+        want = mosaic(E_host.reshape(grid_shape + E_host.shape[1:]))
+        E_dev, ok_dev = grid_retrieval_batch(
+            chunks, np.tile(edges, (B, 1)), np.full(B, ETA_TRUE),
+            dt, df, npad=1, with_ok=True, device_out=True)
+        import jax
+
+        assert isinstance(E_dev, jax.Array)   # still in flight
+        got = mosaic_device(E_dev, grid_shape=grid_shape)
+        np.testing.assert_allclose(got, want, rtol=1e-9,
+                                   atol=1e-9 * np.abs(want).max())
+
+
+class TestCampaignRetrace:
+    """The geometry-keyed cache: a 2-geometry campaign builds exactly
+    two retrieval programs (+ their mosaics), and re-running the whole
+    campaign is retrace-free — the run_survey wrapper inherits this."""
+
+    def test_two_geometry_campaign_compiles_twice(self, arc_batch):
+        from scintools_tpu.obs import retrace
+
+        chunks, times, freqs, edges = arc_batch
+        # two distinct geometries, keyed unique by these dt values so
+        # earlier tests in the process can't have warmed them
+        geoms = [(31.25, 0.2), (33.125, 0.25)]
+
+        def run_campaign():
+            for dt, df in geoms:
+                camp = np.stack([chunks[:2].reshape(1, 2, 64, 64)] * 2)
+                campaign_retrieval_batch(
+                    camp, np.tile(edges, (1, 1)),
+                    np.full(1, ETA_TRUE), dt, df, npad=1)
+
+        before = retrace.compile_counts()
+        run_campaign()
+        after = retrace.compile_counts()
+        grew = {s: after.get(s, 0) - before.get(s, 0)
+                for s in ("thth.retrieval_grid", "thth.mosaic")}
+        assert grew["thth.retrieval_grid"] == 2, grew
+        assert grew["thth.mosaic"] == 1, grew   # one grid shape
+        # steady state: the SAME campaign again must hit every cache
+        with retrace.retrace_guard(sites=("thth.retrieval_grid",
+                                          "thth.mosaic")):
+            run_campaign()
+
+
+class TestShardedFactory:
+    def test_make_retrieval_sharded_matches_plain(self, arc_batch):
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        import jax.numpy as jnp
+
+        from scintools_tpu import parallel as par
+        from scintools_tpu.parallel.survey import make_retrieval_sharded
+
+        chunks, times, freqs, edges = arc_batch
+        dt, df = times[1] - times[0], freqs[1] - freqs[0]
+        mesh = par.make_mesh(8)
+        fn = make_retrieval_sharded(mesh, 64, 64, dt, df, len(edges),
+                                    npad=1)
+        B = 8                                  # device multiple
+        stack = np.concatenate([chunks] * 3)[:B]
+        E_ri, ok = fn(jnp.asarray(stack),
+                      jnp.asarray(np.tile(edges, (B, 1))),
+                      jnp.asarray(np.full(B, ETA_TRUE)), 0.0)
+        got = np.asarray(E_ri[:, 0] + 1j * E_ri[:, 1])
+        assert np.asarray(ok).tolist() == [0] * B
+        want, _ = grid_retrieval_batch(
+            stack, np.tile(edges, (B, 1)), np.full(B, ETA_TRUE),
+            dt, df, npad=1, with_ok=True)
+        for b in range(B):
+            assert _aligned_corr(want[b], got[b]) > 0.9999
+
+
+class TestRetrievalEvents:
+    def test_host_failure_emits_slog_record(self):
+        """The bare-print diagnostic is gone: a failed chunk logs a
+        cataloged ``thth.retrieval_error`` record."""
+        from scintools_tpu.utils import slog
+
+        dspec = np.random.default_rng(0).normal(size=(16, 16))
+        times = np.arange(16.0)
+        freqs = 1400 + 0.1 * np.arange(16)
+        edges = np.linspace(-1, 1, 8)
+        out, _, _ = single_chunk_retrieval(
+            dspec, edges, times, freqs, np.nan, backend="numpy")
+        assert np.all(out == 0)
+        recs = slog.recent(event="thth.retrieval_error")
+        assert recs and recs[-1]["stage"] == "retrieval"
+
+
+_WF_KILL_DRIVER = r"""
+import json, os, sys
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, os.path.join({repo!r}, "tests"))
+from scintools_tpu.backend import set_default_backend
+set_default_backend("jax")
+from scintools_tpu.dynspec import run_wavefield_survey
+from test_retrieval_batch import make_arc_chunks, ETA_TRUE
+
+workdir, kill_after = sys.argv[1], int(sys.argv[2])
+chunks, times, freqs, edges = make_arc_chunks(n_chunks=5)
+count = {{"n": 0}}
+epochs = []
+for i in range(5):
+    def loader(i=i):
+        return chunks[i], times, freqs
+    epochs.append((f"ep{{i}}", loader))
+
+
+def validate(res):
+    # in-order consumption hook: a real SIGKILL mid-epoch, after
+    # kill_after epochs completed + journaled
+    if kill_after >= 0 and count["n"] == kill_after:
+        os.kill(os.getpid(), 9)
+    count["n"] += 1
+    return True
+
+
+out = run_wavefield_survey(epochs, workdir, edges, ETA_TRUE,
+                           cwf=32, cwt=32, npad=1, validate=validate)
+with open(os.path.join(workdir, "final.json"), "w") as fh:
+    json.dump({{k: out["results"][k] for k in sorted(out["results"])}},
+              fh, sort_keys=True)
+print("RESUMED", out["summary"]["n_resumed"])
+"""
+
+
+class TestWavefieldSurveyResume:
+    """Acceptance: a wavefield survey killed with a real SIGKILL
+    mid-run resumes from its journal to results — journal scalars AND
+    wavefield artifacts — identical to an uninterrupted run."""
+
+    def _run(self, script, workdir, kill_after):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, script, str(workdir), str(kill_after)],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+
+    def test_sigkill_resume_identical(self, tmp_path):
+        from scintools_tpu.parallel.checkpoint import EpochJournal
+
+        script = tmp_path / "driver.py"
+        script.write_text(_WF_KILL_DRIVER.format(repo=REPO))
+        interrupted = tmp_path / "interrupted"
+        uninterrupted = tmp_path / "uninterrupted"
+
+        r = self._run(script, interrupted, kill_after=2)
+        assert r.returncode == -signal.SIGKILL, r.stderr[-2000:]
+        n_done = len(EpochJournal(interrupted / "journal.jsonl"))
+        assert 0 < n_done < 5
+
+        r = self._run(script, interrupted, kill_after=-1)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert f"RESUMED {n_done}" in r.stdout
+
+        r = self._run(script, uninterrupted, kill_after=-1)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert ((interrupted / "final.json").read_text()
+                == (uninterrupted / "final.json").read_text())
+        # the stitched wavefield artifacts are byte-identical too
+        a = sorted((interrupted / "wavefields").iterdir())
+        b = sorted((uninterrupted / "wavefields").iterdir())
+        assert [p.name for p in a] == [p.name for p in b] and a
+        for pa, pb in zip(a, b):
+            assert pa.read_bytes() == pb.read_bytes()
