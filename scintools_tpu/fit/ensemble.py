@@ -108,6 +108,8 @@ def make_ensemble_sampler(logp, nwalkers, ndim, a=2.0):
             step, (pos0, lp0), keys)
         return chain, logps, jnp.sum(n_acc) / (steps * nwalkers)
 
+    # lint-ok: retrace-hazard: one-shot build per sample_emcee_jax
+    # call (a user-facing sampler entry, not a per-epoch survey path)
     return jax.jit(run, static_argnames="steps")
 
 

@@ -161,6 +161,18 @@ def make_arc_fit_batch_fn(tdel, fdop, delmax=None, startbin=3, cutmid=3,
     fdop = np.asarray(fdop, dtype=float)
     numsteps = int(numsteps) + int(numsteps) % 2
     H = numsteps // 2
+    # every call builds a fresh program (callers cache per geometry —
+    # ops/fitarc.py:_ARC_PROFILE_CACHE), so each entry is one
+    # accounted build for the retrace gate
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "ops.arc_fit_device",
+        (tdel.tobytes(), fdop.tobytes(),
+         None if delmax is None else float(delmax), int(startbin),
+         int(cutmid), numsteps, int(nsmooth), float(low_power_diff),
+         float(high_power_diff), tuple(map(float, constraint)),
+         bool(noise_error)))
     if nsmooth % 2 != 1 or nsmooth < 3:
         raise ValueError("nsmooth must be an odd window >= 3 "
                          "(scipy savgol_filter requirement)")

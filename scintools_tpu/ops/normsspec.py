@@ -133,6 +133,17 @@ def make_arc_profile_batch_fn(tdel, fdop, delmax=None, startbin=1,
     mode off-TPU so tests exercise the identical kernel.
     """
     jax = get_jax()
+    # every call builds a fresh program (callers cache per geometry —
+    # ops/fitarc.py:_ARC_PROFILE_CACHE), so each entry is one
+    # accounted build for the retrace gate
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "ops.arc_profile",
+        (np.asarray(tdel).tobytes(), np.asarray(fdop).tobytes(),
+         None if delmax is None else float(delmax), int(startbin),
+         int(cutmid), int(numsteps), float(maxnormfac), bool(fold),
+         None if pallas is None else bool(pallas)))
     import jax.numpy as jnp
 
     tdel = np.asarray(tdel, dtype=float)

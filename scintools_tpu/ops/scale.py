@@ -60,6 +60,39 @@ def velocity_rescale(dyn, veff):
     return columnwise_cubic_interp(dyn, vc_orig, vc_new, axis=1)
 
 
+# jitted row-resample program per time axis: a fresh
+# jax.jit(jax.vmap(row)) closure per call would retrace every rescale
+# (the wrapper closes over the interp grid, and jax.jit caches on
+# function identity — the fit/batch.py PR-4 trap)
+_TRAPEZOID_CACHE = {}
+
+
+def _trapezoid_program(times):
+    """Cached jitted ``fn(X[nf, nt], dyn[nf, nt], valid[nf, nt])`` —
+    the vmapped masked row interpolation of :func:`trapezoid_rescale`,
+    keyed on the (concrete) time axis it closes over; jit's own
+    per-signature cache handles shape changes."""
+    key = times.tobytes()
+    fn = _TRAPEZOID_CACHE.get(key)
+    if fn is None:
+        from ..backend import get_jax
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("ops.trapezoid_rescale", key)
+        jax = get_jax()
+        import jax.numpy as jnp
+
+        t_j = jnp.asarray(times)
+
+        def row(x, d, v):
+            return jnp.where(v, jnp.interp(x, t_j, d), 0.0)
+
+        if len(_TRAPEZOID_CACHE) >= 8:
+            _TRAPEZOID_CACHE.pop(next(iter(_TRAPEZOID_CACHE)))
+        fn = _TRAPEZOID_CACHE[key] = jax.jit(jax.vmap(row))
+    return fn
+
+
 def trapezoid_rescale(dyn, times, freqs, window="hanning",
                       window_frac=0.1, backend=None):
     """Trapezoid scaling: per-frequency-row time resampling with
@@ -96,7 +129,6 @@ def trapezoid_rescale(dyn, times, freqs, window="hanning",
                 [newline, np.zeros(nt - n_in[ii])])
         return out
 
-    import jax
     import jax.numpy as jnp
 
     j = np.arange(nt)
@@ -105,11 +137,8 @@ def trapezoid_rescale(dyn, times, freqs, window="hanning",
     X = np.min(times) + j[None, :] * (np.max(times)
                                       - np.min(times)) / denom
     valid = j[None, :] < n_in[:, None]
-    t_j = jnp.asarray(times)
 
-    def row(x, d, v):
-        return jnp.where(v, jnp.interp(x, t_j, d), 0.0)
-
-    return np.asarray(jax.jit(jax.vmap(row))(  # sync-ok: eager host
+    fn = _trapezoid_program(times)
+    return np.asarray(fn(  # sync-ok: eager host
         # API — the resampled dynspec is this function's return value
         jnp.asarray(X), jnp.asarray(dyn), jnp.asarray(valid)))

@@ -84,6 +84,12 @@ def make_gs_sharded(mesh):
     sh3 = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
     sh4 = NamedSharding(mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
     repl = NamedSharding(mesh, P())
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.gs_sharded",
+        (tuple(d.id for d in np.ravel(mesh.devices)),
+         tuple(mesh.axis_names), tuple(mesh.shape.values())))
     return jax.jit(gs, in_shardings=(sh4, sh3, sh3, repl, None),
                    out_shardings=sh4)
 

@@ -49,6 +49,11 @@ def make_thth_grid_search_sharded(mesh, tau, fd, n_edges, iters=64):
 
     fn = make_grid_eval_fn(tau, fd, n_edges, iters=iters)
     chunk_sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.grid_search_sharded",
+        (tau.tobytes(), fd.tobytes(), int(n_edges), int(iters)))
     return jax.jit(fn, in_shardings=(chunk_sh, chunk_sh, chunk_sh),
                    out_shardings=chunk_sh)
 
@@ -123,6 +128,12 @@ def make_thth_thin_grid_search_sharded(mesh, tau, fd, n_edges,
     fn = make_thin_grid_eval_fn(tau, fd, n_edges, n_arclet_edges,
                                 center_cut, iters=iters)
     chunk_sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.thin_grid_search_sharded",
+        (tau.tobytes(), fd.tobytes(), int(n_edges),
+         int(n_arclet_edges), float(center_cut), int(iters)))
     return jax.jit(fn, in_shardings=(chunk_sh,) * 4,
                    out_shardings=chunk_sh)
 
@@ -149,6 +160,13 @@ def make_arc_profile_sharded(mesh, tdel, fdop, delmax=None,
                                    pallas=False)
     sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     ndev = int(np.prod(list(mesh.shape.values())))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.arc_profile_sharded",
+        (np.asarray(tdel).tobytes(), np.asarray(fdop).tobytes(),
+         None if delmax is None else float(delmax), int(startbin),
+         int(cutmid), int(numsteps), bool(fold)))
     return jax.jit(fn, in_shardings=(sh, sh),
                    out_shardings=sh), ndev
 
@@ -180,6 +198,15 @@ def make_arc_fit_sharded(mesh, tdel, fdop, delmax=None, startbin=3,
         noise_error=noise_error, pallas=False)
     sh = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
     ndev = int(np.prod(list(mesh.shape.values())))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.arc_fit_sharded",
+        (np.asarray(tdel).tobytes(), np.asarray(fdop).tobytes(),
+         None if delmax is None else float(delmax), int(startbin),
+         int(cutmid), int(numsteps), int(nsmooth),
+         float(low_power_diff), float(high_power_diff),
+         tuple(map(float, constraint)), bool(noise_error)))
     return jax.jit(fn, in_shardings=(sh, sh, sh),
                    out_shardings=(sh, sh)), ndev
 
@@ -281,6 +308,11 @@ def make_eta_search_sharded(mesh, tau, fd, edges, iters=64):
 
     eval_fn = make_eval_fn(tau, fd, edges, iters=iters)
     eta_sharding = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.eta_search_sharded",
+        (tau.tobytes(), fd.tobytes(), edges.tobytes(), int(iters)))
     return jax.jit(eval_fn,
                    in_shardings=(replicated(mesh), eta_sharding),
                    out_shardings=eta_sharding)

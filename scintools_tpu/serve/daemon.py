@@ -191,6 +191,9 @@ class SurveyService:
         if self._http is not None:
             self._http.close()
         if self._error is not None:
+            # lint-ok: lock-discipline: the loop thread is joined
+            # above, so its final _error write happens-before this
+            # read-and-clear (Thread.join is the synchronisation)
             err, self._error = self._error, None
             raise RuntimeError("serve loop failed") from err
         return self
@@ -280,6 +283,8 @@ class SurveyService:
         except Exception as e:  # noqa: BLE001 — the loop must die
             # loudly: surfaced by /healthz (loop no longer ticking),
             # re-raised from stop()
+            # lint-ok: lock-discipline: single-writer — only the loop
+            # thread assigns _error; stop() reads it after join()
             self._error = e
             slog.log_failure("serve.loop_error", stage="loop", error=e)
         finally:
@@ -295,6 +300,10 @@ class SurveyService:
             return
         try:
             self._warmup_fn()
+            # lint-ok: lock-discipline: _warm is a monotonic
+            # False→True latch written only by the loop thread;
+            # /readyz reads it racily by design (a stale False is a
+            # harmless not-ready-yet)
             self._warm = True
         except Exception as e:  # noqa: BLE001 — warm-up is advisory
             slog.log_failure("serve.warmup_error", stage="warmup",
@@ -362,6 +371,10 @@ class SurveyService:
             st = self._states.get(key, {})
             st["status"] = "in_flight"
         if not loaded.ok:
+            # lint-ok: lock-discipline: the dispatch window is
+            # loop-thread-only (single producer AND consumer —
+            # _dispatch/_consume_one both run in _loop); wait_idle
+            # only reads truthiness
             self._window.append(
                 (key, None,
                  _runner._loader_outcome(key, loaded.error), None))
@@ -370,9 +383,12 @@ class SurveyService:
             entry = _runner._dispatch_first(
                 key, loaded.payload, self.process, self.tiers,
                 self.retries, self.validate)
+        # lint-ok: lock-discipline: loop-thread-only window (above)
         self._window.append(entry)
 
     def _consume_one(self):
+        # lint-ok: lock-discipline: loop-thread-only window (see
+        # _dispatch)
         epoch_id, payload, value, report = self._window.popleft()
         if isinstance(value, EpochOutcome):    # already decided
             out = value
@@ -407,6 +423,8 @@ class SurveyService:
             self._inflight_sha.pop(st.get("sha"), None)
         self.timeline.record(key, "publish", t0, time.perf_counter())
         if out.status == "ok":
+            # lint-ok: lock-discipline: monotonic False→True latch,
+            # loop-thread-only writer (see _warmup)
             self._warm = True
 
     def _update_gauges(self):

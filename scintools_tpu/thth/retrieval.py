@@ -1221,6 +1221,9 @@ def refine_mosaic(chunks, dspec=None, noise=None, mode="rot",
     else:
         raise ValueError("mode must be 'rot' or 'full'")
 
+    # lint-ok: retrace-hazard: one-shot objective build per VLBI
+    # mosaic optimisation (host L-BFGS loop reuses it; not a per-epoch
+    # path)
     obj_grad = jax.jit(jax.value_and_grad(objective))
 
     def fun(x):

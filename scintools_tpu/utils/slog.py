@@ -149,6 +149,10 @@ def log_event(event, **fields):
     configured. Each record is stamped with the emitting ``pid``."""
     rec = {"t": round(time.time(), 3), "pid": os.getpid(),
            "event": event, **fields}
+    # lint-ok: lock-discipline: deque.append is atomic under the GIL
+    # (single C-level op, bounded maxlen); _LOCK only serialises the
+    # file-sink handle, and taking it here would put every event on
+    # the survey hot path behind the writer
     _RECENT.append(rec)
     if not enabled():
         return

@@ -1,36 +1,60 @@
-"""Repo lints as tier-1 gates.
+"""Repo lints as tier-1 gates — one unified jaxlint pass (ISSUE 8).
 
-- tools/lint_excepts.py (ISSUE 2 satellite) forbids bare ``except:``
-  and silent ``except Exception: pass`` in scintools_tpu/ — the two
-  patterns that defeat the robust survey layer by hiding failures the
-  quarantine / fallback machinery is supposed to see and report.
-- tools/lint_import_jit.py (ISSUE 3 satellite) forbids import-time
-  ``jax.jit`` in scintools_tpu/fit/ — compiled programs must be built
-  lazily inside cached factories so cold-start and test collection
-  stay fast (and cannot hang on a dead accelerator tunnel).
-- tools/lint_syncpoints.py (ISSUE 4 satellite) forbids premature
-  device-sync points (``.block_until_ready``, eager ``np.asarray`` on
-  in-flight device values) in the library hot paths ``ops/``,
-  ``fit/``, ``thth/``, ``parallel/`` — the pipelined survey engine
-  only overlaps host and device work if the dispatch chain stays
-  async. Deliberate result-consumption boundaries carry a
-  ``# sync-ok: <reason>`` marker; utils/profiling.py (whose job IS
-  fencing) is allowlisted.
-- tools/lint_obs_events.py (ISSUE 5 satellite) requires every
-  ``slog.log_event``/``log_failure``/``span`` event name in the
-  package to appear in the documented catalog
-  (docs/observability.md) — the event stream is a stable interface,
-  not a place for drive-by unnamed events. Non-literal names carry
-  an ``# obs-event-ok: <name>`` marker.
+The four standalone lints of ISSUEs 2–5 (exception hygiene,
+import-time jit, sync points, obs-event catalog) plus the three
+analyzers new in ISSUE 8 (retrace-hazard, lock-discipline,
+jit-boundary) now run as ONE framework pass over ``scintools_tpu/``:
+each file is parsed exactly once (pinned here by the parse-count
+probe) and every registered rule walks the shared tree. The legacy
+script entry points (``tools/lint_*.py``) survive as thin shims and
+are exercised below.
+
+Gates in this file:
+
+- the merged tree is CLEAN under all rules (zero unexplained
+  findings — deliberate ones carry ``# lint-ok:`` / legacy markers);
+- the self-check: ≥ 7 active rules, nonzero files scanned in every
+  package (a broken rule or an empty scan fails loudly instead of
+  silently passing), one parse per file;
+- the unified single-parse pass is not slower than the old four-pass
+  scheme (wall-time recorded in the runner's JSON output);
+- the four legacy shims still detect their classic fixtures and
+  still exit 1 on violations.
+
+The per-rule golden fixture corpus lives in tests/test_jaxlint.py.
 """
 
 import importlib.util
+import json
 import os
+import subprocess
+import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.jaxlint import (Config, FileContext, RULES,  # noqa: E402
+                           run as jaxlint_run)
+from tools.jaxlint.formats import render_json  # noqa: E402
+
+PKG = os.path.join(REPO, "scintools_tpu")
+
+# every subpackage the self-check requires nonzero scanned files in
+# ("." is the package root: dynspec.py, backend.py, ...)
+EXPECTED_PACKAGES = {"fit", "io", "obs", "ops", "parallel", "robust",
+                     "serve", "sim", "thth", "utils", "."}
+
+# the legacy scan targets of the old four-pass scheme, per script
+LEGACY_SYNC_DIRS = ("ops", "fit", "thth", "parallel", "serve",
+                    "robust", "obs")
 
 
 def _tool(name):
+    """Load a legacy shim exactly the way the old suite did — by file
+    path, outside any package context (the shims must bootstrap
+    themselves)."""
     spec = importlib.util.spec_from_file_location(
         name, os.path.join(REPO, "tools", name + ".py"))
     mod = importlib.util.module_from_spec(spec)
@@ -38,222 +62,176 @@ def _tool(name):
     return mod
 
 
-def _lint():
-    return _tool("lint_excepts")
+def _unified(**kw):
+    return jaxlint_run([PKG], config=Config(repo_root=REPO), **kw)
 
 
-def test_package_is_clean():
-    lint = _lint()
-    violations = lint.scan_tree(os.path.join(REPO, "scintools_tpu"))
-    assert violations == [], (
-        "exception-hygiene violations (bare except / silent "
-        f"swallow-all): {violations}")
+class TestUnifiedGate:
+    """The acceptance gate: ``python -m tools.jaxlint scintools_tpu/``
+    exits 0 on the merged tree with ≥ 7 active rules."""
+
+    def test_package_is_clean_under_all_rules(self):
+        rep = _unified()
+        assert rep.findings == [], (
+            "jaxlint findings on the tree (fix them or annotate "
+            "deliberate ones with '# lint-ok: <rule>: <reason>'):\n"
+            + "\n".join(f"{f.rel}:{f.line}: [{f.rule}] {f.message}"
+                        for f in rep.findings))
+        assert rep.exit_code == 0
+
+    def test_at_least_seven_active_rules(self):
+        rep = _unified()
+        assert len(rep.rules) >= 7
+        assert set(rep.rules) >= {
+            "excepts", "import-jit", "syncpoints", "obs-events",
+            "retrace-hazard", "lock-discipline", "jit-boundary"}
+
+    def test_nonzero_files_scanned_per_package(self):
+        """A broken rule or a mis-rooted scan must fail loudly, not
+        silently scan nothing."""
+        rep = _unified()
+        assert rep.files_scanned >= 60
+        for pkg in sorted(EXPECTED_PACKAGES):
+            assert rep.packages.get(pkg, 0) > 0, (
+                f"no files scanned in package {pkg!r}: "
+                f"{rep.packages}")
+
+    def test_each_file_parsed_exactly_once(self):
+        """The framework's whole point: one ast.parse per file per
+        run, shared by all rules."""
+        before = FileContext.parse_count
+        rep = _unified()
+        delta = FileContext.parse_count - before
+        assert delta == rep.files_scanned == rep.parse_count
+
+    def test_json_output_self_check_fields(self):
+        rep = _unified()
+        doc = json.loads(render_json(rep))
+        assert doc["wall_time_s"] > 0
+        assert doc["files_scanned"] == rep.files_scanned
+        assert doc["parse_count"] == doc["files_scanned"]
+        assert set(doc["packages"]) >= EXPECTED_PACKAGES
+
+    def test_unified_pass_not_slower_than_four_pass_scheme(self):
+        """One parse + seven rules must beat four separate
+        parse-everything passes (the old scheme). Best-of-2 each to
+        absorb scheduler noise on the 1-core host."""
+        excepts = _tool("lint_excepts")
+        import_jit = _tool("lint_import_jit")
+        syncpoints = _tool("lint_syncpoints")
+        obs = _tool("lint_obs_events")
+        docs = (os.path.join(REPO, "docs", "observability.md"),
+                os.path.join(REPO, "docs", "serving.md"))
+
+        def four_pass():
+            t0 = time.perf_counter()
+            excepts.scan_tree(PKG)
+            import_jit.scan_tree(os.path.join(PKG, "fit"))
+            for d in LEGACY_SYNC_DIRS:
+                syncpoints.scan_tree(os.path.join(PKG, d))
+            syncpoints.scan_file(os.path.join(PKG, "dynspec.py"))
+            obs.scan_tree(PKG, docs)
+            return time.perf_counter() - t0
+
+        def unified():
+            rep = _unified()
+            return rep.wall_time_s
+
+        unified(), four_pass()                      # warm both
+        t_unified = min(unified() for _ in range(2))
+        t_legacy = min(four_pass() for _ in range(2))
+        assert t_unified <= t_legacy, (
+            f"unified single-parse pass ({t_unified:.3f}s) slower "
+            f"than the old four-pass scheme ({t_legacy:.3f}s)")
 
 
-def test_detector_flags_bare_except():
-    lint = _lint()
-    out = lint.scan_source("try:\n    x()\nexcept:\n    handle()\n")
-    assert len(out) == 1 and "bare" in out[0][1]
+class TestLegacyShims:
+    """The four script entry points keep their contracts (same scan
+    shapes, same CLI exit codes) as thin shims over the framework."""
 
+    def test_excepts_shim_detects_and_tree_clean(self):
+        lint = _tool("lint_excepts")
+        out = lint.scan_source("try:\n    x()\nexcept:\n    pass\n")
+        assert len(out) == 1 and "bare" in out[0][1]
+        assert lint.scan_tree(PKG) == []
 
-def test_detector_flags_silent_swallow():
-    lint = _lint()
-    src = ("try:\n    x()\nexcept Exception:\n    pass\n"
-           "try:\n    y()\nexcept Exception as e:\n    ...\n")
-    out = lint.scan_source(src)
-    assert len(out) == 2
-    assert all("swallows" in msg for _, msg in out)
-
-
-def test_detector_allows_handled_broad_and_marker():
-    lint = _lint()
-    src = (
-        "try:\n    x()\nexcept Exception as e:\n    log(e)\n"
-        "try:\n    y()\nexcept ValueError:\n    pass\n"
-        "try:\n    z()\n"
-        "except Exception:  # broad-except-ok: best-effort\n"
-        "    pass\n")
-    assert lint.scan_source(src) == []
-
-
-def test_detector_flags_tuple_form():
-    lint = _lint()
-    src = ("try:\n    x()\nexcept (ValueError, Exception):\n"
-           "    pass\n")
-    assert len(lint.scan_source(src)) == 1
-
-
-class TestImportTimeJit:
-    def test_fit_layer_is_clean(self):
+    def test_import_jit_shim_detects_and_fit_clean(self):
         lint = _tool("lint_import_jit")
-        violations = lint.scan_tree(
-            os.path.join(REPO, "scintools_tpu", "fit"))
-        assert violations == [], (
-            "import-time jax.jit in fit/ (build programs lazily in "
-            f"a cached factory): {violations}")
-
-    def test_detector_flags_module_level_jit(self):
-        lint = _tool("lint_import_jit")
-        out = lint.scan_source(
-            "import jax\nf = jax.jit(lambda x: x)\n")
+        out = lint.scan_source("import jax\nf = jax.jit(lambda x: x)\n")
         assert len(out) == 1 and "import time" in out[0][1]
+        assert lint.scan_tree(os.path.join(PKG, "fit")) == []
 
-    def test_detector_flags_decorator_and_partial(self):
-        lint = _tool("lint_import_jit")
-        src = ("import jax\nfrom functools import partial\n"
-               "@jax.jit\ndef f(x):\n    return x\n"
-               "@partial(jax.jit, static_argnums=0)\n"
-               "def g(n, x):\n    return x\n")
-        assert len(lint.scan_source(src)) == 2
-
-    def test_detector_allows_lazy_jit(self):
-        lint = _tool("lint_import_jit")
-        src = ("import jax\n"
-               "def build():\n    return jax.jit(lambda x: x)\n"
-               "class C:\n"
-               "    def m(self):\n"
-               "        return jax.jit(lambda x: x)\n")
-        assert lint.scan_source(src) == []
-
-
-class TestSyncpoints:
-    """tools/lint_syncpoints.py (ISSUE 4): library hot paths must not
-    fence the device queue — the acceptance gate is zero violations
-    across ops/, fit/, thth/, parallel/."""
-
-    def test_hot_paths_are_clean(self):
-        lint = _tool("lint_syncpoints")
-        violations = []
-        # serve/ joined the scan in ISSUE 6; robust/ and obs/ in
-        # ISSUE 7 (the runner/ladder drive in-flight device values
-        # through the retrieval survey and must never fence them
-        # mid-pipeline)
-        for d in ("ops", "fit", "thth", "parallel", "serve",
-                  "robust", "obs"):
-            violations.extend(lint.scan_tree(
-                os.path.join(REPO, "scintools_tpu", d)))
-        # dynspec.py joined in ISSUE 7: the survey entries
-        # (run_psrflux_survey / run_wavefield_survey) and the
-        # device-native retrieval path live here — eager fetches of
-        # in-flight values would serialise the pipelined runner
-        violations.extend(lint.scan_file(
-            os.path.join(REPO, "scintools_tpu", "dynspec.py")))
-        assert violations == [], (
-            "premature device-sync points in library hot paths "
-            f"(fence only at consumption boundaries): {violations}")
-
-    def test_detector_flags_block_until_ready(self):
+    def test_syncpoints_shim_detects_and_hot_paths_clean(self):
         lint = _tool("lint_syncpoints")
         out = lint.scan_source("y = fn(x).block_until_ready()\n")
         assert len(out) == 1 and "block_until_ready" in out[0][1]
-        out = lint.scan_source("jax.block_until_ready(fn(x))\n")
-        assert len(out) == 1
+        violations = []
+        for d in LEGACY_SYNC_DIRS:
+            violations.extend(lint.scan_tree(os.path.join(PKG, d)))
+        violations.extend(lint.scan_file(
+            os.path.join(PKG, "dynspec.py")))
+        assert violations == []
 
-    def test_detector_flags_dispatch_and_fetch(self):
-        lint = _tool("lint_syncpoints")
-        out = lint.scan_source(
-            "v = np.asarray(f(jnp.asarray(x)))\n")
-        assert len(out) == 1 and "one expression" in out[0][1]
-        out = lint.scan_source(
-            "v = float(f(jax.device_put(x)))\n")
-        assert len(out) == 1
-
-    def test_detector_flags_jit_bound_fetch(self):
-        lint = _tool("lint_syncpoints")
-        src = ("import jax\ng = jax.jit(lambda x: x)\n"
-               "v = np.asarray(g(y))\n")
-        out = lint.scan_source(src)
-        assert len(out) == 1 and "jit-bound" in out[0][1]
-
-    def test_detector_respects_marker_and_plain_asarray(self):
-        lint = _tool("lint_syncpoints")
-        src = ("v = np.asarray(f(jnp.asarray(x)))  # sync-ok: edge\n"
-               "w = np.asarray(unit_checks(x))\n"
-               "u = np.asarray(host_array)\n")
-        assert lint.scan_source(src) == []
-
-    def test_allowlist_exempts_profiling(self):
+    def test_syncpoints_allowlist_preserved(self):
         lint = _tool("lint_syncpoints")
         assert lint._allowlisted(
-            os.path.join(REPO, "scintools_tpu", "utils",
-                         "profiling.py"), REPO)
+            os.path.join(PKG, "utils", "profiling.py"), REPO)
 
-
-class TestObsEvents:
-    """tools/lint_obs_events.py (ISSUE 5): every emitted slog event
-    name must be in the docs/observability.md catalog."""
-
-    DOC = os.path.join(REPO, "docs", "observability.md")
-    DOCS = (DOC, os.path.join(REPO, "docs", "serving.md"))
-
-    def test_package_events_are_documented(self):
+    def test_obs_events_shim_contracts(self):
         lint = _tool("lint_obs_events")
-        violations = lint.scan_tree(
-            os.path.join(REPO, "scintools_tpu"), self.DOCS)
-        assert violations == [], (
-            "undocumented / unresolvable slog event names "
-            "(document them in docs/observability.md or "
-            f"docs/serving.md): {violations}")
-
-    def test_catalog_accepts_multiple_docs(self):
-        lint = _tool("lint_obs_events")
-        multi = lint.catalog_names(self.DOCS)
-        assert lint.catalog_names(self.DOC) <= multi
-        assert "serve.ingest" in multi
-
-    def test_catalog_parses_known_events(self):
-        lint = _tool("lint_obs_events")
-        names = lint.catalog_names(self.DOC)
+        doc = os.path.join(REPO, "docs", "observability.md")
+        docs = (doc, os.path.join(REPO, "docs", "serving.md"))
+        multi = lint.catalog_names(docs)
+        assert lint.catalog_names(doc) <= multi
         assert {"robust.quarantine", "robust.fallback",
-                "survey.heartbeat", "survey.run_report",
-                "survey.pipeline_timeline"} <= names
-
-    def test_detector_resolves_literals_and_defaults(self):
-        lint = _tool("lint_obs_events")
-        src = ("from scintools_tpu.utils import slog\n"
-               "def f(event='my.default'):\n"
-               "    slog.log_event(event, a=1)\n"
-               "    slog.log_event('my.literal')\n"
-               "    with slog.span('my.span'):\n"
-               "        pass\n"
-               "    slog.log_failure(epoch='e0')\n")
-        events, violations = lint.scan_source(src)
-        assert violations == []
-        assert {n for _, n in events} == {
-            "my.default", "my.literal", "my.span", "robust.failure"}
-
-    def test_detector_flags_unresolvable_and_accepts_marker(self):
-        lint = _tool("lint_obs_events")
-        src = ("from scintools_tpu.utils import slog\n"
-               "class C:\n"
-               "    def f(self):\n"
-               "        slog.log_event(self.event)\n")
-        events, violations = lint.scan_source(src)
-        assert len(violations) == 1
-        assert "unresolvable" in violations[0][1]
-        marked = src.replace(
-            "slog.log_event(self.event)",
-            "slog.log_event(self.event)  # obs-event-ok: my.marked")
-        events, violations = lint.scan_source(marked)
-        assert violations == []
-        assert events == [(4, "my.marked")]
-
-    def test_detector_ignores_timeline_spans(self):
-        """``StageTimeline.span`` is a stage recorder, not an event
-        emitter — attribute ``span`` calls on non-slog receivers must
-        not be treated as events."""
-        lint = _tool("lint_obs_events")
-        src = ("with timeline.span('e0', 'load'):\n"
-               "    pass\n")
-        events, violations = lint.scan_source(src)
-        assert events == [] and violations == []
-
-    def test_undocumented_event_fails_tree_scan(self, tmp_path):
-        lint = _tool("lint_obs_events")
-        pkg = tmp_path / "pkg"
-        pkg.mkdir()
-        (pkg / "m.py").write_text(
+                "survey.heartbeat", "serve.ingest"} <= multi
+        events, violations = lint.scan_source(
             "from scintools_tpu.utils import slog\n"
-            "slog.log_event('not.in.catalog')\n")
-        out = lint.scan_tree(str(pkg), self.DOC)
-        assert len(out) == 1 and "not in the catalog" in out[0][2]
+            "def f(event='my.default'):\n"
+            "    slog.log_event(event, a=1)\n"
+            "    slog.log_failure(epoch='e0')\n")
+        assert violations == []
+        assert {n for _, n in events} == {"my.default",
+                                         "robust.failure"}
+        assert lint.scan_tree(PKG, docs) == []
+
+    def test_shim_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    x()\nexcept:\n    pass\n")
+        clean = tmp_path / "clean.py"
+        clean.write_text("A = 1\n")
+        lint = _tool("lint_excepts")
+        assert lint.main([str(bad)]) == 1
+        assert lint.main([str(clean)]) == 0
+
+    def test_shim_script_runs_standalone(self, tmp_path):
+        """`python tools/lint_excepts.py <file>` still works from a
+        cold interpreter (the shim bootstraps sys.path itself)."""
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    x()\nexcept:\n    pass\n")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "lint_excepts.py"),
+             str(bad)],
+            capture_output=True, text=True)
+        assert p.returncode == 1
+        assert "bare 'except:'" in p.stdout
+
+
+class TestTier1CliGate:
+    """The acceptance criterion verbatim: the CLI exits 0 on the
+    merged tree, and its JSON self-check reports a real scan."""
+
+    def test_cli_clean_tree_and_self_check(self):
+        env = dict(os.environ, PYTHONPATH=REPO)
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint", "scintools_tpu",
+             "--format", "json"],
+            capture_output=True, text=True, cwd=REPO, env=env)
+        assert p.returncode == 0, (p.stdout, p.stderr)
+        doc = json.loads(p.stdout)
+        assert doc["n_findings"] == 0
+        assert doc["files_scanned"] >= 60
+        assert len(doc["rules"]) >= 7
+        for pkg in sorted(EXPECTED_PACKAGES):
+            assert doc["packages"].get(pkg, 0) > 0, doc["packages"]

@@ -1,0 +1,8 @@
+"""Repo tooling package.
+
+Makes ``tools/`` importable so the unified static-analysis framework
+can be run as ``python -m tools.jaxlint`` from the repo root. The
+standalone scripts in this directory (``lint_*.py``, ``make_golden.py``,
+...) still run directly; the four legacy lint scripts are thin shims
+over :mod:`tools.jaxlint`.
+"""

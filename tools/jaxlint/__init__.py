@@ -1,0 +1,38 @@
+"""jaxlint — the repo's unified AST static-analysis framework.
+
+One parse per file, a registry of rule plugins over the shared tree
+(ISSUE 8). Replaces the four standalone lints
+(``tools/lint_excepts.py``, ``lint_import_jit.py``,
+``lint_syncpoints.py``, ``lint_obs_events.py`` — kept as thin shims)
+and adds three analyzers for this codebase's proven failure modes:
+
+========  ===============  ==========================================
+id        rule             catches
+========  ===============  ==========================================
+JL001     excepts          bare ``except:`` / silent swallow-alls
+JL002     import-jit       ``jax.jit`` reachable at import time
+JL003     syncpoints       premature device fences in hot paths
+JL004     obs-events       undocumented slog event names
+JL101     retrace-hazard   per-call jit-wrapper construction outside
+                           a recognized cache; unhashable cache keys
+JL102     lock-discipline  unlocked shared-state writes in threaded
+                           modules
+JL103     jit-boundary     host-only calls inside traced bodies
+========  ===============  ==========================================
+
+CLI::
+
+    python -m tools.jaxlint [paths] [--format text|json|sarif]
+                            [--rules r1,r2] [--baseline FILE]
+                            [--write-baseline FILE] [--list-rules]
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error. Escape
+hatch: ``# lint-ok: <rule>: <reason>`` (legacy ``sync-ok`` /
+``broad-except-ok`` / ``obs-event-ok`` markers stay honored). Full
+rule catalog: docs/static-analysis.md.
+"""
+
+from .framework import (Config, FileContext, Finding, Report, Rule,  # noqa: F401
+                        RULES, load_baseline, package_rel, register,
+                        run, write_baseline, __version__)
+from . import rules as _rules  # noqa: F401  (populates the registry)

@@ -1,0 +1,104 @@
+"""CLI entry: ``python -m tools.jaxlint`` (see package docstring)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .framework import (Config, RULES, load_baseline, run,
+                        write_baseline)
+from .formats import RENDERERS
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="Unified AST static analysis for scintools_tpu "
+                    "(rule catalog: docs/static-analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "scintools_tpu package)")
+    p.add_argument("--format", choices=sorted(RENDERERS),
+                   default="text", dest="fmt")
+    p.add_argument("--rules",
+                   help="comma-separated rule names to run "
+                        "(default: all)")
+    p.add_argument("--baseline",
+                   help="JSON baseline of grandfathered findings to "
+                        "suppress")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="write current findings as a new baseline "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("-o", "--output", help="write report here instead "
+                                          "of stdout")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            scope = ", ".join(rule.scope) if rule.scope else "package"
+            print(f"{rule.id}  {rule.name:<16} [{scope}]  "
+                  f"{rule.short}")
+        return 0
+
+    targets = args.paths or [os.path.join(_repo_root(),
+                                          "scintools_tpu")]
+    for t in targets:
+        if not os.path.exists(t):
+            print(f"jaxlint: no such path: {t}", file=sys.stderr)
+            return 2
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"jaxlint: unknown rule(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(RULES)})", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"jaxlint: cannot read baseline {args.baseline}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+
+    try:
+        report = run(targets, rules=rules,
+                     config=Config(repo_root=_repo_root()),
+                     baseline=baseline)
+    except Exception as e:   # an internal rule crash must be LOUD
+        print(f"jaxlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, report.findings)
+        print(f"jaxlint: wrote {len(report.findings)} finding(s) to "
+              f"baseline {args.write_baseline}")
+        return 0
+
+    out = RENDERERS[args.fmt](report)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(out + "\n")
+    else:
+        print(out)
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Output renderers for the jaxlint CLI: text, json, sarif.
+
+The JSON document is the machine interface the tier-1 self-check
+reads (``files_scanned`` / ``packages`` must be nonzero — a broken
+rule or an empty scan fails loudly). The SARIF output is minimal
+valid SARIF 2.1.0 for code-scanning UIs.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .framework import RULES
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_text(report):
+    lines = []
+    for f in report.findings:
+        rule = RULES.get(f.rule)
+        rid = rule.id if rule else f.rule
+        lines.append(f"{f.path}:{f.line}: [{rid} {f.rule}] "
+                     f"{f.message}")
+    lines.append(
+        f"jaxlint: {len(report.findings)} finding(s) in "
+        f"{report.files_scanned} file(s) "
+        f"({report.baselined} baselined, {report.suppressed} "
+        f"marker-suppressed) in {report.wall_time_s:.2f}s")
+    return "\n".join(lines)
+
+
+def render_json(report):
+    return json.dumps(report.as_dict(), indent=1, sort_keys=False)
+
+
+def render_sarif(report):
+    rules_meta = []
+    for name in report.rules:
+        rule = RULES.get(name)
+        if rule is None:
+            continue
+        rules_meta.append({
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.short or rule.name},
+        })
+    results = []
+    for f in report.findings:
+        rule = RULES.get(f.rule)
+        results.append({
+            "ruleId": rule.id if rule else f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.rel.replace("\\", "/")},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        })
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1)
+
+
+RENDERERS = {"text": render_text, "json": render_json,
+             "sarif": render_sarif}

@@ -1,0 +1,496 @@
+"""Core of the jaxlint unified AST analysis framework.
+
+The four standalone repo lints (``tools/lint_excepts.py``,
+``lint_import_jit.py``, ``lint_syncpoints.py``, ``lint_obs_events.py``)
+each parsed every file themselves — four grep-adjacent passes with four
+marker syntaxes and four exit conventions. This module replaces the
+plumbing with one framework:
+
+- :class:`FileContext` — ONE ``ast.parse`` per file per run (pinned by
+  the ``FileContext.parse_count`` probe in tests), plus the shared
+  derived analyses every rule needs (parent links, enclosing-function
+  chains, per-line escape-hatch markers);
+- :class:`Rule` + :func:`register` — rule plugins declare an id, a
+  package-relative scope, and a ``check(ctx, config)``; the registry
+  is what ``--list-rules`` and the CLI ``--rules`` filter see;
+- :func:`run` — walks the targets once, builds one context per file,
+  runs every applicable rule over the shared tree, applies marker
+  suppression and the ``--baseline`` grandfather file, and returns a
+  :class:`Report` carrying findings + scan accounting (files scanned
+  per package, parse count, wall time) so a broken rule or an empty
+  scan fails loudly instead of silently passing.
+
+Escape hatch: one unified marker ::
+
+    ...offending line...  # lint-ok: <rule>: <reason>
+
+suppresses findings of ``<rule>`` on that line. The three legacy
+markers stay honored and map onto rules: ``# sync-ok: <reason>``
+(syncpoints), ``# broad-except-ok: <reason>`` (excepts),
+``# obs-event-ok: <name>`` (obs-events). For ``obs-events`` the first
+token of the reason names the emitted event (which is then
+catalog-checked like any literal).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import time
+
+__version__ = "1.0"
+
+#: unified escape hatch: ``# lint-ok: rule[,rule2]: reason``
+MARKER_RE = re.compile(
+    r"#\s*lint-ok:\s*([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"\s*(?::\s*(.*))?")
+
+#: legacy marker → rule name (kept working forever; annotated lines
+#: from ISSUEs 2/4/5 must not need a rewrite)
+LEGACY_MARKERS = {
+    "sync-ok": "syncpoints",
+    "broad-except-ok": "excepts",
+    "obs-event-ok": "obs-events",
+}
+_LEGACY_RE = re.compile(
+    r"#\s*(sync-ok|broad-except-ok|obs-event-ok)\s*:?\s*([^#]*)")
+
+PACKAGE = "scintools_tpu"
+
+
+class Finding:
+    """One rule violation at ``path:line``.
+
+    ``data`` carries rule-specific extras (e.g. the event name for
+    obs-events). The :meth:`fingerprint` is line-number-insensitive
+    (rule, package-relative path, stripped source line) so a baseline
+    survives unrelated edits above the finding.
+    """
+
+    __slots__ = ("rule", "path", "rel", "line", "message", "data",
+                 "code")
+
+    def __init__(self, rule, path, line, message, rel=None, data=None,
+                 code=""):
+        self.rule = rule
+        self.path = path
+        self.rel = rel or path
+        self.line = int(line)
+        self.message = message
+        self.data = data or {}
+        self.code = code
+
+    def fingerprint(self):
+        return (self.rule, self.rel.replace(os.sep, "/"),
+                self.code.strip())
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "rel": self.rel,
+                "line": self.line, "message": self.message,
+                "code": self.code, **(
+                    {"data": self.data} if self.data else {})}
+
+    def __repr__(self):
+        return (f"Finding({self.rule}, {self.rel}:{self.line}, "
+                f"{self.message!r})")
+
+    # tuple-compat for the legacy shims: (line, message)
+    def legacy(self):
+        return (self.line, self.message)
+
+
+class FileContext:
+    """One parsed file shared by every rule in a run.
+
+    ``parse_count`` is a class-level probe: tests pin that a full-tree
+    run parses each file exactly once (the whole point of unifying the
+    four lints).
+    """
+
+    parse_count = 0
+
+    def __init__(self, path, source=None, rel=None):
+        self.path = path
+        if source is None:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self.rel = (rel if rel is not None
+                    else package_rel(path) or os.path.basename(path))
+        self.syntax_error = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.tree = ast.Module(body=[], type_ignores=[])
+            self.syntax_error = e
+        FileContext.parse_count += 1
+        self._markers = None
+        self._parents = None
+        self._nodes = None
+        self._functions = None
+
+    # ---- escape-hatch markers ---------------------------------------
+    @property
+    def markers(self):
+        """``{lineno: [(rule_name, payload), ...]}`` for every
+        unified ``# lint-ok:`` and legacy marker in the file."""
+        if self._markers is None:
+            out = {}
+            for i, line in enumerate(self.lines, start=1):
+                if "#" not in line:
+                    continue
+                m = MARKER_RE.search(line)
+                if m:
+                    rules = [r.strip() for r in m.group(1).split(",")]
+                    payload = (m.group(2) or "").strip()
+                    out.setdefault(i, []).extend(
+                        (r, payload) for r in rules)
+                lm = _LEGACY_RE.search(line)
+                if lm:
+                    out.setdefault(i, []).append(
+                        (LEGACY_MARKERS[lm.group(1)],
+                         lm.group(2).strip()))
+            self._markers = out
+        return self._markers
+
+    def marked(self, lineno, rule):
+        """Payload string when ``lineno`` carries a marker for
+        ``rule`` (empty string for a bare marker), else None. A
+        marker may sit on the flagged line itself or in the block of
+        comment-only lines immediately above it (long flagged lines
+        stay within the line-length budget)."""
+        candidates = [lineno]
+        i = lineno - 1
+        while i >= 1 and self.line_at(i).lstrip().startswith("#"):
+            candidates.append(i)
+            i -= 1
+        for ln in candidates:
+            for name, payload in self.markers.get(ln, ()):
+                if name == rule:
+                    return payload
+        return None
+
+    def line_at(self, lineno):
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    # ---- shared derived analyses ------------------------------------
+    @property
+    def nodes(self):
+        """Every AST node, walked once and shared by all rules —
+        ``ast.walk`` re-runs ``iter_child_nodes`` per call, which is
+        the bulk of a full-tree scan's cost at seven rules."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    @property
+    def parents(self):
+        """``{id(node): parent_node}`` over the whole tree (built
+        once, shared by every rule that needs lexical context)."""
+        if self._parents is None:
+            par = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    par[id(child)] = node
+            self._parents = par
+        return self._parents
+
+    def ancestors(self, node):
+        """Lexical ancestor chain of ``node``, innermost first."""
+        out = []
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            out.append(cur)
+            cur = self.parents.get(id(cur))
+        return out
+
+    @property
+    def functions(self):
+        """Every function/lambda node, shared across rules."""
+        if self._functions is None:
+            self._functions = [
+                n for n in self.nodes
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda))]
+        return self._functions
+
+    def enclosing_functions(self, node):
+        """Enclosing FunctionDef/AsyncFunctionDef/Lambda chain,
+        innermost first (empty at module level). Computed by line
+        interval containment — function extents are disjoint or
+        nested, so containment is exact and avoids materialising a
+        full parent map per file. A node on a function's own
+        decorator lines is (correctly) OUTSIDE that function."""
+        ln = getattr(node, "lineno", None)
+        if ln is None:
+            return []
+        end = getattr(node, "end_lineno", None) or ln
+        col = getattr(node, "col_offset", 0)
+        out = []
+        for fn in self.functions:
+            if fn is node:
+                continue
+            fln = fn.lineno
+            fend = getattr(fn, "end_lineno", None) or fln
+            if fln < ln or (fln == ln
+                            and fn.col_offset <= col):
+                if fend > end or (fend == end and fln <= ln):
+                    out.append(fn)
+        out.sort(key=lambda f: (
+            ((getattr(f, "end_lineno", None) or f.lineno)
+             - f.lineno),
+            -f.col_offset))
+        return out
+
+
+def package_rel(path):
+    """Path relative to the ``scintools_tpu`` package root
+    ('/'-separated), or None when the file is outside the package.
+    Rule scopes are expressed against this."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    if PACKAGE not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index(PACKAGE)
+    rel = "/".join(parts[idx + 1:])
+    return rel or None
+
+
+# ---------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------
+
+RULES = {}          # name -> rule instance, in registration order
+
+
+def register(cls):
+    """Class decorator adding one instance of ``cls`` to the
+    registry."""
+    RULES[cls.name] = cls()
+    return cls
+
+
+class Rule:
+    """Base class for rule plugins.
+
+    Subclasses set ``id`` (stable SARIF id, ``JLxxx``), ``name``
+    (marker / CLI name), ``short`` (one-liner for --list-rules),
+    ``scope`` (package-relative path prefixes the rule applies to;
+    None = whole package) and ``exclude`` (package-relative suffixes
+    exempt because their JOB is the flagged behavior), then implement
+    ``check(ctx, config) -> iterable[Finding]``.
+
+    ``self_markers=True`` opts the rule out of the runner's generic
+    line-marker suppression (obs-events consumes its marker payload
+    itself: the named event is still catalog-checked).
+    """
+
+    id = "JL000"
+    name = "rule"
+    short = ""
+    scope = None
+    exclude = ()
+    self_markers = False
+
+    def applies(self, rel):
+        if rel is None:
+            return True
+        rel = rel.replace(os.sep, "/")
+        if any(rel.endswith(e) for e in self.exclude):
+            return False
+        if self.scope is None:
+            return True
+        return any(rel == s or rel.startswith(s) for s in self.scope)
+
+    def check(self, ctx, config):
+        raise NotImplementedError
+
+    def finding(self, ctx, line, message, data=None):
+        return Finding(self.name, ctx.path, line, message, rel=ctx.rel,
+                       data=data, code=ctx.line_at(line))
+
+    # ---- direct (fixture/test) API ----------------------------------
+    def scan_source(self, source, filename="<string>", config=None):
+        """Run just this rule over one source blob, with marker
+        suppression applied — the golden-corpus entry point."""
+        ctx = FileContext(filename, source=source, rel=filename)
+        config = config or Config()
+        if ctx.syntax_error is not None:
+            e = ctx.syntax_error
+            return [Finding(self.name, filename, e.lineno or 0,
+                            f"syntax error: {e.msg}", rel=filename)]
+        out = []
+        for f in self.check(ctx, config):
+            if not self.self_markers \
+                    and ctx.marked(f.line, self.name) is not None:
+                continue
+            out.append(f)
+        return sorted(out, key=lambda f: (f.line, f.message))
+
+
+class Config:
+    """Run-wide configuration shared by every rule."""
+
+    def __init__(self, repo_root=None, obs_docs=None):
+        self.repo_root = repo_root or _default_repo_root()
+        self._obs_docs = obs_docs
+        self._obs_catalog = None
+
+    @property
+    def obs_docs(self):
+        if self._obs_docs is None:
+            docs = os.path.join(self.repo_root, "docs")
+            self._obs_docs = [
+                p for p in (os.path.join(docs, "observability.md"),
+                            os.path.join(docs, "serving.md"))
+                if os.path.exists(p)]
+        return self._obs_docs
+
+    @property
+    def obs_catalog(self):
+        """Backtick-quoted dotted names across the obs event-catalog
+        docs (cached once per run)."""
+        if self._obs_catalog is None:
+            names = set()
+            for path in self.obs_docs:
+                with open(path, encoding="utf-8") as fh:
+                    names |= set(re.findall(
+                        r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`", fh.read()))
+            self._obs_catalog = names
+        return self._obs_catalog
+
+
+def _default_repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------
+
+class Report:
+    """Outcome of one run: surviving findings + scan accounting."""
+
+    def __init__(self):
+        self.findings = []
+        self.suppressed = 0       # marker-suppressed
+        self.baselined = 0        # baseline-suppressed
+        self.files_scanned = 0
+        self.parse_count = 0
+        self.packages = {}        # first path component -> file count
+        self.rules = []
+        self.wall_time_s = 0.0
+
+    @property
+    def exit_code(self):
+        return 1 if self.findings else 0
+
+    def as_dict(self):
+        return {
+            "tool": "jaxlint",
+            "version": __version__,
+            "wall_time_s": round(self.wall_time_s, 4),
+            "files_scanned": self.files_scanned,
+            "parse_count": self.parse_count,
+            "packages": dict(sorted(self.packages.items())),
+            "rules": list(self.rules),
+            "n_findings": len(self.findings),
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+
+def iter_py_files(target):
+    """Yield ``.py`` files under ``target`` (a file or directory), in
+    sorted deterministic order."""
+    if os.path.isfile(target):
+        yield target
+        return
+    for base, dirs, names in sorted(os.walk(target)):
+        dirs.sort()
+        for name in sorted(names):
+            if name.endswith(".py"):
+                yield os.path.join(base, name)
+
+
+def load_baseline(path):
+    """Baseline file → set of finding fingerprints. The file is JSON:
+    ``{"version": 1, "entries": [{"rule", "path", "code"}, ...]}``."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return {(e["rule"], e["path"].replace(os.sep, "/"),
+             e["code"].strip()) for e in doc.get("entries", ())}
+
+
+def write_baseline(path, findings):
+    entries = [{"rule": f.rule, "path": f.rel.replace(os.sep, "/"),
+                "code": f.code.strip()} for f in findings]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def run(targets, rules=None, config=None, baseline=None,
+        respect_scope=True):
+    """Run the framework over ``targets`` (files/directories).
+
+    ``rules`` — iterable of rule names (default: every registered
+    rule); ``baseline`` — set of fingerprints (or a path) to
+    grandfather; ``respect_scope=False`` applies every rule to every
+    file regardless of its declared package scope (fixture runs).
+    """
+    from . import rules as _rules_pkg  # noqa: F401  (registers rules)
+
+    t0 = time.perf_counter()
+    config = config or Config()
+    if isinstance(baseline, str):
+        baseline = load_baseline(baseline)
+    baseline = baseline or set()
+    active = [RULES[n] for n in (rules or RULES.keys())]
+    report = Report()
+    report.rules = [r.name for r in active]
+    p0 = FileContext.parse_count
+
+    seen = set()
+    for target in targets:
+        for path in iter_py_files(target):
+            apath = os.path.abspath(path)
+            if apath in seen:
+                continue
+            seen.add(apath)
+            ctx = FileContext(path)
+            report.files_scanned += 1
+            rel = ctx.rel.replace(os.sep, "/")
+            pkg = rel.split("/")[0] if "/" in rel else "."
+            report.packages[pkg] = report.packages.get(pkg, 0) + 1
+            if ctx.syntax_error is not None:
+                e = ctx.syntax_error
+                report.findings.append(Finding(
+                    "parse", path, e.lineno or 0,
+                    f"syntax error: {e.msg}", rel=ctx.rel))
+                continue
+            for rule in active:
+                if respect_scope and not rule.applies(ctx.rel):
+                    continue
+                for f in rule.check(ctx, config):
+                    if not rule.self_markers and \
+                            ctx.marked(f.line, rule.name) is not None:
+                        report.suppressed += 1
+                        continue
+                    if f.fingerprint() in baseline:
+                        report.baselined += 1
+                        continue
+                    report.findings.append(f)
+
+    report.parse_count = FileContext.parse_count - p0
+    report.findings.sort(key=lambda f: (f.rel, f.line, f.rule,
+                                        f.message))
+    report.wall_time_s = time.perf_counter() - t0
+    return report

@@ -1,0 +1,75 @@
+"""JL001 ``excepts`` — exception hygiene (ported from
+tools/lint_excepts.py, ISSUE 2).
+
+Two patterns defeat the robustness layer by hiding failures the
+survey runner / fallback ladder is supposed to see and report:
+
+- bare ``except:`` — catches SystemExit/KeyboardInterrupt too, so a
+  survey cannot even be stopped cleanly;
+- ``except Exception:`` (or BaseException) whose body is ONLY
+  ``pass``/``...`` — the classic swallow-all that turns a corrupt
+  epoch into silent garbage.
+
+Broad handlers that *do something* (log, return a fallback, re-raise)
+are allowed. Escape hatch: ``# lint-ok: excepts: <reason>`` (legacy
+``# broad-except-ok: <reason>`` still honored) on the ``except``
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(node):
+    """``except Exception``/``BaseException`` (bound or not),
+    including tuple forms containing one."""
+    t = node.type
+    if t is None:
+        return False
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(isinstance(e, ast.Name) and e.id in _BROAD
+               for e in elts)
+
+
+def _swallows(node):
+    """Handler body is only ``pass``/``...`` — nothing logged,
+    nothing returned, nothing re-raised."""
+    for stmt in node.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+@register
+class ExceptsRule(Rule):
+    id = "JL001"
+    name = "excepts"
+    short = ("bare 'except:' or silent 'except Exception: pass' "
+             "swallow-alls")
+    scope = None                      # whole package
+
+    def check(self, ctx, config):
+        for node in ctx.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    ctx, node.lineno,
+                    "bare 'except:' (catches KeyboardInterrupt/"
+                    "SystemExit; name the exceptions)")
+            elif _is_broad(node) and _swallows(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "'except Exception: pass' swallows all failures "
+                    "silently (log it, narrow it, or mark "
+                    "'# lint-ok: excepts: <reason>')")

@@ -1,0 +1,95 @@
+"""JL002 ``import-jit`` — no import-time ``jax.jit`` (ported from
+tools/lint_import_jit.py, ISSUE 3).
+
+A ``jax.jit(...)`` (or ``@jax.jit`` decorator / ``partial(jax.jit)``)
+executed at module import time forces the jax backend to initialise
+before any work is requested: cold-start of every CLI entry and test
+collection pays it, and on the tunneled TPU an import can then HANG
+on a dead link (backend.py:force_cpu_platform docstring). Compiled
+programs must be built lazily inside cached factories
+(fit/acf2d.py:_SOLVER_CACHE, thth/core.py:keyed_jit_cache).
+
+Flagged: any call whose callee is named ``jit`` (``jax.jit``,
+``get_jax().jit``, bare ``jit``) or ``partial(...jit...)`` reachable
+at IMPORT TIME — module body, class bodies, module-level decorator
+lists, and function default arguments. Calls inside function bodies
+(deferred to call time) are fine — and are rule ``retrace-hazard``'s
+territory instead.
+
+Scope: the whole package (the legacy script defaulted to ``fit/``;
+the rest of the tree is clean, so the unified rule pins it globally).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+
+def is_jit_callee(node):
+    """True when a Call's func resolves to a name ending in
+    ``jit``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    return False
+
+
+def jit_calls(node):
+    """Yield Call nodes invoking jit anywhere under ``node``."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        if is_jit_callee(sub.func):
+            yield sub
+        elif (isinstance(sub.func, ast.Name)
+              and sub.func.id == "partial"
+              and any(is_jit_callee(a) for a in sub.args)):
+            yield sub
+
+
+def _import_time_nodes(body):
+    """Yield ``(node, is_decorator)`` pairs for AST nodes whose code
+    executes when the module is imported: statements in module/class
+    bodies, decorators and argument defaults of (possibly
+    nested-in-class) function defs — but NOT function bodies. A BARE
+    jit decorator (``@jax.jit`` — an Attribute, not a Call) still
+    invokes jit at def time, so decorators are flagged."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from ((d, True) for d in stmt.decorator_list)
+            yield from ((d, False) for d in stmt.args.defaults)
+            yield from ((d, False) for d in stmt.args.kw_defaults
+                        if d is not None)
+        elif isinstance(stmt, ast.ClassDef):
+            yield from ((d, True) for d in stmt.decorator_list)
+            yield from _import_time_nodes(stmt.body)
+        else:
+            yield stmt, False
+
+
+@register
+class ImportJitRule(Rule):
+    id = "JL002"
+    name = "import-jit"
+    short = "jax.jit reachable at module import time"
+    scope = None                      # whole package
+
+    MSG = ("jax.jit at import time (build compiled programs lazily "
+           "inside a cached factory — fit/acf2d.py:_SOLVER_CACHE "
+           "pattern)")
+
+    def check(self, ctx, config):
+        seen = set()
+        for node, is_decorator in _import_time_nodes(ctx.tree.body):
+            if is_decorator and is_jit_callee(node):
+                if node.lineno not in seen:       # bare @jax.jit
+                    seen.add(node.lineno)
+                    yield self.finding(ctx, node.lineno, self.MSG)
+                continue
+            for call in jit_calls(node):
+                if call.lineno not in seen:
+                    seen.add(call.lineno)
+                    yield self.finding(ctx, call.lineno, self.MSG)

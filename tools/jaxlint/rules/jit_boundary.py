@@ -1,0 +1,270 @@
+"""JL103 ``jit-boundary`` — host-only calls inside traced function
+bodies.
+
+Code passed to ``jit``/``vmap``/``pmap``/``lax.scan``/``while_loop``/
+``cond``/``fori_loop``/``lax.map`` runs at TRACE time, not call time:
+a ``print``, an ``open``, an ``slog.log_event`` or a metrics
+increment inside a traced body fires once per (re)trace — silently
+absent from steady-state runs, misleadingly present during compiles —
+and an eager ``np.asarray(<traced arg>)`` either raises
+``TracerArrayConversionError`` under jit or silently pins the value
+to host on the un-jitted oracle path. PR 7 swept bare prints out of
+the retrieval path; this rule keeps all traced bodies clean
+structurally.
+
+Detection: within one module, a function is **traced** when it (a
+``def`` or ``lambda``) is passed to a trace consumer (``jit``,
+``vmap``, ``pmap``, ``grad``, ``value_and_grad``, ``checkpoint``,
+``remat``, ``lax.scan``/``map``/``cond``/``while_loop``/
+``fori_loop``/``switch``/``associative_scan``), directly or through
+the module-local call graph (a helper called from a traced body is
+traced too; resolution is name-based within the file).
+
+Flagged inside traced bodies:
+
+- ``print(...)`` — use ``jax.debug.print`` (trace-staged) or log at
+  the call site after the fence;
+- ``open(...)`` — host I/O cannot run per device element;
+- ``slog.log_event`` / ``log_failure`` / ``slog.span`` — events must
+  be emitted at the host boundary (they would fire per trace, not
+  per call);
+- metrics mutation (``metrics.*`` calls, or ``.inc()``/
+  ``.observe()``/``.set()``/``.dec()`` on a ``counter``/``gauge``/
+  ``histogram`` chain) — same;
+- ``np.save``/``savez``/``savetxt`` and ``np.asarray``/``np.array``
+  of a traced function PARAMETER — host materialisation of a tracer.
+
+Escape hatch: ``# lint-ok: jit-boundary: <reason>`` on the offending
+line (e.g. a debug helper deliberately kept behind a static flag).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+#: callee names whose first functional argument is traced
+_WRAPPERS = {"jit", "vmap", "pmap", "grad", "value_and_grad",
+             "checkpoint", "remat"}
+#: lax-style consumers — every function-valued argument is traced
+_LAX_CONSUMERS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                  "map", "associative_scan"}
+_ALL_CONSUMERS = _WRAPPERS | _LAX_CONSUMERS
+
+_NP_WRITERS = {"save", "savez", "savez_compressed", "savetxt"}
+_METRIC_MUTATORS = {"inc", "dec", "observe", "set"}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_SLOG_CALLS = {"log_event", "log_failure", "span"}
+
+
+def _callee_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class _Scope:
+    """One lexical function scope: local ``def`` names → nodes."""
+
+    def __init__(self, node):
+        self.node = node
+        self.defs = {}
+
+
+def _build_scopes(ctx):
+    """``{id(fn_node): _Scope}`` for the module plus every function,
+    each mapping locally-defined function names to their nodes."""
+    scopes = {id(ctx.tree): _Scope(ctx.tree)}
+
+    def visit(owner, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                scopes[id(owner)].defs[child.name] = child
+                scopes[id(child)] = _Scope(child)
+                visit(child, child)
+            elif isinstance(child, ast.Lambda):
+                scopes[id(child)] = _Scope(child)
+                visit(child, child)
+            elif isinstance(child, ast.ClassDef):
+                # methods resolve within the class body only; skip —
+                # traced fns are module/function-local in practice
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        scopes[id(sub)] = _Scope(sub)
+                        visit(sub, sub)
+            else:
+                visit(owner, child)
+
+    visit(ctx.tree, ctx.tree)
+    return scopes
+
+
+def _resolve(ctx, scopes, site, name):
+    """Nearest function def named ``name`` visible from ``site``
+    (enclosing-scope chain, innermost first)."""
+    for fn in ctx.enclosing_functions(site):
+        sc = scopes.get(id(fn))
+        if sc and name in sc.defs:
+            return sc.defs[name]
+    sc = scopes.get(id(ctx.tree))
+    if sc and name in sc.defs:
+        return sc.defs[name]
+    return None
+
+
+def _functional_args(call):
+    """Argument expressions of ``call`` that may be traced functions."""
+    name = _callee_name(call.func)
+    if name in _WRAPPERS:
+        return call.args[:1]
+    if name in _LAX_CONSUMERS:
+        return list(call.args)
+    return []
+
+
+def traced_functions(ctx):
+    """``(direct, all_traced)`` function nodes (def or Lambda) traced
+    in this module: ``direct`` are trace-consumer arguments plus defs
+    nested inside them (their parameters ARE tracers); ``all_traced``
+    adds the transitive module-local call closure (helpers called
+    from traced bodies run at trace time too, but their arguments may
+    be static — the dual-backend host helpers)."""
+    consumers = [n for n in ctx.nodes
+                 if isinstance(n, ast.Call)
+                 and _callee_name(n.func) in _ALL_CONSUMERS]
+    if not consumers:
+        return [], []         # no trace consumers → skip scope build
+    scopes = _build_scopes(ctx)
+    roots = []
+    for node in consumers:
+        for arg in _functional_args(node):
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Lambda):
+                    roots.append(sub)
+                elif isinstance(sub, ast.Name):
+                    fn = _resolve(ctx, scopes, node, sub.id)
+                    if fn is not None:
+                        roots.append(fn)
+
+    direct = {}
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if id(fn) in direct:
+            continue
+        direct[id(fn)] = fn
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and sub is not fn \
+                    and id(sub) not in direct:
+                work.append(sub)
+
+    traced = dict(direct)
+    work = list(direct.values())
+    while work:
+        fn = work.pop()
+        traced[id(fn)] = fn
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Name):
+                callee = _resolve(ctx, scopes, sub, sub.func.id)
+                if callee is not None and id(callee) not in traced:
+                    traced[id(callee)] = callee
+                    work.append(callee)
+    return list(direct.values()), list(traced.values())
+
+
+def _params(fn):
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _is_metrics_mutation(call):
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    # metrics.<anything>(...)
+    if isinstance(f.value, ast.Name) and f.value.id == "metrics":
+        return True
+    # counter(...).labels(...).inc() style chains
+    if f.attr in _METRIC_MUTATORS:
+        for sub in ast.walk(f.value):
+            if isinstance(sub, ast.Call):
+                n = _callee_name(sub.func)
+                if n in _METRIC_FACTORIES:
+                    return True
+    return False
+
+
+@register
+class JitBoundaryRule(Rule):
+    id = "JL103"
+    name = "jit-boundary"
+    short = ("host-only calls (print/open/slog/metrics/np "
+             "materialisation) inside traced function bodies")
+    scope = None
+
+    def check(self, ctx, config):
+        direct, traced = traced_functions(ctx)
+        if not traced:
+            return
+        direct_ids = {id(f) for f in direct}
+        seen = set()
+        for fn in traced:
+            # tracer-materialisation checks only apply where the
+            # parameters are KNOWN to be tracers: functions passed
+            # straight to a trace consumer (call-graph helpers may
+            # receive static closure values — the dual-backend host
+            # helpers)
+            params = _params(fn) if id(fn) in direct_ids else set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._hostile(node, params)
+                if msg and node.lineno not in seen:
+                    seen.add(node.lineno)
+                    yield self.finding(
+                        ctx, node.lineno,
+                        msg + " inside a traced function body — it "
+                        "runs at TRACE time (once per compile), not "
+                        "per call; move it to the host boundary or "
+                        "mark `# lint-ok: jit-boundary: <reason>`")
+
+    def _hostile(self, call, params):
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id == "print":
+                return ("`print` (use jax.debug.print for staged "
+                        "output)")
+            if f.id == "open":
+                return "`open` (host I/O)"
+            if f.id in _SLOG_CALLS and f.id != "span":
+                return f"`{f.id}` (slog event emission)"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        recv_name = recv.id if isinstance(recv, ast.Name) else None
+        if recv_name == "slog" and f.attr in _SLOG_CALLS:
+            return f"`slog.{f.attr}` (slog event emission)"
+        if _is_metrics_mutation(call):
+            return f"`{recv_name or '...'}.{f.attr}` (metrics mutation)"
+        if recv_name == "np":
+            if f.attr in _NP_WRITERS:
+                return f"`np.{f.attr}` (host file write)"
+            if f.attr in ("asarray", "array") and call.args \
+                    and isinstance(call.args[0], ast.Name) \
+                    and call.args[0].id in params:
+                return (f"`np.{f.attr}({call.args[0].id})` "
+                        "materialises a traced argument on host")
+        return None

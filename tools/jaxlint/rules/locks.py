@@ -1,0 +1,304 @@
+"""JL102 ``lock-discipline`` — unlocked writes to shared mutable
+state in threaded modules.
+
+The daemon/fleet tier (serve/, the pipelined loader/writer, the obs
+registries) is threaded: the ingest loop, the HTTP telemetry handlers,
+the prefetch workers, and the journal writer all touch the same
+objects. A write to shared state outside the owning lock is a race
+that no test reliably catches — this rule makes the discipline
+structural.
+
+Flagged, in the **threaded modules only** (``serve/``,
+``parallel/pipeline.py``, ``parallel/checkpoint.py``, ``obs/``,
+``utils/slog.py``, ``utils/profiling.py``):
+
+- in any class that OWNS a lock (``self._lock = threading.Lock()`` /
+  ``RLock`` / ``Condition``): a write to a shared mutable attribute —
+  one assigned in ``__init__`` and mutated in **two or more** other
+  methods — reached outside a ``with self._lock:`` block;
+- at module level: a module that owns a lock (``_LOCK =
+  threading.Lock()``) and mutates a module-level mutable (dict / list
+  / set / deque display or constructor) outside ``with _LOCK:``.
+
+Recognized conventions (not flagged):
+
+- mutations inside ``with <lock>:`` for ANY lock the class/module
+  owns (nested blocks count — lexical containment);
+- methods/functions whose name ends in ``_locked`` — the codebase
+  convention for "caller holds the lock"
+  (``utils/slog.py:_close_sink_locked``);
+- attributes holding synchronisation primitives themselves
+  (``threading.Event`` — ``.set()``/``.clear()`` are atomic —
+  ``queue.Queue``, locks);
+- attributes mutated in zero or one non-init methods (single-writer
+  pattern: the owning thread's loop).
+
+Escape hatch: ``# lint-ok: lock-discipline: <reason>`` — for writes
+that are deliberately lock-free (GIL-atomic deque appends, monotonic
+flags read racily by design). The reason should say WHY it is safe.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_SYNC_CTORS = _LOCK_CTORS | {"Event", "Semaphore", "BoundedSemaphore",
+                             "Barrier", "Queue", "SimpleQueue",
+                             "LifoQueue", "PriorityQueue"}
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "OrderedDict",
+                  "defaultdict", "Counter"}
+#: method calls that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "popitem", "remove", "discard", "add",
+             "clear", "update", "setdefault", "sort", "reverse",
+             "rotate"}
+
+
+def _ctor_name(value):
+    """Callee name of a Call expression (``threading.Lock()`` →
+    ``Lock``), else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _self_attr(node):
+    """``self.<name>`` → name, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _methods(cls):
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt
+
+
+class _Mutation:
+    __slots__ = ("attr", "lineno", "kind", "method", "node")
+
+    def __init__(self, attr, node, kind, method):
+        self.attr = attr
+        self.node = node
+        self.lineno = node.lineno
+        self.kind = kind
+        self.method = method
+
+
+def _attr_mutations(method):
+    """Yield mutations of ``self.<attr>`` in ``method``'s body."""
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    yield _Mutation(attr, node, "assign", method)
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        yield _Mutation(attr, node, "setitem", method)
+                elif isinstance(t, ast.Tuple):
+                    for elt in t.elts:
+                        attr = _self_attr(elt)
+                        if attr:
+                            yield _Mutation(attr, node, "assign",
+                                            method)
+        elif isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target)
+            if attr:
+                yield _Mutation(attr, node, "augassign", method)
+            if isinstance(node.target, ast.Subscript):
+                attr = _self_attr(node.target.value)
+                if attr:
+                    yield _Mutation(attr, node, "setitem", method)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        yield _Mutation(attr, node, "delitem", method)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr:
+                yield _Mutation(attr, node, f".{node.func.attr}()",
+                                method)
+
+
+def _under_lock(ctx, lineno_node, lock_exprs):
+    """True when ``lineno_node`` sits lexically inside a ``with``
+    block over one of ``lock_exprs`` (predicate on the context
+    expression)."""
+    for anc in ctx.ancestors(lineno_node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if lock_exprs(item.context_expr):
+                    return True
+    return False
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "JL102"
+    name = "lock-discipline"
+    short = ("shared mutable state written outside the owning lock "
+             "in threaded modules")
+    # the threaded tier only — flagging single-threaded code would be
+    # all noise
+    scope = ("serve/", "parallel/pipeline.py",
+             "parallel/checkpoint.py", "obs/", "utils/slog.py",
+             "utils/profiling.py")
+
+    def check(self, ctx, config):
+        yield from self._check_classes(ctx)
+        yield from self._check_module(ctx)
+
+    # ---- classes ----------------------------------------------------
+    def _check_classes(self, ctx):
+        for cls in ctx.nodes:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs, sync_attrs = set(), set()
+            init = None
+            init_attrs = set()
+            for m in _methods(cls):
+                if m.name == "__init__":
+                    init = m
+                for node in ast.walk(m):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        ctor = _ctor_name(node.value)
+                        if ctor in _LOCK_CTORS:
+                            lock_attrs.add(attr)
+                        if ctor in _SYNC_CTORS:
+                            sync_attrs.add(attr)
+                        if m.name == "__init__":
+                            init_attrs.add(attr)
+            if not lock_attrs or init is None:
+                continue
+
+            shared = init_attrs - sync_attrs
+            # collect mutations per attr across non-init methods
+            by_attr = {}
+            for m in _methods(cls):
+                if m.name == "__init__":
+                    continue
+                for mut in _attr_mutations(m):
+                    if mut.attr in shared:
+                        by_attr.setdefault(mut.attr, []).append(mut)
+
+            def is_lock(expr, _la=lock_attrs):
+                return _self_attr(expr) in _la
+
+            for attr, muts in sorted(by_attr.items()):
+                writers = {m.method.name for m in muts}
+                if len(writers) < 2:
+                    continue          # single-writer pattern
+                for mut in muts:
+                    if mut.method.name.endswith("_locked"):
+                        continue      # caller-holds-lock convention
+                    if _under_lock(ctx, mut.node, is_lock):
+                        continue
+                    yield self.finding(
+                        ctx, mut.lineno,
+                        f"`self.{attr}` ({mut.kind}) written outside "
+                        f"`with self.{sorted(lock_attrs)[0]}:` — "
+                        f"shared state mutated in {len(writers)} "
+                        f"methods of lock-owning class `{cls.name}`; "
+                        "hold the lock, rename the method "
+                        "`*_locked`, or mark `# lint-ok: "
+                        "lock-discipline: <why safe>`",
+                        data={"attr": attr, "class": cls.name})
+
+    # ---- module level -----------------------------------------------
+    def _check_module(self, ctx):
+        lock_names, mutable_names = set(), set()
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            ctor = _ctor_name(stmt.value)
+            is_mut = (ctor in _MUTABLE_CTORS
+                      or isinstance(stmt.value,
+                                    (ast.Dict, ast.List, ast.Set)))
+            for t in stmt.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if ctor in _LOCK_CTORS:
+                    lock_names.add(t.id)
+                elif is_mut:
+                    mutable_names.add(t.id)
+        if not lock_names or not mutable_names:
+            return
+
+        def is_lock(expr, _ln=lock_names):
+            return isinstance(expr, ast.Name) and expr.id in _ln
+
+        for fn in ctx.nodes:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name.endswith("_locked"):
+                continue
+            if ctx.enclosing_functions(fn):
+                continue              # visit each function once
+            for mut in self._module_mutations(fn, mutable_names):
+                if _under_lock(ctx, mut.node, is_lock):
+                    continue
+                yield self.finding(
+                    ctx, mut.lineno,
+                    f"module-level mutable `{mut.attr}` ({mut.kind}) "
+                    f"mutated outside `with "
+                    f"{sorted(lock_names)[0]}:` in a lock-owning "
+                    "module; hold the lock, use a `*_locked` helper, "
+                    "or mark `# lint-ok: lock-discipline: "
+                    "<why safe>`",
+                    data={"name": mut.attr})
+
+    def _module_mutations(self, fn, names):
+        for node in ast.walk(fn):
+            mut = None
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in names:
+                        mut = _Mutation(t.value.id, node, "setitem",
+                                        fn)
+            elif isinstance(node, ast.AugAssign):
+                t = node.target
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in names:
+                    mut = _Mutation(t.value.id, node, "setitem", fn)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in names:
+                        mut = _Mutation(t.value.id, node, "delitem",
+                                        fn)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in names:
+                mut = _Mutation(node.func.value.id, node,
+                                f".{node.func.attr}()", fn)
+            if mut is not None:
+                yield mut

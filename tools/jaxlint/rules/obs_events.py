@@ -1,0 +1,157 @@
+"""JL004 ``obs-events`` — every slog event name must be in the
+documented catalog (ported from tools/lint_obs_events.py, ISSUE 5).
+
+The observability layer is only useful if the event stream is a
+stable, documented interface — a dashboard or grep that works today
+must not silently miss next month's renamed event. The rule walks
+every ``slog.log_event(...)`` / ``slog.log_failure(...)`` /
+``slog.span(...)`` call and checks the event name against the catalog
+(backtick-quoted dotted names in docs/observability.md +
+docs/serving.md):
+
+- a **literal** first argument (or ``event=`` keyword) is resolved
+  directly;
+- a plain **variable** is resolved through the enclosing function's
+  default for that parameter (the ``def log_summary(self, event=
+  "survey.pipeline_timeline")`` pattern);
+- anything else (attributes, f-strings, arbitrary expressions) must
+  carry an ``# lint-ok: obs-events: <name>`` marker (legacy
+  ``# obs-event-ok: <name>`` still honored) naming the event it
+  emits — the named event is then catalog-checked like any other. No
+  marker → violation ("drive-by unnamed event").
+
+``span`` names are cataloged by their base name (the
+``.start``/``.end`` suffix convention is documented once);
+``utils/slog.py`` itself is exempt (it builds the suffixed names).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+_CALLS = {"log_event", "log_failure", "span"}
+# literal defaults of slog.log_failure's own ``event`` parameter —
+# calls that omit the argument emit this name
+_IMPLICIT = {"log_failure": "robust.failure"}
+
+
+def _is_slog_call(node):
+    """``slog.log_event(...)`` / ``slog.span(...)`` — the attribute
+    form requires the receiver to be named ``slog`` (``span`` is a
+    common method name: ``StageTimeline.span`` records stage spans,
+    not events). Bare imported ``log_event``/``log_failure`` names
+    are distinctive enough to match directly."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _CALLS \
+            and isinstance(f.value, ast.Name) and f.value.id == "slog":
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _CALLS and f.id != "span":
+        return f.id
+    return None
+
+
+def _event_arg(node):
+    """The AST node holding the event name (first positional or the
+    ``event=`` keyword), or None when omitted."""
+    if node.args:
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "event":
+            return kw.value
+    return None
+
+
+def _fn_defaults(node):
+    """``{param: literal-string-default}`` of one function def."""
+    out = {}
+    args = node.args
+    pos = args.posonlyargs + args.args
+    for a, d in zip(pos[len(pos) - len(args.defaults):],
+                    args.defaults):
+        if isinstance(d, ast.Constant) and isinstance(d.value, str):
+            out[a.arg] = d.value
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant) \
+                and isinstance(d.value, str):
+            out[a.arg] = d.value
+    return out
+
+
+def _collect(ctx, rule):
+    """``(events, violations)``: emissions as ``[(lineno, name)]``,
+    violations as ``[(lineno, message)]``. Variable names resolve
+    through the nearest enclosing function's literal parameter
+    default; anything else needs the line marker naming the event."""
+    events, violations = [], []
+    defaults_cache = {}
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        which = _is_slog_call(node)
+        if which is None:
+            continue
+        arg = _event_arg(node)
+        name = None
+        if arg is None:
+            name = _IMPLICIT.get(which)
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                          str):
+            name = arg.value
+        elif isinstance(arg, ast.Name):
+            for fn in ctx.enclosing_functions(node):
+                if isinstance(fn, ast.Lambda):
+                    continue
+                d = defaults_cache.get(id(fn))
+                if d is None:
+                    d = defaults_cache[id(fn)] = _fn_defaults(fn)
+                if arg.id in d:
+                    name = d[arg.id]
+                    break
+        if name is None:
+            payload = ctx.marked(node.lineno, rule.name)
+            if payload:
+                name = payload.split()[0].rstrip(",;")
+        if name is None:
+            violations.append((
+                node.lineno,
+                f"slog.{which} with unresolvable event name — use "
+                "a literal, a literal parameter default, or an "
+                "'# lint-ok: obs-events: <name>' marker"))
+            continue
+        events.append((node.lineno, name))
+    return events, violations
+
+
+@register
+class ObsEventsRule(Rule):
+    id = "JL004"
+    name = "obs-events"
+    short = ("slog event names must be resolvable and in the "
+             "documented catalog")
+    scope = None
+    exclude = ("utils/slog.py",)      # builds the suffixed names
+    self_markers = True               # marker NAMES the event; the
+    #                                   named event is still checked
+
+    def collect(self, ctx):
+        """``(events, violations)`` without the catalog check — the
+        legacy ``scan_source`` contract."""
+        return _collect(ctx, self)
+
+    def check(self, ctx, config):
+        events, violations = self.collect(ctx)
+        for ln, msg in violations:
+            yield self.finding(ctx, ln, msg)
+        catalog = config.obs_catalog
+        doc_names = ", ".join(
+            __import__("os").path.basename(p)
+            for p in config.obs_docs) or "<no catalog docs>"
+        for ln, name in events:
+            if name not in catalog:
+                yield self.finding(
+                    ctx, ln,
+                    f"event {name!r} not in the catalog ({doc_names})"
+                    " — document it or rename to a documented event",
+                    data={"event": name})

@@ -1,0 +1,299 @@
+"""JL101 ``retrace-hazard`` — per-call jit-wrapper construction.
+
+The regression that has bitten this repo twice (thth fused search
+pre-PR-1; ``fit/batch.py:make_acf1d_batch`` pre-PR-4): ``jax.jit``
+caches compiled programs on FUNCTION IDENTITY, so a function that
+constructs a fresh ``jax.jit(...)`` / ``partial(jit, ...)`` /
+``jit(vmap(...))`` wrapper on every call retraces (and on a cold XLA
+cache recompiles) every call — ~320 ms/epoch measured on the CPU
+host, pure compile noise on the per-epoch survey path.
+
+The rule flags any jit-wrapper construction inside a function body
+that is NOT routed through one of the codebase's recognized caching
+idioms. A construction is **recognized** when any enclosing function:
+
+1. is a **module-cache guard** (the ``_SOLVER_CACHE`` /
+   ``_ACF1D_BATCH_CACHE`` pattern): the same name is both read with
+   ``X.get(...)`` and stored with ``X[key] = ...`` in the function
+   body — covers ``thth.core.keyed_jit_cache`` itself and every
+   dict-cached factory;
+2. is a **global-singleton builder** (the
+   ``sim/simulation.py:_jax_screen_program`` pattern): declares
+   ``global X`` and assigns one of those names;
+3. calls ``keyed_jit_cache(...)`` — the construction is the cache's
+   own builder plumbing;
+4. calls ``record_build(...)`` (obs/retrace.py) — a deliberate,
+   retrace-accounted factory whose every build is visible to the
+   tier-1 ``retrace_guard`` gate (the ``parallel/survey.py`` sharded
+   factories: cached by their callers, accounted at build);
+5. routes through the formulation registry's measured-build path
+   (``measure_formulation(...)``), which times and pins candidates
+   once per (op, platform).
+
+Also flagged: **unhashable cache keys** — a cache-guard function
+whose key expression contains a list/dict/set display (or a
+``list()``/``dict()``/``set()`` call): the first ``cache.get(key)``
+raises ``TypeError`` at runtime, or silently never hits if repr'd.
+
+Escape hatch: ``# lint-ok: retrace-hazard: <reason>`` on the
+construction line — for genuine one-shot builds (a user-facing API
+that compiles once per call by design, not an epoch path).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+from .import_jit import is_jit_callee
+
+#: calls whose presence in an enclosing function marks a recognized
+#: routing (cases 3–5 in the module docstring)
+_ROUTED_CALLS = {"keyed_jit_cache", "record_build",
+                 "measure_formulation"}
+
+
+def _called_names(fn):
+    """Bare / attribute callee names invoked anywhere in ``fn``'s
+    body (one level — the lexical body, including nested defs)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _base_name(node):
+    """The root Name id of a possibly-dotted expression, else None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _cache_guard_names(fn):
+    """Names that look like dict caches in ``fn``: read via
+    ``X.get(...)`` / ``X[key]`` / ``key in X`` AND stored via
+    ``X[...] = ...`` (or ``X.setdefault``). Returns
+    ``{name: [key_expr, ...]}`` with the key expressions (for the
+    unhashable-key check)."""
+    reads = {}
+    stores = set()
+    store_targets = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    base = _base_name(t.value)
+                    if base:
+                        stores.add(base)
+                        store_targets.add(id(t))
+    plain_reads = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("get", "setdefault") \
+                and node.args:
+            base = _base_name(node.func.value)
+            if base:
+                reads.setdefault(base, []).append(node.args[0])
+                if node.func.attr == "setdefault":
+                    stores.add(base)
+        elif isinstance(node, ast.Compare) \
+                and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            base = _base_name(node.comparators[0])
+            if base:
+                reads.setdefault(base, []).append(node.left)
+        elif isinstance(node, ast.Subscript) \
+                and id(node) not in store_targets:
+            base = _base_name(node.value)
+            if base:
+                # a plain ``X[key]`` read recognizes the guard but is
+                # NOT subjected to the unhashable-key check (numpy
+                # fancy indexing uses list literals legitimately)
+                plain_reads.add(base)
+    out = {n: keys for n, keys in reads.items() if n in stores}
+    for n in plain_reads & stores:
+        out.setdefault(n, [])
+    return out
+
+
+def _global_singleton_names(fn):
+    """Global names declared AND assigned in ``fn`` (the cached
+    module-singleton builder pattern)."""
+    declared = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            declared.update(node.names)
+    if not declared:
+        return set()
+    assigned = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id in declared:
+                        assigned.add(sub.id)
+    return assigned
+
+
+def _is_recognized(fn):
+    """True when ``fn`` routes its jit construction through a
+    recognized cache (docstring cases 1–5)."""
+    if _cache_guard_names(fn):
+        return True
+    if _global_singleton_names(fn):
+        return True
+    if _called_names(fn) & _ROUTED_CALLS:
+        return True
+    return False
+
+
+_HASHING_CALLS = {"tuple", "frozenset", "bytes", "str", "repr",
+                  "hash", "int", "float", "bool", "len", "id"}
+_UNHASHABLE_CALLS = {"list", "dict", "set", "sorted", "bytearray"}
+
+
+def _unhashable(expr):
+    """True when the key expression is structurally unhashable: a
+    list/dict/set display or comprehension, or a
+    ``list()``/``dict()``/``set()``/``sorted()`` call — at any tuple
+    nesting depth. Conversions that PRODUCE hashables
+    (``tuple(...)``, ``frozenset(...)``, ``.tobytes()``, arbitrary
+    calls) are not descended into: ``tuple(d.id for d in devs)`` is a
+    fine key."""
+    if isinstance(expr, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.SetComp, ast.DictComp)):
+        return True
+    if isinstance(expr, ast.Tuple):
+        return any(_unhashable(e) for e in expr.elts)
+    if isinstance(expr, ast.Starred):
+        return _unhashable(expr.value)
+    if isinstance(expr, ast.Call):
+        name = None
+        if isinstance(expr.func, ast.Name):
+            name = expr.func.id
+        if name in _UNHASHABLE_CALLS:
+            return True
+        # tuple()/frozenset()/.tobytes()/unknown calls: trust the
+        # conversion
+        return False
+    return False
+
+
+def _key_assignments(fn, name):
+    """Value expressions assigned to ``name`` inside ``fn`` (simple
+    single-target assignments)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name:
+            yield node.value, node.lineno
+
+
+@register
+class RetraceHazardRule(Rule):
+    id = "JL101"
+    name = "retrace-hazard"
+    short = ("jit wrapper constructed per call outside a recognized "
+             "cache; unhashable cache keys")
+    scope = None
+
+    MSG = ("jit wrapper constructed per call — jax.jit caches on "
+           "function identity, so this retraces every invocation "
+           "(~0.3 s/epoch measured, the PR-4 fit/batch.py trap); "
+           "route it through a keyed cache (keyed_jit_cache / "
+           "_SOLVER_CACHE pattern), account it with "
+           "obs.retrace.record_build, or mark a deliberate one-shot "
+           "build with `# lint-ok: retrace-hazard: <reason>`")
+
+    def check(self, ctx, config):
+        seen = set()
+        recognized = {}      # id(fn) -> bool, memoized per run
+
+        def chain_ok(site):
+            for fn in ctx.enclosing_functions(site):
+                if isinstance(fn, ast.Lambda):
+                    continue
+                ok = recognized.get(id(fn))
+                if ok is None:
+                    ok = recognized[id(fn)] = _is_recognized(fn)
+                if ok:
+                    return True
+            return False
+
+        # functions containing a subscript store are the only
+        # cache-guard candidates — gates the per-function sub-walks
+        guard_candidates = []
+        for node in ctx.nodes:
+            call = None
+            if isinstance(node, ast.Call):
+                if is_jit_callee(node.func):
+                    call = node
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id == "partial"
+                      and any(is_jit_callee(a) for a in node.args)):
+                    call = node
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # a bare @jax.jit decorator on a NESTED def is a
+                # per-call wrapper too (module-level ones are
+                # import-jit's territory)
+                for dec in node.decorator_list:
+                    if not is_jit_callee(dec):
+                        continue
+                    if not ctx.enclosing_functions(node):
+                        continue
+                    if chain_ok(node):
+                        continue
+                    key = (dec.lineno, "dec")
+                    if key not in seen:
+                        seen.add(key)
+                        yield self.finding(ctx, dec.lineno, self.MSG)
+            elif isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Subscript)
+                    for t in node.targets):
+                fns = ctx.enclosing_functions(node)
+                if fns and not isinstance(fns[0], ast.Lambda):
+                    guard_candidates.append(fns[0])
+            if call is None:
+                continue
+            if not ctx.enclosing_functions(call):
+                continue              # module level → import-jit rule
+            if chain_ok(call):
+                continue
+            key = (call.lineno, "call")
+            if key not in seen:
+                seen.add(key)
+                yield self.finding(ctx, call.lineno, self.MSG)
+
+        # unhashable cache keys in cache-guard functions
+        checked = set()
+        for node in guard_candidates:
+            if id(node) in checked:
+                continue
+            checked.add(id(node))
+            for cache, key_exprs in _cache_guard_names(node).items():
+                for key_expr in key_exprs:
+                    exprs = [(key_expr, key_expr.lineno)]
+                    if isinstance(key_expr, ast.Name):
+                        exprs = list(_key_assignments(node,
+                                                      key_expr.id))
+                    for expr, lineno in exprs:
+                        if _unhashable(expr) \
+                                and (lineno, "key") not in seen:
+                            seen.add((lineno, "key"))
+                            yield self.finding(
+                                ctx, lineno,
+                                f"cache key for `{cache}` contains an "
+                                "unhashable list/dict/set — the "
+                                "cache lookup raises TypeError (or "
+                                "never hits); use tuples / "
+                                ".tobytes() for array-valued keys")

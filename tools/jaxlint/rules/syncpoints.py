@@ -1,0 +1,154 @@
+"""JL003 ``syncpoints`` — no premature device-sync points in library
+hot paths (ported from tools/lint_syncpoints.py, ISSUE 4).
+
+The pipelined survey engine (parallel/pipeline.py + robust/runner.py)
+only overlaps host work with device compute if the dispatch chain
+stays ASYNC: a stray ``.block_until_ready()`` or an eager
+``np.asarray(...)`` on an in-flight device value inside a library hot
+path fences the whole device queue and silently serialises the
+pipeline.
+
+Flagged patterns:
+
+1. ANY ``.block_until_ready`` use (method call or
+   ``jax.block_until_ready(x)``) — fencing belongs to profiling
+   (utils/profiling.py, excluded) and bench timing, never library
+   code;
+2. ``jax.device_get(...)`` / ``x.device_get(...)`` — same;
+3. ``np.asarray(f(...))`` / ``float(f(...))`` / ``int(f(...))``
+   where the wrapped call FEEDS DEVICE INPUTS (its argument subtree
+   contains ``jnp.asarray`` / ``device_put``): dispatch-and-fetch in
+   one expression, the classic hidden sync;
+4. ``np.asarray(g(...))`` / ``float(g(...))`` where ``g`` is a name
+   bound from ``jax.jit(...)`` (or ``*.jit(...)``) in the same
+   module — fetching a jitted program's result eagerly.
+
+Escape hatch: ``# lint-ok: syncpoints: <reason>`` (legacy
+``# sync-ok: <reason>`` still honored) marks a deliberate
+result-consumption boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+# callee names that fetch/force a value to host
+_FETCHERS = ("asarray", "device_get", "to_numpy")
+_CASTS = ("float", "int")
+# attribute names marking an expression as producing device inputs
+_DEVICE_FEEDERS = ("device_put",)
+
+
+def _attr_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_jnp_asarray(node):
+    """True for ``jnp.asarray(...)`` / ``jax.numpy.asarray`` calls —
+    the device-staging idiom (vs plain ``np.asarray``)."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr not in ("asarray",) + _DEVICE_FEEDERS:
+        return False
+    base = node.func.value
+    base_name = base.id if isinstance(base, ast.Name) else (
+        base.attr if isinstance(base, ast.Attribute) else None)
+    if node.func.attr in _DEVICE_FEEDERS:
+        return True                      # jax.device_put(...)
+    return base_name in ("jnp", "jaxnp")
+
+
+def _feeds_device(call):
+    """True when any argument subtree of ``call`` stages device
+    inputs (jnp.asarray / device_put)."""
+    for arg in list(call.args) + [k.value for k in call.keywords]:
+        for sub in ast.walk(arg):
+            if _is_jnp_asarray(sub):
+                return True
+    return False
+
+
+def _jit_bound_names(tree):
+    """Names assigned (anywhere in the module) from a ``*.jit(...)``
+    or bare ``jit(...)`` call — simple single-target assignments
+    only."""
+    names = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) \
+                and _attr_name(value.func) == "jit":
+            names.add(node.targets[0].id)
+    return names
+
+
+@register
+class SyncpointsRule(Rule):
+    id = "JL003"
+    name = "syncpoints"
+    short = ("premature device fences (.block_until_ready / eager "
+             "fetch of in-flight values) in hot paths")
+    # the library hot paths the pipelined engine flows through; the
+    # scan list grew with ISSUEs 4→7 (see tests/test_lint.py history)
+    scope = ("ops/", "fit/", "thth/", "parallel/", "serve/",
+             "robust/", "obs/", "dynspec.py")
+    # profiling's whole JOB is fencing
+    exclude = ("utils/profiling.py",)
+
+    def check(self, ctx, config):
+        jit_names = _jit_bound_names(ctx.tree)
+        seen = set()
+        for node in ctx.nodes:
+            # rule 1/2: block_until_ready / device_get anywhere
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in ("block_until_ready",
+                                      "device_get"):
+                key = (node.lineno, node.attr)
+                if key not in seen:
+                    seen.add(key)
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"`.{node.attr}` fences the device queue — "
+                        "library hot paths must stay async (profile "
+                        "with utils/profiling.py; mark a deliberate "
+                        "consumption boundary with "
+                        "`# lint-ok: syncpoints: <reason>`)")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = _attr_name(node.func)
+            if name not in _FETCHERS + _CASTS or not node.args:
+                continue
+            inner = node.args[0]
+            if not isinstance(inner, ast.Call):
+                continue
+            inner_name = _attr_name(inner.func)
+            flagged = None
+            if isinstance(inner.func, ast.Name) \
+                    and inner.func.id in jit_names:
+                flagged = (f"fetching the jit-bound `{inner.func.id}` "
+                           "result eagerly")
+            elif _feeds_device(inner):
+                flagged = (f"`{name}({inner_name or '<call>'}(...))` "
+                           "dispatches device inputs and fetches the "
+                           "result in one expression")
+            if flagged:
+                key = (node.lineno, flagged)
+                if key not in seen:
+                    seen.add(key)
+                    yield self.finding(
+                        ctx, node.lineno,
+                        flagged + " — a hidden sync point; keep the "
+                        "value in flight or mark the consumption "
+                        "boundary with "
+                        "`# lint-ok: syncpoints: <reason>`")

@@ -1,0 +1,104 @@
+"""Legacy-contract adapters for the four standalone lint scripts.
+
+``tools/lint_excepts.py`` / ``lint_import_jit.py`` /
+``lint_syncpoints.py`` / ``lint_obs_events.py`` are kept as thin
+shims over the unified framework (same function shapes, same CLI
+exit codes) so existing callers — and muscle memory — keep working.
+Each shim's ``scan_source`` returns the legacy ``[(line, message)]``
+tuples, ``scan_tree`` the legacy ``[(path, line, message)]``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .framework import RULES, Config, FileContext, iter_py_files
+from . import rules as _rules  # noqa: F401  (populate registry)
+
+
+def _excluded(rule, path, root):
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return any(rel.endswith(e) for e in rule.exclude)
+
+
+def scan_source(rule_name, source, filename="<string>"):
+    """Legacy ``[(line, message)]`` for one source blob (marker
+    suppression applied)."""
+    rule = RULES[rule_name]
+    return sorted({f.legacy() for f in
+                   rule.scan_source(source, filename)})
+
+
+def scan_file(rule_name, path):
+    with open(path, encoding="utf-8") as fh:
+        return scan_source(rule_name, fh.read(), filename=path)
+
+
+def scan_tree(rule_name, root):
+    """Legacy ``[(path, line, message)]`` over every ``*.py`` under
+    ``root`` (the rule's own exclude list — e.g. the syncpoints
+    profiling allowlist — is honored)."""
+    rule = RULES[rule_name]
+    out = []
+    for path in iter_py_files(root):
+        if _excluded(rule, path, root):
+            continue
+        out.extend((path, line, msg)
+                   for line, msg in scan_file(rule_name, path))
+    return out
+
+
+def main(rule_name, argv, default_targets, label):
+    """Legacy CLI driver: scan the targets, print ``path:line:
+    message`` lines, exit 1 on violations."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        args = default_targets()
+    violations = []
+    for target in args:
+        if os.path.isdir(target):
+            violations.extend(scan_tree(rule_name, target))
+        else:
+            violations.extend((target, line, msg) for line, msg
+                              in scan_file(rule_name, target))
+    for path, line, msg in violations:
+        print(f"{path}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} {label} violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+# ---- obs-events legacy contract (events + catalog) ------------------
+
+def obs_collect(source, filename="<src>"):
+    """Legacy ``(events, violations)`` — event emissions as
+    ``[(lineno, name)]`` (markers resolve names), violations as
+    ``[(lineno, message)]``; no catalog check. Raises SyntaxError
+    like the legacy scanner."""
+    ctx = FileContext(filename, source=source, rel=filename)
+    if ctx.syntax_error is not None:
+        raise ctx.syntax_error
+    return RULES["obs-events"].collect(ctx)
+
+
+def obs_scan_tree(root, doc_path):
+    """Legacy obs-events tree scan against the catalog at
+    ``doc_path`` (one path or several) →
+    ``[(path, lineno, message)]``."""
+    rule = RULES["obs-events"]
+    paths = [doc_path] if isinstance(doc_path, (str, os.PathLike)) \
+        else list(doc_path)
+    config = Config(obs_docs=[os.fspath(p) for p in paths])
+    out = []
+    for path in iter_py_files(root):
+        if _excluded(rule, path, root):
+            continue
+        ctx = FileContext(path)
+        if ctx.syntax_error is not None:
+            raise ctx.syntax_error
+        out.extend((path, f.line, f.message)
+                   for f in rule.check(ctx, config))
+    return out

@@ -1,125 +1,52 @@
 #!/usr/bin/env python
-"""Repo lint: forbid silent exception swallowing in scintools_tpu/.
+"""Thin shim — exception-hygiene lint, now rule ``excepts`` (JL001)
+in the unified framework (``python -m tools.jaxlint``; rule catalog:
+docs/static-analysis.md).
 
-Two patterns defeat the robustness layer (ISSUE 2) by hiding failures
-the survey runner / fallback ladder is supposed to see and report:
+Forbids bare ``except:`` and silent ``except Exception: pass`` in
+scintools_tpu/ — the two patterns that defeat the robustness layer
+(ISSUE 2) by hiding failures the survey runner / fallback ladder is
+supposed to see and report. Escape hatch:
+``# broad-except-ok: <reason>`` (or the unified
+``# lint-ok: excepts: <reason>``) on the ``except`` line.
 
-- bare ``except:`` — catches SystemExit/KeyboardInterrupt too, so a
-  survey cannot even be stopped cleanly;
-- ``except Exception:`` (or BaseException) whose body is ONLY
-  ``pass``/``...`` — the classic swallow-all that turns a corrupt
-  epoch into silent garbage.
-
-Broad handlers that *do something* (log, return a fallback, re-raise)
-are allowed — the codebase legitimately guards best-effort paths that
-way. A genuinely unavoidable swallow-all can be exempted with a
-``broad-except-ok: <reason>`` comment on the ``except`` line.
-
-Run as a script (exit 1 on violations) or via tests/test_lint.py,
-which makes it part of the tier-1 gate.
+Legacy API preserved: ``scan_source`` → ``[(line, message)]``,
+``scan_tree`` → ``[(path, line, message)]``, ``main`` exits 1 on
+violations.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.jaxlint import shim as _shim  # noqa: E402
+
 MARKER = "broad-except-ok"
-
-_BROAD = ("Exception", "BaseException")
-
-
-def _is_broad(node):
-    """True for ``except Exception``/``BaseException`` (bound or
-    not), including tuple forms containing one."""
-    t = node.type
-    if t is None:
-        return False
-    elts = t.elts if isinstance(t, ast.Tuple) else [t]
-    return any(isinstance(e, ast.Name) and e.id in _BROAD
-               for e in elts)
-
-
-def _swallows(node):
-    """True when the handler body is only ``pass``/``...`` — nothing
-    logged, nothing returned, nothing re-raised."""
-    for stmt in node.body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if (isinstance(stmt, ast.Expr)
-                and isinstance(stmt.value, ast.Constant)
-                and stmt.value.value is Ellipsis):
-            continue
-        return False
-    return True
+_RULE = "excepts"
 
 
 def scan_source(source, filename="<string>"):
-    """Lint one source string → list of ``(line, message)``."""
-    try:
-        tree = ast.parse(source, filename=filename)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = source.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) \
-            else ""
-        if MARKER in line:
-            continue
-        if node.type is None:
-            out.append((node.lineno,
-                        "bare 'except:' (catches KeyboardInterrupt/"
-                        "SystemExit; name the exceptions)"))
-        elif _is_broad(node) and _swallows(node):
-            out.append((node.lineno,
-                        "'except Exception: pass' swallows all "
-                        "failures silently (log it, narrow it, or "
-                        f"mark '{MARKER}: <reason>')"))
-    return sorted(out)
+    return _shim.scan_source(_RULE, source, filename)
 
 
 def scan_file(path):
-    with open(path, encoding="utf-8") as fh:
-        return scan_source(fh.read(), filename=path)
+    return _shim.scan_file(_RULE, path)
 
 
 def scan_tree(root):
-    """Lint every ``*.py`` under ``root`` → list of
-    ``(path, line, message)``."""
-    out = []
-    for base, _, names in sorted(os.walk(root)):
-        for name in sorted(names):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(base, name)
-            out.extend((path, line, msg)
-                       for line, msg in scan_file(path))
-    return out
+    return _shim.scan_tree(_RULE, root)
 
 
 def main(argv=None):
-    args = list(sys.argv[1:] if argv is None else argv)
-    if not args:
-        args = [os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "..", "scintools_tpu")]
-    violations = []
-    for target in args:
-        if os.path.isdir(target):
-            violations.extend(scan_tree(target))
-        else:
-            violations.extend((target, line, msg)
-                              for line, msg in scan_file(target))
-    for path, line, msg in violations:
-        print(f"{path}:{line}: {msg}")
-    if violations:
-        print(f"{len(violations)} exception-hygiene violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
+    return _shim.main(
+        _RULE, argv,
+        lambda: [os.path.join(_REPO, "scintools_tpu")],
+        "exception-hygiene")
 
 
 if __name__ == "__main__":

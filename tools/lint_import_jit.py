@@ -1,130 +1,53 @@
 #!/usr/bin/env python
-"""Repo lint: forbid import-time ``jax.jit`` in the fit layer.
+"""Thin shim — import-time-jit lint, now rule ``import-jit`` (JL002)
+in the unified framework (``python -m tools.jaxlint``; rule catalog:
+docs/static-analysis.md).
 
-A ``jax.jit(...)`` (or ``@jax.jit`` decorator / ``partial(jax.jit)``)
-executed at module import time forces the jax backend to initialise
-before any fit is requested: cold-start of every CLI entry and test
-collection pays it, and on the tunneled TPU an import can then HANG on
-a dead link (backend.py:force_cpu_platform docstring). The fit layer's
-contract is that compiled programs are built lazily inside factory
-functions and cached on their static configuration
-(fit/acf2d.py:_SOLVER_CACHE, thth/core.py:keyed_jit_cache) — this lint
-keeps that true structurally.
+Forbids ``jax.jit`` (calls, ``@jax.jit`` decorators,
+``partial(jax.jit)``) reachable at module import time — compiled
+programs must be built lazily inside cached factories
+(fit/acf2d.py:_SOLVER_CACHE, thth/core.py:keyed_jit_cache) so
+cold-start and test collection stay fast and cannot hang on a dead
+accelerator tunnel (ISSUE 3). The unified rule now scans the whole
+package; this shim's CLI keeps the legacy ``scintools_tpu/fit``
+default target.
 
-Flagged: any call whose callee is named ``jit`` (``jax.jit``,
-``get_jax().jit``, bare ``jit``) or ``partial(...jit...)`` reachable
-at IMPORT TIME — module body, class bodies, module-level decorator
-lists, and function default arguments. Calls inside function bodies
-(deferred to call time) are fine.
-
-Run as a script (exit 1 on violations) or via tests/test_lint.py,
-which makes it part of the tier-1 gate over ``scintools_tpu/fit/``.
+Legacy API preserved: ``scan_source`` → ``[(line, message)]``,
+``scan_tree`` → ``[(path, line, message)]``, ``main`` exits 1 on
+violations.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-def _is_jit_callee(node):
-    """True when a Call's func resolves to a name ending in ``jit``."""
-    if isinstance(node, ast.Attribute):
-        return node.attr == "jit"
-    if isinstance(node, ast.Name):
-        return node.id == "jit"
-    return False
+from tools.jaxlint import shim as _shim  # noqa: E402
 
-
-def _jit_calls(node):
-    """Yield Call nodes invoking jit anywhere under ``node``."""
-    for sub in ast.walk(node):
-        if not isinstance(sub, ast.Call):
-            continue
-        if _is_jit_callee(sub.func):
-            yield sub
-        elif (isinstance(sub.func, ast.Name)
-              and sub.func.id == "partial"
-              and any(_is_jit_callee(a) for a in sub.args)):
-            yield sub
-
-
-def _import_time_nodes(body):
-    """Yield ``(node, is_decorator)`` pairs for AST nodes whose code
-    executes when the module is imported: statements in module/class
-    bodies, decorators and argument defaults of (possibly
-    nested-in-class) function defs — but NOT function bodies. A BARE
-    jit decorator (``@jax.jit`` — an Attribute, not a Call) still
-    invokes jit at def time, so decorators are flagged."""
-    for stmt in body:
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield from ((d, True) for d in stmt.decorator_list)
-            yield from ((d, False) for d in stmt.args.defaults)
-            yield from ((d, False) for d in stmt.args.kw_defaults
-                        if d is not None)
-        elif isinstance(stmt, ast.ClassDef):
-            yield from ((d, True) for d in stmt.decorator_list)
-            yield from _import_time_nodes(stmt.body)
-        else:
-            yield stmt, False
+_RULE = "import-jit"
 
 
 def scan_source(source, filename="<string>"):
-    """Lint one source string → list of ``(line, message)``."""
-    try:
-        tree = ast.parse(source, filename=filename)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    msg = ("jax.jit at import time (build compiled programs lazily "
-           "inside a cached factory — fit/acf2d.py:_SOLVER_CACHE "
-           "pattern)")
-    out = []
-    for node, is_decorator in _import_time_nodes(tree.body):
-        if is_decorator and _is_jit_callee(node):
-            out.append((node.lineno, msg))     # bare @jax.jit
-            continue
-        for call in _jit_calls(node):
-            out.append((call.lineno, msg))
-    return sorted(set(out))
+    return _shim.scan_source(_RULE, source, filename)
 
 
 def scan_file(path):
-    with open(path, encoding="utf-8") as fh:
-        return scan_source(fh.read(), filename=path)
+    return _shim.scan_file(_RULE, path)
 
 
 def scan_tree(root):
-    out = []
-    for base, _, names in sorted(os.walk(root)):
-        for name in sorted(names):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(base, name)
-            out.extend((path, line, msg)
-                       for line, msg in scan_file(path))
-    return out
+    return _shim.scan_tree(_RULE, root)
 
 
 def main(argv=None):
-    args = list(sys.argv[1:] if argv is None else argv)
-    if not args:
-        args = [os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "..", "scintools_tpu", "fit")]
-    violations = []
-    for target in args:
-        if os.path.isdir(target):
-            violations.extend(scan_tree(target))
-        else:
-            violations.extend((target, line, msg)
-                              for line, msg in scan_file(target))
-    for path, line, msg in violations:
-        print(f"{path}:{line}: {msg}")
-    if violations:
-        print(f"{len(violations)} import-time-jit violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
+    return _shim.main(
+        _RULE, argv,
+        lambda: [os.path.join(_REPO, "scintools_tpu", "fit")],
+        "import-time-jit")
 
 
 if __name__ == "__main__":

@@ -1,52 +1,43 @@
 #!/usr/bin/env python
-"""Repo lint: every slog event name must be in the documented catalog.
+"""Thin shim — obs-event-catalog lint, now rule ``obs-events``
+(JL004) in the unified framework (``python -m tools.jaxlint``; rule
+catalog: docs/static-analysis.md).
 
-The observability layer (ISSUE 5) is only useful if the event stream
-is a stable, documented interface — a dashboard or grep that works
-today must not silently miss next month's renamed event. This lint
-walks ``scintools_tpu/`` for every ``slog.log_event(...)`` /
-``slog.log_failure(...)`` / ``slog.span(...)`` call and checks the
-event name against the catalog in ``docs/observability.md``:
+Every ``slog.log_event(...)`` / ``slog.log_failure(...)`` /
+``slog.span(...)`` event name in scintools_tpu/ must appear
+backtick-quoted in the documented catalog (docs/observability.md +
+docs/serving.md) — the event stream is a stable interface, not a
+place for drive-by unnamed events (ISSUE 5). Non-literal names carry
+``# obs-event-ok: <name>`` (or the unified
+``# lint-ok: obs-events: <name>``); the named event is then
+catalog-checked like any other.
 
-- a **literal** first argument (or ``event=`` keyword) is resolved
-  directly;
-- a plain **variable** is resolved through the enclosing function's
-  default for that parameter (the ``def log_summary(self, event=
-  "survey.pipeline_timeline")`` pattern);
-- anything else (attributes, f-strings, arbitrary expressions) must
-  carry an ``# obs-event-ok: <name>`` marker on the call line naming
-  the event it emits — the named event is then catalog-checked like
-  any other. No marker → violation ("drive-by unnamed event").
-
-A name is "documented" when it appears backtick-quoted in
-docs/observability.md. ``span`` names are cataloged by their base
-name (the ``.start``/``.end`` suffix convention is documented once).
-``utils/slog.py`` itself is exempt (it builds the suffixed names).
-
-Run as a script (exit 1 on violations) or via tests/test_lint.py,
-which makes it part of the tier-1 gate.
+Legacy API preserved: ``catalog_names(doc_path)``,
+``scan_source(src)`` → ``(events, violations)`` (no catalog check),
+``scan_tree(root, doc_path)`` → ``[(path, line, message)]``,
+``main(sys.argv-style)``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import re
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.jaxlint import shim as _shim  # noqa: E402
+
 MARKER = "obs-event-ok"
-_CALLS = {"log_event", "log_failure", "span"}
-# literal defaults of slog.log_failure's own ``event`` parameter —
-# calls that omit the argument emit this name
-_IMPLICIT = {"log_failure": "robust.failure"}
 
 _EXEMPT = (os.path.join("utils", "slog.py"),)
 
 
 def catalog_names(doc_path):
     """Backtick-quoted dotted names in the event-catalog doc(s) —
-    ``doc_path`` is one path or an iterable of paths (the catalog
-    spans docs/observability.md and docs/serving.md)."""
+    ``doc_path`` is one path or an iterable of paths."""
     paths = [doc_path] if isinstance(doc_path, (str, os.PathLike)) \
         else list(doc_path)
     names = set()
@@ -58,145 +49,20 @@ def catalog_names(doc_path):
     return names
 
 
-def _is_slog_call(node):
-    """``slog.log_event(...)`` / ``slog.span(...)`` — the attribute
-    form requires the receiver to be named ``slog`` (``span`` is a
-    common method name: ``StageTimeline.span`` records stage spans,
-    not events). Bare imported ``log_event``/``log_failure`` names
-    are distinctive enough to match directly."""
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr in _CALLS \
-            and isinstance(f.value, ast.Name) and f.value.id == "slog":
-        return f.attr
-    if isinstance(f, ast.Name) and f.id in _CALLS and f.id != "span":
-        return f.id
-    return None
-
-
-def _event_arg(node):
-    """The AST node holding the event name (first positional or the
-    ``event=`` keyword), or None when omitted."""
-    if node.args:
-        return node.args[0]
-    for kw in node.keywords:
-        if kw.arg == "event":
-            return kw.value
-    return None
-
-
-class _Scanner(ast.NodeVisitor):
-    """Collects (lineno, event_name) emissions and (lineno, message)
-    violations, resolving variable names through enclosing-function
-    parameter defaults."""
-
-    def __init__(self, lines):
-        self.lines = lines
-        self.events = []
-        self.violations = []
-        self._defaults = [{}]      # stack of {param: literal-default}
-
-    def _fn_defaults(self, node):
-        out = {}
-        args = node.args
-        pos = args.posonlyargs + args.args
-        for a, d in zip(pos[len(pos) - len(args.defaults):],
-                        args.defaults):
-            if isinstance(d, ast.Constant) and isinstance(d.value, str):
-                out[a.arg] = d.value
-        for a, d in zip(args.kwonlyargs, args.kw_defaults):
-            if d is not None and isinstance(d, ast.Constant) \
-                    and isinstance(d.value, str):
-                out[a.arg] = d.value
-        return out
-
-    def visit_FunctionDef(self, node):
-        self._defaults.append(self._fn_defaults(node))
-        self.generic_visit(node)
-        self._defaults.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def _marker_name(self, lineno):
-        line = self.lines[lineno - 1] if lineno <= len(self.lines) \
-            else ""
-        m = re.search(MARKER + r":\s*([\w.]+)", line)
-        return m.group(1) if m else None
-
-    def visit_Call(self, node):
-        which = _is_slog_call(node)
-        if which is None:
-            self.generic_visit(node)
-            return
-        arg = _event_arg(node)
-        name = None
-        if arg is None:
-            name = _IMPLICIT.get(which)
-        elif isinstance(arg, ast.Constant) and isinstance(arg.value,
-                                                          str):
-            name = arg.value
-        elif isinstance(arg, ast.Name):
-            for scope in reversed(self._defaults):
-                if arg.id in scope:
-                    name = scope[arg.id]
-                    break
-        if name is None:
-            name = self._marker_name(node.lineno)
-            if name is None:
-                self.violations.append((
-                    node.lineno,
-                    f"slog.{which} with unresolvable event name — use "
-                    f"a literal, a literal parameter default, or an "
-                    f"'# {MARKER}: <name>' marker"))
-                self.generic_visit(node)
-                return
-        self.events.append((node.lineno, name))
-        self.generic_visit(node)
-
-
 def scan_source(src, filename="<src>"):
     """``(events, violations)`` for one source blob: events as
     ``[(lineno, name)]``, violations as ``[(lineno, message)]``."""
-    tree = ast.parse(src, filename=filename)
-    sc = _Scanner(src.splitlines())
-    sc.visit(tree)
-    return sc.events, sc.violations
+    return _shim.obs_collect(src, filename)
 
 
 def scan_tree(root, doc_path):
-    """Walk ``root`` for python files; return ``[(path, lineno,
-    message)]`` violations — unresolvable event names plus any
-    emitted name missing from the catalog at ``doc_path`` (one path
-    or several)."""
-    catalog = catalog_names(doc_path)
-    doc_names = ", ".join(
-        os.path.basename(p) for p in
-        ([doc_path] if isinstance(doc_path, (str, os.PathLike))
-         else doc_path))
-    out = []
-    for dirpath, _, files in os.walk(root):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            if rel in _EXEMPT:
-                continue
-            with open(path, encoding="utf-8") as fh:
-                src = fh.read()
-            events, violations = scan_source(src, filename=path)
-            out.extend((path, ln, msg) for ln, msg in violations)
-            for ln, name in events:
-                if name not in catalog:
-                    out.append((
-                        path, ln,
-                        f"event {name!r} not in the catalog "
-                        f"({doc_names}) — document "
-                        f"it or rename to a documented event"))
-    return out
+    """Violations (unresolvable names + catalog misses) as
+    ``[(path, lineno, message)]``."""
+    return _shim.obs_scan_tree(root, doc_path)
 
 
 def main(argv):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = _REPO
     root = argv[1] if len(argv) > 1 else os.path.join(repo,
                                                       "scintools_tpu")
     docs = argv[2:] if len(argv) > 2 else [

@@ -1,172 +1,41 @@
 #!/usr/bin/env python
-"""Repo lint: forbid premature device-sync points in library hot paths.
+"""Thin shim — sync-point lint, now rule ``syncpoints`` (JL003) in
+the unified framework (``python -m tools.jaxlint``; rule catalog:
+docs/static-analysis.md).
 
-The pipelined survey engine (parallel/pipeline.py + robust/runner.py)
-only overlaps host work with device compute if the dispatch chain
-stays ASYNC: a stray ``.block_until_ready()`` or an eager
-``np.asarray(...)`` on an in-flight device value inside a library hot
-path fences the whole device queue and silently serialises the
-pipeline. This lint keeps the hot paths (``ops/``, ``fit/``,
-``thth/``, ``parallel/``) structurally free of such syncs.
+Forbids premature device-sync points (``.block_until_ready``,
+``jax.device_get``, eager ``np.asarray``/``float``/``int`` on
+in-flight device values) in the library hot paths — the pipelined
+survey engine (ISSUE 4) only overlaps host and device work if the
+dispatch chain stays async. Deliberate result-consumption boundaries
+carry ``# sync-ok: <reason>`` (or the unified
+``# lint-ok: syncpoints: <reason>``); utils/profiling.py, whose job
+IS fencing, is allowlisted.
 
-Flagged patterns:
-
-1. ANY ``.block_until_ready`` use (method call or
-   ``jax.block_until_ready(x)``) — fencing belongs to profiling
-   (utils/profiling.py, allowlisted) and bench timing, never library
-   code;
-2. ``jax.device_get(...)`` / ``x.device_get(...)`` — same;
-3. ``np.asarray(f(...))`` / ``float(f(...))`` / ``int(f(...))``
-   where the wrapped call FEEDS DEVICE INPUTS (its argument subtree
-   contains ``jnp.asarray`` / ``device_put``): dispatch-and-fetch in
-   one expression, the classic hidden sync;
-4. ``np.asarray(g(...))`` / ``float(g(...))`` where ``g`` is a name
-   bound from ``jax.jit(...)`` (or ``*.jit(...)``) in the same
-   module — fetching a jitted program's result eagerly.
-
-Escape hatches (the pipelined engine still needs SOME fences):
-
-- a trailing ``# sync-ok: <reason>`` comment on the flagged line
-  marks a deliberate result-consumption boundary (e.g. the host API
-  edge of ``multi_chunk_search``, where numpy results are the
-  contract);
-- ``ALLOWLIST_FILES`` exempts whole files whose JOB is fencing
-  (utils/profiling.py — outside the scanned dirs but listed for
-  completeness and for callers scanning wider roots).
-
-Run as a script (exit 1 on violations) or via tests/test_lint.py,
-which makes it part of the tier-1 gate over the four hot-path
-packages.
+Legacy API preserved: ``scan_source`` → ``[(line, message)]``,
+``scan_tree`` → ``[(path, line, message)]``, ``_allowlisted``,
+``main`` exits 1 on violations.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-# paths (relative to the scan root, '/'-separated) whose whole file is
-# exempt: their job IS synchronisation
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.jaxlint import shim as _shim  # noqa: E402
+
+MARKER = "sync-ok:"
+_RULE = "syncpoints"
+
+# kept for callers scanning wider roots (legacy contract)
 ALLOWLIST_FILES = (
     "utils/profiling.py",
     "scintools_tpu/utils/profiling.py",
 )
-
-MARKER = "sync-ok:"
-
-# callee names that fetch/force a value to host
-_FETCHERS = ("asarray", "device_get", "to_numpy")
-_CASTS = ("float", "int")
-# attribute names marking an expression as producing device inputs
-_DEVICE_FEEDERS = ("device_put",)
-
-
-def _attr_name(func):
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
-
-
-def _is_jnp_asarray(node):
-    """True for ``jnp.asarray(...)`` / ``jax.numpy.asarray`` calls —
-    the device-staging idiom (vs plain ``np.asarray``)."""
-    if not (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)):
-        return False
-    if node.func.attr not in ("asarray",) + _DEVICE_FEEDERS:
-        return False
-    base = node.func.value
-    base_name = base.id if isinstance(base, ast.Name) else (
-        base.attr if isinstance(base, ast.Attribute) else None)
-    if node.func.attr in _DEVICE_FEEDERS:
-        return True                      # jax.device_put(...)
-    return base_name in ("jnp", "jaxnp")
-
-
-def _feeds_device(call):
-    """True when any argument subtree of ``call`` stages device
-    inputs (jnp.asarray / device_put)."""
-    for arg in list(call.args) + [k.value for k in call.keywords]:
-        for sub in ast.walk(arg):
-            if _is_jnp_asarray(sub):
-                return True
-    return False
-
-
-def _jit_bound_names(tree):
-    """Names assigned (anywhere in the module) from a ``*.jit(...)``
-    or bare ``jit(...)`` call — simple single-target assignments
-    only."""
-    names = set()
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Assign)
-                and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)):
-            continue
-        value = node.value
-        if isinstance(value, ast.Call) \
-                and _attr_name(value.func) == "jit":
-            names.add(node.targets[0].id)
-    return names
-
-
-def scan_source(source, filename="<string>"):
-    """Lint one source string → list of ``(line, message)``."""
-    try:
-        tree = ast.parse(source, filename=filename)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = source.splitlines()
-
-    def marked(lineno):
-        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
-        return MARKER in line
-
-    jit_names = _jit_bound_names(tree)
-    out = []
-    for node in ast.walk(tree):
-        # rule 1/2: block_until_ready / device_get anywhere
-        if isinstance(node, ast.Attribute) \
-                and node.attr in ("block_until_ready", "device_get"):
-            if not marked(node.lineno):
-                out.append((node.lineno,
-                            f"`.{node.attr}` fences the device queue "
-                            "— library hot paths must stay async "
-                            "(profile with utils/profiling.py; mark "
-                            "a deliberate consumption boundary with "
-                            "`# sync-ok: <reason>`)"))
-            continue
-        if not isinstance(node, ast.Call):
-            continue
-        name = _attr_name(node.func)
-        if name not in _FETCHERS + _CASTS or not node.args:
-            continue
-        inner = node.args[0]
-        if not isinstance(inner, ast.Call):
-            continue
-        inner_name = _attr_name(inner.func)
-        flagged = None
-        if isinstance(inner.func, ast.Name) \
-                and inner.func.id in jit_names:
-            flagged = (f"fetching the jit-bound `{inner.func.id}` "
-                       "result eagerly")
-        elif _feeds_device(inner):
-            flagged = (f"`{name}({inner_name or '<call>'}(...))` "
-                       "dispatches device inputs and fetches the "
-                       "result in one expression")
-        if flagged and not marked(node.lineno):
-            out.append((node.lineno,
-                        flagged + " — a hidden sync point; keep the "
-                        "value in flight or mark the consumption "
-                        "boundary with `# sync-ok: <reason>`"))
-    return sorted(set(out))
-
-
-def scan_file(path):
-    with open(path, encoding="utf-8") as fh:
-        return scan_source(fh.read(), filename=path)
 
 
 def _allowlisted(path, root):
@@ -174,41 +43,25 @@ def _allowlisted(path, root):
     return any(rel.endswith(a) for a in ALLOWLIST_FILES)
 
 
+def scan_source(source, filename="<string>"):
+    return _shim.scan_source(_RULE, source, filename)
+
+
+def scan_file(path):
+    return _shim.scan_file(_RULE, path)
+
+
 def scan_tree(root):
-    out = []
-    for base, _, names in sorted(os.walk(root)):
-        for name in sorted(names):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(base, name)
-            if _allowlisted(path, root):
-                continue
-            out.extend((path, line, msg)
-                       for line, msg in scan_file(path))
-    return out
+    return _shim.scan_tree(_RULE, root)
 
 
 def main(argv=None):
-    args = list(sys.argv[1:] if argv is None else argv)
-    if not args:
-        pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "..", "scintools_tpu")
-        args = [os.path.join(pkg, d)
+    def defaults():
+        pkg = os.path.join(_REPO, "scintools_tpu")
+        return [os.path.join(pkg, d)
                 for d in ("ops", "fit", "thth", "parallel")]
-    violations = []
-    for target in args:
-        if os.path.isdir(target):
-            violations.extend(scan_tree(target))
-        else:
-            violations.extend((target, line, msg)
-                              for line, msg in scan_file(target))
-    for path, line, msg in violations:
-        print(f"{path}:{line}: {msg}")
-    if violations:
-        print(f"{len(violations)} sync-point violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
+
+    return _shim.main(_RULE, argv, defaults, "sync-point")
 
 
 if __name__ == "__main__":
