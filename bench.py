@@ -1882,6 +1882,7 @@ def main():
             "probe": state["probe"],
             "xla_cache_dir": state.get("xla_cache_dir"),
             "configs": dict(configs),
+            "program_fingerprints": state.get("program_fingerprints"),
             "total_bench_s": round(time.time() - t_start, 1),
         }
         if state["platform"] == "cpu":
@@ -2037,6 +2038,19 @@ def main():
         except Exception as e:          # noqa: BLE001 — record, go on
             configs[name] = {"error": repr(e)[:300]}
         _emit()
+    # per-site program fingerprints (obs/programs.py): a bench-to-
+    # bench diff of this block flags a formulation flip explicitly —
+    # the PR-7 incident ("sspec_thth 0.31x") was the STAGED program
+    # being timed while the fused one existed, invisible in the
+    # timing numbers alone. Traced abstractly (no execution), after
+    # the configs so a wedged tunnel cannot starve them of budget;
+    # NOT a bench config, so the config-count assertion stays put.
+    try:
+        from scintools_tpu.obs.programs import fingerprint_report
+
+        state["program_fingerprints"] = fingerprint_report()
+    except Exception as e:              # noqa: BLE001 — diagnostics,
+        state["program_fingerprints"] = {"error": repr(e)[:200]}
     timer.cancel()
     _emit()
 

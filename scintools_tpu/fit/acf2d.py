@@ -423,3 +423,32 @@ def fit_acf2d_tpu(params, ydata, weights, n_iter=60, precision=None,
                                  precision=precision,
                                  fresnel_method=fresnel_method)
     return results[0]
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("fit.acf2d_batch")
+def _probe_acf2d_batch():
+    """The cached vmapped analytic-ACF LM program through the REAL
+    ``_batch_program`` cache (so the probe audits the same jit
+    wrapper the survey warms), at a fixed 9x9 crop with the
+    throughput precision policy."""
+    import jax
+
+    vary = ("tau", "dnu", "amp")
+    lo = np.array([1e-3] * 3)
+    hi = np.array([1e3] * 3)
+    key = ("probe", 9, 9, vary, 8, "default")
+    fn = _batch_program(key, lambda: make_acf2d_fit_one(
+        9, 9, 1.0, 5 / 3, 0.0, 1.0, 1.0, vary, lo, hi, n_iter=8,
+        precision="default"))
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 3), np.float32), S((2, 9, 9), np.float32),
+                S((2, 9, 9), np.float32), S((2, 9, 9), np.float32),
+                S((2, 7), np.float32), S((2, 2), np.float32))

@@ -231,3 +231,22 @@ def scint_params_batch(dyns, dt, df, alpha=5 / 3, n_iter=100,
 
     out = fit(jnp.asarray(tcuts), jnp.asarray(fcuts))
     return {k: np.asarray(v) for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("fit.acf1d_batch")
+def _probe_acf1d_batch():
+    """The cached vmapped acf1d LM fitter at a fixed 16x16 epoch
+    geometry (the real entry: ``make_acf1d_batch``)."""
+    import jax
+
+    fit = make_acf1d_batch(16, 16, 1.0, 1.0, n_iter=8)
+    S = jax.ShapeDtypeStruct
+    return fit, (S((2, 16), np.float32), S((2, 16), np.float32))

@@ -14,6 +14,10 @@ bench-only timing splits):
 - :mod:`~scintools_tpu.obs.retrace` — per-site jit build accounting
   over every cached program factory, with :func:`retrace_guard` as
   the tier-1 retrace-regression gate;
+- :mod:`~scintools_tpu.obs.programs` — abstract program probes over
+  the same sites: no-execution jaxpr tracing, per-site program
+  summaries/FLOP estimates, and the stable fingerprints the jaxlint
+  JP2xx program pass (tools/jaxlint/program.py) gates in tier-1;
 - :mod:`~scintools_tpu.obs.heartbeat` — cadence-gated live progress
   events for long runs;
 - :mod:`~scintools_tpu.obs.report` — the end-of-run ``run_report``
@@ -23,7 +27,8 @@ See docs/observability.md for the event catalog, metric names, the
 trace-viewer walkthrough, and the RunReport schema.
 """
 
-from . import heartbeat, metrics, report, retrace, trace  # noqa: F401
+from . import (heartbeat, metrics, programs, report,  # noqa: F401
+               retrace, trace)
 from .heartbeat import Heartbeat, as_heartbeat  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, counter, gauge, histogram,

@@ -1,0 +1,457 @@
+"""Abstract program probes: trace every cached jit site WITHOUT
+executing it.
+
+PR 7 found the bench silently timing the staged ``sspec_thth`` path
+(stamped 0.31x while the fused path measured 2.36x) — a *formulation*
+regression invisible to source-level lints: the source was fine, the
+wrong PROGRAM was compiled. The retrace registry (obs/retrace.py)
+already names every cached jit site; this module gives each site an
+**abstract probe** — a builder returning ``(fn, example_avals)`` — so
+the program a site compiles can be traced to a ClosedJaxpr with
+``jax.make_jaxpr`` on ``jax.ShapeDtypeStruct`` inputs: no device
+execution, no compile, CPU-safe, a few hundred ms per site.
+
+On top of the trace this module derives a per-site **program
+summary** (input/output avals, recursive primitive multiset,
+closure-constant census, observed buffer donation, the active
+per-platform formulations, rough FLOP/byte cost estimates — exported
+through :mod:`~scintools_tpu.obs.metrics` as
+``program_flops_estimate{site=}`` / ``program_bytes_estimate{site=}``)
+and a stable **fingerprint** hash of its structure. The jaxlint
+program pass (tools/jaxlint/program.py, rules JP200–JP205) audits the
+summaries against per-site contracts and gates fingerprints against a
+committed baseline, so "the compiler quietly picked a different
+program" fails tier-1 with a readable diff instead of shipping as a
+silent 7x slowdown.
+
+Determinism contract (what makes fingerprints comparable across
+machines, device counts and test configurations):
+
+- probes trace under an explicit x64 context chosen by their declared
+  dtype ``policy`` (``'float32'`` → ``jax.experimental.disable_x64``),
+  NOT the ambient flag — the test suite enables x64 globally while
+  the CLI does not, and both must see the same program;
+- mesh-sharded factories trace over a fixed-shape
+  :func:`abstract_mesh` (``jax.sharding.AbstractMesh``, 2 data x
+  2 seq), so per-shard aval shapes never depend on the host's real
+  device count;
+- probe geometry is small and FIXED inside each builder — the probe
+  documents the program's structure, not a production shape.
+
+Probes are registered NEXT to the site they audit (the module that
+calls ``record_build``), via::
+
+    @register_probe("ops.arc_profile", formulations=("ops.arc_profile_interp",))
+    def _probe_arc_profile():
+        ...
+        return fn, (S((2, 16, 16), np.float32), S((2,), np.float32))
+
+:data:`PROBE_MODULES` lists every module owning a site;
+:func:`load_probes` imports them so registration happens on demand. A
+new cached site whose module is missing from the list surfaces as a
+JP200 probe-coverage finding — the failure is loud, never silent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_PROBES = {}            # site -> ProbeSpec
+_SUMMARIES = {}         # site -> summary dict (memoised per process)
+_LOADED = False
+
+#: modules that own ``record_build`` sites; :func:`load_probes`
+#: imports these so their ``register_probe`` calls run. Forgetting a
+#: new site's module here leaves its site probe-less, which the
+#: jaxlint JP200 coverage rule turns into a tier-1 failure.
+PROBE_MODULES = (
+    "scintools_tpu.ops.normsspec",
+    "scintools_tpu.ops.fitarc_device",
+    "scintools_tpu.ops.scale",
+    "scintools_tpu.fit.acf2d",
+    "scintools_tpu.fit.batch",
+    "scintools_tpu.thth.core",
+    "scintools_tpu.thth.search",
+    "scintools_tpu.thth.retrieval",
+    "scintools_tpu.parallel.fft",
+    "scintools_tpu.parallel.survey",
+    "scintools_tpu.sim.simulation",
+)
+
+_WIDE_DTYPES = ("float64", "complex128")
+
+
+class ProbeSpec:
+    """Contract + abstract-input builder for one jit-cache site.
+
+    ``build()`` → ``(fn, args)`` where ``args`` are
+    ``jax.ShapeDtypeStruct`` (or small concrete arrays) accepted by
+    ``jax.make_jaxpr``; it must not execute device code. The
+    remaining fields are the site's declared contract, read by the
+    JP2xx rules."""
+
+    __slots__ = ("site", "build", "policy", "hot", "donate",
+                 "formulations", "const_budget", "f64_const_budget",
+                 "path", "lineno", "doc")
+
+    def __init__(self, site, build, policy="float32", hot=True,
+                 donate=(), formulations=(), const_budget=512 * 1024,
+                 f64_const_budget=4096):
+        self.site = site
+        self.build = build
+        self.policy = policy
+        self.hot = bool(hot)
+        self.donate = tuple(int(i) for i in donate)
+        self.formulations = tuple(formulations)
+        self.const_budget = int(const_budget)
+        self.f64_const_budget = int(f64_const_budget)
+        code = getattr(build, "__code__", None)
+        self.path = getattr(code, "co_filename", "<probe>")
+        self.lineno = getattr(code, "co_firstlineno", 0)
+        self.doc = (build.__doc__ or "").strip()
+
+
+def register_probe(site, *, policy="float32", hot=True, donate=(),
+                   formulations=(), const_budget=512 * 1024,
+                   f64_const_budget=4096):
+    """Decorator registering ``build`` as the abstract probe for
+    ``site``.
+
+    ``policy`` — dtype policy the traced program must satisfy
+    ('float32' default: traced under ``disable_x64``, JP201 flags any
+    f64/c128 aval and any wide closure constant above
+    ``f64_const_budget`` bytes; 'float64': traced under
+    ``enable_x64``, wide dtypes allowed). ``hot`` — hot-path site:
+    JP203 forbids host-callback primitives. ``donate`` — argnums the
+    factory donates WHEN the ``'jit.donate'`` formulation is active
+    (JP204 checks the observed donation matches the formulation
+    gate). ``formulations`` — backend.py formulation ops this program
+    depends on; their resolved choices enter the fingerprint, so a
+    formulation-table flip changes the hash even when primitives
+    coincide. ``const_budget`` / ``f64_const_budget`` — JP202/JP201
+    closure-constant byte thresholds."""
+
+    def deco(build):
+        spec = ProbeSpec(site, build, policy=policy, hot=hot,
+                         donate=donate, formulations=formulations,
+                         const_budget=const_budget,
+                         f64_const_budget=f64_const_budget)
+        with _LOCK:
+            _PROBES[site] = spec
+        return build
+
+    return deco
+
+
+def load_probes():
+    """Import every :data:`PROBE_MODULES` module (idempotent) so all
+    probe registrations run; returns the number of registered
+    probes."""
+    global _LOADED
+    import importlib
+
+    if not _LOADED:
+        for mod in PROBE_MODULES:
+            importlib.import_module(mod)
+        _LOADED = True
+    with _LOCK:
+        return len(_PROBES)
+
+
+def probes():
+    """``{site: ProbeSpec}`` after loading the probe modules."""
+    load_probes()
+    with _LOCK:
+        return dict(_PROBES)
+
+
+def get_probe(site):
+    load_probes()
+    with _LOCK:
+        return _PROBES.get(site)
+
+
+def abstract_mesh():
+    """The canonical fixed-shape mesh every sharded probe traces
+    over: 2 'data' x 2 'seq' ``AbstractMesh`` — no real devices, so
+    per-shard aval shapes (and therefore fingerprints) are identical
+    on a 1-device CLI host, the 8-virtual-device test suite, and a
+    TPU pod."""
+    from jax.sharding import AbstractMesh
+
+    from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+    return AbstractMesh(((DATA_AXIS, 2), (SEQ_AXIS, 2)))
+
+
+def _ensure_safe_platform():
+    """Pin jax onto CPU when no backend is initialised yet (the
+    tunneled-TPU plugin can hang a cold ``jnp.asarray``); a live
+    non-CPU backend traces fine, so failures are ignored."""
+    from ..backend import get_jax
+
+    try:
+        get_jax().config.update("jax_platforms", "cpu")
+    # lint-ok: excepts: a live non-CPU backend rejects the update;
+    # tracing works on it regardless, so the pin is best-effort
+    except Exception:
+        pass
+
+
+def _policy_x64(policy):
+    from jax.experimental import disable_x64, enable_x64
+
+    return enable_x64() if policy == "float64" else disable_x64()
+
+
+def trace_probe(spec):
+    """ClosedJaxpr of ``spec``'s program: builder + ``make_jaxpr``
+    under the probe's dtype-policy x64 context. No execution."""
+    from ..backend import get_jax
+
+    jax = get_jax()
+    _ensure_safe_platform()
+    with _policy_x64(spec.policy):
+        fn, args = spec.build()
+        return jax.make_jaxpr(fn)(*args)
+
+
+def iter_eqns(closed_jaxpr):
+    """Yield ``(eqn, scale)`` over the whole program, recursing into
+    every sub-jaxpr (pjit/scan/while/cond/custom_* params).
+    ``scale`` is the static execution-count multiplier accumulated
+    from enclosing ``scan`` lengths (while-loop bodies count once —
+    trip counts are dynamic, so derived costs are lower bounds)."""
+
+    def walk(jaxpr, scale):
+        for eqn in jaxpr.eqns:
+            yield eqn, scale
+            inner = scale
+            if eqn.primitive.name == "scan":
+                inner = scale * int(eqn.params.get("length", 1))
+            for sub in _sub_jaxprs(eqn):
+                yield from walk(sub, inner)
+
+    yield from walk(closed_jaxpr.jaxpr, 1)
+
+
+def _sub_jaxprs(eqn):
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            if hasattr(v, "eqns"):
+                yield v
+            elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                yield v.jaxpr
+
+
+def gather_consts(closed_jaxpr):
+    """Every closure constant in the program, including consts of
+    nested ClosedJaxprs — ``make_jaxpr`` over a jitted callable hoists
+    the captured arrays into the inner pjit jaxpr, so the top level
+    alone usually reports zero."""
+    out = list(closed_jaxpr.consts)
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for val in eqn.params.values():
+                vals = (val if isinstance(val, (list, tuple))
+                        else (val,))
+                for v in vals:
+                    if hasattr(v, "consts") and hasattr(v, "jaxpr"):
+                        out.extend(v.consts)
+                        walk(v.jaxpr)
+                    elif hasattr(v, "eqns"):
+                        walk(v)
+
+    walk(closed_jaxpr.jaxpr)
+    return out
+
+
+def _aval_str(aval):
+    dtype = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", None)
+    if dtype is None:
+        return str(aval)
+    dims = ",".join(str(d) for d in (shape or ()))
+    return f"{dtype}[{dims}]"
+
+
+def _aval_bytes(aval):
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:  # jax extended dtypes (PRNG keys)
+        itemsize = int(getattr(dtype, "itemsize", 4))
+    return int(itemsize * np.prod(getattr(aval, "shape", ()) or (1,)))
+
+
+def _eqn_flops(eqn):
+    """Rough per-execution FLOP estimate for one equation: 2·N·K for
+    contractions, 5·N·log2(n) for FFTs, the output element count for
+    everything else — executable documentation of relative cost, not
+    a performance model."""
+    name = eqn.primitive.name
+    out_numel = sum(int(np.prod(getattr(v.aval, "shape", ()) or (1,)))
+                    for v in eqn.outvars
+                    if hasattr(v.aval, "shape"))
+    if name == "dot_general":
+        (lc, _), _ = eqn.params["dimension_numbers"]
+        lhs_shape = eqn.invars[0].aval.shape
+        contracted = int(np.prod([lhs_shape[d] for d in lc]) or 1)
+        return 2 * out_numel * contracted
+    if name == "fft":
+        n = max((max(v.aval.shape) for v in eqn.outvars
+                 if getattr(v.aval, "shape", ())), default=2)
+        return int(5 * out_numel * math.log2(max(n, 2)))
+    return out_numel
+
+
+def summary(site, refresh=False):
+    """Memoised program summary for ``site`` (see module docstring).
+    Raises KeyError for an unknown site, and propagates trace errors
+    (the jaxlint pass converts both into loud findings)."""
+    with _LOCK:
+        if not refresh and site in _SUMMARIES:
+            return _SUMMARIES[site]
+    spec = get_probe(site)
+    if spec is None:
+        raise KeyError(f"no registered probe for site {site!r} "
+                       f"(known: {sorted(_PROBES)})")
+    doc = summarize(spec)
+    with _LOCK:
+        _SUMMARIES[site] = doc
+    return doc
+
+
+def summarize(spec):
+    """Un-memoised summary of one :class:`ProbeSpec` (registered or
+    not — test fixtures build throwaway specs)."""
+    site = spec.site
+    closed = trace_probe(spec)
+
+    prims, n_eqns, flops, traffic = {}, 0, 0, 0
+    wide_avals = set()
+    for eqn, scale in iter_eqns(closed):
+        n_eqns += 1
+        name = eqn.primitive.name
+        prims[name] = prims.get(name, 0) + 1
+        flops += scale * _eqn_flops(eqn)
+        traffic += scale * sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        for v in eqn.outvars:
+            d = getattr(v.aval, "dtype", None)
+            if d is not None and str(d) in _WIDE_DTYPES:
+                wide_avals.add(_aval_str(v.aval))
+
+    consts = []
+    for c in gather_consts(closed):
+        try:
+            dt, nb = str(c.dtype), int(c.nbytes)
+        except (AttributeError, TypeError):
+            a = np.asarray(c)
+            dt, nb = str(a.dtype), int(a.nbytes)
+        consts.append((dt, nb))
+    const_bytes = sum(nb for _, nb in consts)
+    wide_const_bytes = sum(nb for dt, nb in consts
+                           if dt in _WIDE_DTYPES)
+    const_dtypes = {}
+    for dt, nb in consts:
+        const_dtypes[dt] = const_dtypes.get(dt, 0) + nb
+
+    donated = []
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name == "pjit":
+            donated = [i for i, d in
+                       enumerate(eqn.params.get("donated_invars", ()))
+                       if d]
+            break
+
+    from ..backend import _FORMULATIONS, formulation
+
+    forms = {}
+    for op in spec.formulations:
+        if op in _FORMULATIONS:
+            forms[op] = formulation(op, platform="cpu")
+        else:
+            forms[op] = "<unregistered>"
+
+    doc = {
+        "site": site,
+        "policy": spec.policy,
+        "hot": spec.hot,
+        "in_avals": [_aval_str(a) for a in closed.in_avals],
+        "out_avals": [_aval_str(a) for a in closed.out_avals],
+        "primitives": dict(sorted(prims.items())),
+        "n_eqns": n_eqns,
+        "wide_avals": sorted(wide_avals),
+        "const_count": len(consts),
+        "const_bytes": const_bytes,
+        "const_dtypes": dict(sorted(const_dtypes.items())),
+        "wide_const_bytes": wide_const_bytes,
+        "max_const_bytes": max((nb for _, nb in consts), default=0),
+        "donated": donated,
+        "formulations": forms,
+        "flops_est": int(flops),
+        "bytes_est": int(traffic),
+    }
+    doc["fingerprint"] = fingerprint(doc)
+
+    from . import metrics
+
+    metrics.gauge(
+        "program_flops_estimate",
+        help="rough jaxpr FLOP estimate per cached-program site",
+    ).labels(site=site).set(doc["flops_est"])
+    metrics.gauge(
+        "program_bytes_estimate",
+        help="rough jaxpr memory-traffic estimate per site",
+    ).labels(site=site).set(doc["bytes_est"])
+    return doc
+
+
+#: summary keys that define a program's identity — what the JP205
+#: fingerprint hashes. Cost estimates and eqn counts stay OUT (they
+#: are derived views; primitive counts already pin the structure).
+FINGERPRINT_FIELDS = ("site", "policy", "in_avals", "out_avals",
+                      "primitives", "const_count", "const_dtypes",
+                      "donated", "formulations")
+
+
+def fingerprint(doc):
+    """Stable hex digest of a summary's identity fields."""
+    payload = {k: doc.get(k) for k in FINGERPRINT_FIELDS}
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def fingerprint_report(sites=None):
+    """``{"platform": ..., "sites": {site: fingerprint}}`` over
+    ``sites`` (default: every registered probe) — the bench embeds
+    this in its JSON so bench-to-bench diffs surface formulation
+    flips explicitly (the PR-7 incident class)."""
+    from ..backend import formulation_platform
+
+    load_probes()
+    names = sorted(sites) if sites is not None else sorted(_PROBES)
+    out = {}
+    for site in names:
+        try:
+            out[site] = summary(site)["fingerprint"]
+        except Exception as e:  # one broken probe must not hide the
+            out[site] = f"error:{type(e).__name__}"  # rest in a diff
+    return {"platform": formulation_platform(), "sites": out}
+
+
+def reset_summaries():
+    """Drop the memoised summaries (tests that tamper with
+    formulation overrides re-trace)."""
+    with _LOCK:
+        _SUMMARIES.clear()

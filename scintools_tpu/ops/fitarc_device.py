@@ -337,3 +337,27 @@ def make_arc_fit_batch_fn(tdel, fdop, delmax=None, startbin=3, cutmid=3,
         return packed, folded
 
     return jax.jit(program)
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("ops.arc_fit_device",
+                 formulations=("ops.arc_profile_interp",))
+def _probe_arc_fit_device():
+    """Fixed small geometry: 2 epochs, 16x16 secondary spectrum, 32
+    profile steps; ``Ls`` is the per-epoch valid profile length
+    (int32, as the host driver passes it)."""
+    import jax
+
+    tdel = np.linspace(0.0, 1.0, 16)
+    fdop = np.linspace(-1.0, 1.0, 16)
+    fn = make_arc_fit_batch_fn(tdel, fdop, numsteps=32, pallas=False)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16, 16), np.float32), S((2,), np.float32),
+                S((2,), np.int32))

@@ -413,3 +413,27 @@ def normalise_sspec(sspec, tdel, fdop, eta, delmax=None, startbin=1,
     return NormSspec(normsspecavg=avg, normsspec=norm, mask=mask,
                      tdel=tdel_c, fdop=fdopnew,
                      powerspectrum=powerspectrum, weights=weights, **ps)
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("ops.arc_profile",
+                 formulations=("ops.arc_profile_interp",))
+def _probe_arc_profile():
+    """Fixed small geometry: 2 epochs, 16x16 secondary spectrum, 32
+    profile steps, XLA base (pallas=False — the formulation the
+    sharded path compiles)."""
+    import jax
+
+    tdel = np.linspace(0.0, 1.0, 16)
+    fdop = np.linspace(-1.0, 1.0, 16)
+    fn = make_arc_profile_batch_fn(tdel, fdop, numsteps=32,
+                                   pallas=False)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16, 16), np.float32), S((2,), np.float32))

@@ -142,3 +142,23 @@ def trapezoid_rescale(dyn, times, freqs, window="hanning",
     return np.asarray(fn(  # sync-ok: eager host
         # API — the resampled dynspec is this function's return value
         jnp.asarray(X), jnp.asarray(dyn), jnp.asarray(valid)))
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("ops.trapezoid_rescale")
+def _probe_trapezoid_rescale():
+    """The cached vmapped masked row interpolation at a fixed 16-bin
+    time axis (the real entry: ``_trapezoid_program(times)``)."""
+    import jax
+
+    fn = _trapezoid_program(np.linspace(0.0, 30.0, 16))
+    S = jax.ShapeDtypeStruct
+    return fn, (S((8, 16), np.float32), S((8, 16), np.float32),
+                S((8, 16), np.bool_))

@@ -86,10 +86,16 @@ def make_gs_sharded(mesh):
     repl = NamedSharding(mesh, P())
     from ..obs import retrace as _retrace
 
+    # AbstractMesh (the obs/programs.py probe trace) has no devices —
+    # its .devices property raises, so key on the axis layout alone
+    try:
+        dev_ids = tuple(d.id for d in np.ravel(mesh.devices))
+    except ValueError:
+        dev_ids = None
     _retrace.record_build(
         "parallel.gs_sharded",
-        (tuple(d.id for d in np.ravel(mesh.devices)),
-         tuple(mesh.axis_names), tuple(mesh.shape.values())))
+        (dev_ids, tuple(mesh.axis_names),
+         tuple(mesh.shape.values())))
     return jax.jit(gs, in_shardings=(sh4, sh3, sh3, repl, None),
                    out_shardings=sh4)
 
@@ -143,3 +149,28 @@ def make_sspec_power_sharded(mesh, nf, nt, window_arrays=None,
         return jax.lax.with_sharding_constraint(power, sharded)
 
     return fn
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py). Sharded probes trace
+# over the fixed 2x2 AbstractMesh (obs.programs.abstract_mesh), so
+# per-shard aval shapes never depend on the host's device count.
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("parallel.gs_sharded")
+def _probe_gs_sharded():
+    """Mesh-sharded Gerchberg–Saxton refinement at a fixed B=2,
+    8x8 wavefield, traced iteration count."""
+    import jax
+
+    from ..obs.programs import abstract_mesh
+
+    fn = make_gs_sharded(abstract_mesh())
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 2, 8, 8), np.float32), S((2, 8, 8), np.float32),
+                S((2, 8, 8), np.bool_), S((8,), np.bool_),
+                S((), np.int32))

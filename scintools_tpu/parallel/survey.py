@@ -413,3 +413,140 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
         (nf, nt, float(dt), float(df), float(alpha), n_iter,
          bartlett, weighted, window, float(window_frac)))
     return jax.jit(step, in_shardings=(dyn_sh,), **kwargs)
+
+
+# ---------------------------------------------------------------------
+# abstract program probes (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py). Every sharded probe
+# traces over the fixed 2x2 AbstractMesh (obs.programs.abstract_mesh)
+# so per-shard aval shapes never depend on the host's device count;
+# batch axes are 4 (one chunk per abstract device), geometry is the
+# small fixed 16x16/npad=1/16-edge probe chunk.
+# ---------------------------------------------------------------------
+
+import numpy as np
+
+from ..obs.programs import abstract_mesh, register_probe as _register_probe
+
+
+def _probe_chunk_geometry():
+    from ..thth.search import chunk_geometry
+
+    return chunk_geometry(nf=16, nt=16, npad=1, n_edges=16)
+
+
+@_register_probe("parallel.grid_search_sharded",
+                 formulations=("thth.eig",))
+def _probe_grid_search_sharded():
+    import jax
+
+    _, _, tau, fd, _ = _probe_chunk_geometry()
+    fn = make_thth_grid_search_sharded(abstract_mesh(), tau, fd, 16,
+                                       iters=8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 2, len(tau), len(fd)), np.float32),
+                S((4, 16), np.float32), S((4, 4), np.float32))
+
+
+@_register_probe("parallel.fused_grid_search_sharded", donate=(0,),
+                 formulations=("thth.eig", "ops.cs", "jit.donate"))
+def _probe_fused_grid_search_sharded():
+    import jax
+
+    _, _, tau, fd, _ = _probe_chunk_geometry()
+    fn = make_fused_grid_search_sharded(abstract_mesh(), tau, fd, 16,
+                                        16, 16, npad=1, iters=8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 16, 16), np.float32), S((4, 16), np.float32),
+                S((4, 4), np.float32))
+
+
+@_register_probe("parallel.thin_grid_search_sharded",
+                 formulations=("thth.eig",))
+def _probe_thin_grid_search_sharded():
+    import jax
+
+    _, _, tau, fd, _ = _probe_chunk_geometry()
+    fn = make_thth_thin_grid_search_sharded(abstract_mesh(), tau, fd,
+                                            16, 8, 0.1, iters=8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 2, len(tau), len(fd)), np.float32),
+                S((4, 16), np.float32), S((4, 8), np.float32),
+                S((4, 4), np.float32))
+
+
+@_register_probe("parallel.arc_profile_sharded",
+                 formulations=("ops.arc_profile_interp",))
+def _probe_arc_profile_sharded():
+    import jax
+
+    tdel = np.linspace(0.0, 1.0, 16)
+    fdop = np.linspace(-1.0, 1.0, 16)
+    fn, _ = make_arc_profile_sharded(abstract_mesh(), tdel, fdop,
+                                     numsteps=32)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 16, 16), np.float32), S((4,), np.float32))
+
+
+@_register_probe("parallel.arc_fit_sharded",
+                 formulations=("ops.arc_profile_interp",))
+def _probe_arc_fit_sharded():
+    import jax
+
+    tdel = np.linspace(0.0, 1.0, 16)
+    fdop = np.linspace(-1.0, 1.0, 16)
+    fn, _ = make_arc_fit_sharded(abstract_mesh(), tdel, fdop,
+                                 numsteps=32)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 16, 16), np.float32), S((4,), np.float32),
+                S((4,), np.int32))
+
+
+@_register_probe("parallel.acf2d_fit_sharded")
+def _probe_acf2d_fit_sharded():
+    import jax
+
+    vary = ("tau", "dnu", "amp")
+    lo = np.array([1e-3] * 3)
+    hi = np.array([1e3] * 3)
+    fn, _ = make_acf2d_fit_sharded(abstract_mesh(), 9, 9, 1.0, 5 / 3,
+                                   0.0, 1.0, 1.0, vary, lo, hi,
+                                   n_iter=8, precision="default")
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 3), np.float32), S((4, 9, 9), np.float32),
+                S((4, 9, 9), np.float32), S((4, 9, 9), np.float32),
+                S((4, 7), np.float32), S((4, 2), np.float32))
+
+
+@_register_probe("parallel.retrieval_sharded", donate=(0,),
+                 formulations=("thth.retrieval_eig", "ops.cs",
+                               "jit.donate"))
+def _probe_retrieval_sharded():
+    import jax
+
+    fn = make_retrieval_sharded(abstract_mesh(), 16, 16, 1.0, 0.1, 16,
+                                npad=1, iters=16, warm_iters=4)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4, 16, 16), np.float32), S((4, 16), np.float32),
+                S((4,), np.float32), S((), np.float32))
+
+
+@_register_probe("parallel.eta_search_sharded")
+def _probe_eta_search_sharded():
+    import jax
+
+    _, _, tau, fd, edges = _probe_chunk_geometry()
+    fn = make_eta_search_sharded(abstract_mesh(), tau, fd, edges,
+                                 iters=8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, len(tau), len(fd)), np.float32),
+                S((4,), np.float32))
+
+
+@_register_probe("parallel.survey_step", donate=(0,))
+def _probe_survey_step():
+    import jax
+
+    fn = make_survey_step(abstract_mesh(), 16, 16, n_iter=8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16, 16), np.float32),)

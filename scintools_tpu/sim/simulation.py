@@ -137,6 +137,10 @@ def _jax_screen_program():
         jax = get_jax()
         import jax.numpy as jnp
 
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("sim.screen")
+
         def run(w, key):
             k1, k2 = jax.random.split(key)
             re = jax.random.normal(k1, w.shape)
@@ -154,6 +158,10 @@ def _jax_propagate_program():
     if _PROP_JIT is None:
         jax = get_jax()
         import jax.numpy as jnp
+
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("sim.propagate")
 
         def run(xyp, q2, scales, column):
             def one_freq(scale):
@@ -413,6 +421,10 @@ def make_dynspec_batch_fn(mb2=2, rf=1, ds=0.01, alpha=5 / 3,
     jax = get_jax()
     import jax.numpy as jnp
 
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build("sim.dynspec_batch", cache_key)
+
     sim = Simulation.__new__(Simulation)
     sim.mb2, sim.rf, sim.ds = mb2, rf, ds
     sim.dx = sim.dy = ds
@@ -471,3 +483,46 @@ def simulate_dynspec_batch(nscreens, mb2=2, rf=1, ds=0.01, alpha=5 / 3,
                                nf=nf, dlam=dlam)
     keys = jax.random.split(jax.random.PRNGKey(seed), nscreens)
     return fn(keys)
+
+
+# ---------------------------------------------------------------------
+# abstract program probes (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("sim.screen")
+def _probe_sim_screen():
+    """The cached phase-screen draw at a fixed 8x8 screen (legacy
+    uint32 PRNG key, as the Simulation driver passes it)."""
+    import jax
+
+    fn = _jax_screen_program()
+    S = jax.ShapeDtypeStruct
+    return fn, (S((8, 8), np.float32), S((2,), np.uint32))
+
+
+@_register_probe("sim.propagate")
+def _probe_sim_propagate():
+    """The cached Fresnel propagation at a fixed 8x8 screen over 4
+    frequencies (the ``column`` extraction index is static)."""
+    import jax
+
+    fn = _jax_propagate_program()
+    S = jax.ShapeDtypeStruct
+    return (lambda xyp, q2, scales: fn(xyp, q2, scales, column=4)), (
+        S((8, 8), np.float32), S((8, 8), np.float32),
+        S((4,), np.float32))
+
+
+@_register_probe("sim.dynspec_batch")
+def _probe_sim_dynspec_batch():
+    """The memoised batched simulator (screens → Fresnel → dynspec)
+    at a fixed 8x8 screen, 2 frequencies, 2 seeds."""
+    import jax
+
+    fn = make_dynspec_batch_fn(ns=8, nf=2)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 2), np.uint32),)

@@ -544,3 +544,28 @@ def ext_find(x, y):
     dx = np.diff(x).mean()
     dy = np.diff(y).mean()
     return [x[0] - dx / 2, x[-1] + dx / 2, y[0] - dy / 2, y[-1] + dy / 2]
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("thth.eval")
+def _probe_thth_eval():
+    """The per-chunk eigenvalue-vs-eta curve through the REAL
+    ``_jitted_eval_fn`` cache, at a fixed 16x16/npad=1/16-edge chunk
+    geometry."""
+    import jax
+
+    from .search import chunk_geometry
+
+    _, _, tau, fd, edges = chunk_geometry(nf=16, nt=16, npad=1,
+                                          n_edges=16)
+    fn = _jitted_eval_fn(tau, fd, edges, 8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, len(tau), len(fd)), np.float32),
+                S((4,), np.float32))

@@ -1417,3 +1417,75 @@ def err_string(value, error):
     e = error / 10 ** exp
     dig = max(0, 1 - int(np.floor(np.log10(e)))) if e > 0 else 2
     return f"({v:.{dig}f}±{e:.{dig}f})e{exp}"
+
+
+# ---------------------------------------------------------------------
+# abstract program probes (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py). The grid probe
+# mirrors the in-function composition of ``grid_retrieval_batch``
+# (core retrieval lax.map'd over groups, chunk stack donated) with a
+# distinct "probe:" cache key; drift between the probe and the site
+# is what the fingerprint baseline review catches.
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("thth.retrieval_grid", donate=(0,),
+                 formulations=("thth.retrieval_eig", "ops.cs",
+                               "thth.retrieval_group", "jit.donate"))
+def _probe_retrieval_grid():
+    """Grouped chunk retrieval: ``make_chunk_retrieval_fn`` under
+    ``lax.map`` at a fixed 16x16/npad=1/16-edge geometry, through the
+    real ``_RETRIEVAL_JIT_CACHE``."""
+    import jax
+
+    from ..backend import donation_argnums
+
+    method = resolve_retrieval_method(None, 16)
+    key = ("probe:grid", 16, 16, 1.0, 0.1, 16, 1, method, 16, 4)
+
+    def build():
+        core = make_chunk_retrieval_fn(16, 16, 1.0, 0.1, 16, npad=1,
+                                       method=method, iters=16,
+                                       warm_iters=4)
+        return lambda cg, eg, etg, tm: jax.lax.map(
+            lambda args: core(*args, tm), (cg, eg, etg))
+
+    fn = keyed_jit_cache(_RETRIEVAL_JIT_CACHE, key, build,
+                         donate_argnums=donation_argnums((0,)),
+                         site="thth.retrieval_grid")
+    S = jax.ShapeDtypeStruct
+    return fn, (S((1, 2, 16, 16), np.float32), S((1, 2, 16), np.float32),
+                S((1, 2), np.float32), S((), np.float32))
+
+
+@_register_probe("thth.retrieval_vlbi",
+                 formulations=("thth.retrieval_eig", "ops.cs"))
+def _probe_retrieval_vlbi():
+    """Batched VLBI retrieval (2 dishes, 3 cross-spectra per chunk)
+    through the real ``_RETRIEVAL_JIT_CACHE`` at an 8x8/npad=1
+    geometry."""
+    import jax
+
+    key = ("probe:vlbi", 8, 8, 1.0, 0.1, 8, 2, 1)
+    fn = keyed_jit_cache(
+        _RETRIEVAL_JIT_CACHE, key,
+        lambda: make_vlbi_retrieval_fn(8, 8, 1.0, 0.1, 8, 2, npad=1),
+        site="thth.retrieval_vlbi")
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 3, 2, 8, 8), np.float32), S((8,), np.float32),
+                S((), np.float32), S((), np.float32))
+
+
+@_register_probe("thth.mosaic")
+def _probe_mosaic():
+    """Phase-aligned overlap-add mosaic stitch at a fixed 2x2 grid of
+    8x8 chunks, through the real ``_MOSAIC_JIT_CACHE``."""
+    import jax
+
+    fn = keyed_jit_cache(_MOSAIC_JIT_CACHE, ("probe:mosaic", 2, 2, 8, 8),
+                         lambda: make_mosaic_fn(2, 2, 8, 8),
+                         site="thth.mosaic")
+    S = jax.ShapeDtypeStruct
+    return fn, (S((1, 4, 2, 8, 8), np.float32),)

@@ -549,3 +549,74 @@ def multi_chunk_search_thin(dspecs, freq, times, etas, edges,
                                      eigs=eigs_c, etas=etas_c,
                                      popt=popt, ok=ok))
     return out
+
+
+# ---------------------------------------------------------------------
+# abstract program probes (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py). The fused vs staged
+# pair below is the PR-7 incident as a standing contract: the two
+# sites must compile DIFFERENT programs (tests/test_program_audit.py
+# pins their fingerprints apart).
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe
+
+
+def _probe_geometry():
+    return chunk_geometry(nf=16, nt=16, npad=1, n_edges=16)
+
+
+@_register_probe("thth.multi_eval", formulations=("thth.eig",))
+def _probe_multi_eval():
+    """The STAGED path's batched eigen-curve program (host FFT
+    upstream, device curve only) through ``_jitted_multi_eval``."""
+    import jax
+
+    _, _, tau, fd, edges = _probe_geometry()
+    fn = _jitted_multi_eval(tau, fd, edges, "auto")
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 2, len(tau), len(fd)), np.float32),
+                S((4,), np.float32))
+
+
+@_register_probe("thth.fused", donate=(0,),
+                 formulations=("thth.eig", "ops.cs", "jit.donate"))
+def _probe_fused():
+    """The FUSED end-to-end search program (pad → fft2 → θ-θ →
+    eigen curve → peak fit) through ``_jitted_fused_eval`` — raw
+    chunks in, fits out."""
+    import jax
+
+    _, _, tau, fd, edges = _probe_geometry()
+    fn = _jitted_fused_eval(tau, fd, edges, (16, 16), 1, True, 0.0,
+                            0.1, "auto")
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16, 16), np.float32), S((4,), np.float32))
+
+
+@_register_probe("thth.thin_eval", formulations=("thth.eig",))
+def _probe_thin_eval():
+    """Staged thin-screen singular-value curve through
+    ``_jitted_thin_eval``."""
+    import jax
+
+    _, _, tau, fd, edges = _probe_geometry()
+    arclet = np.linspace(edges[0] / 2, edges[-1] / 2, 8)
+    fn = _jitted_thin_eval(tau, fd, edges, arclet, 0.1)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 2, len(tau), len(fd)), np.float32),
+                S((4,), np.float32))
+
+
+@_register_probe("thth.fused_thin", donate=(0,),
+                 formulations=("thth.eig", "ops.cs", "jit.donate"))
+def _probe_fused_thin():
+    """Fused thin-screen search through ``_jitted_fused_thin_eval``."""
+    import jax
+
+    _, _, tau, fd, edges = _probe_geometry()
+    arclet = np.linspace(edges[0] / 2, edges[-1] / 2, 8)
+    fn = _jitted_fused_thin_eval(tau, fd, edges, arclet, 0.1,
+                                 (16, 16), 1, True, 0.0, 0.1)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16, 16), np.float32), S((4,), np.float32))

@@ -324,3 +324,16 @@ class TestBudgetFallback:
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9
+        # ISSUE 9 satellite: per-site program fingerprints ride in
+        # the bench JSON so bench-to-bench diffs surface formulation
+        # flips explicitly (the PR-7 'sspec_thth 0.31x' class) — even
+        # a fully-skipped run records them (abstract trace, no device)
+        fp = d["program_fingerprints"]
+        assert fp and "error" not in fp, fp
+        assert len(fp["sites"]) >= 24
+        assert all(not v.startswith("error:")
+                   for v in fp["sites"].values()), fp["sites"]
+        assert {"thth.fused", "thth.multi_eval"} <= set(fp["sites"])
+        # the PR-7 pair stays distinguishable in every bench artifact
+        assert fp["sites"]["thth.fused"] \
+            != fp["sites"]["thth.multi_eval"]

@@ -580,6 +580,59 @@ class TestJitBoundary:
                "g = jax.jit(f)\n")
         assert scan("jit-boundary", src) == []
 
+    # --- ISSUE 9 satellite: keyword-passed branch/body callables ----
+    # (the known AST blind spot the JP program pass would otherwise
+    # paper over: lax consumers accept their callables as keywords)
+
+    def test_while_loop_keyword_body_flagged(self):
+        src = ("import jax\n"
+               "def outer(x):\n"
+               "    def cond(c):\n"
+               "        return c[0] < 3\n"
+               "    def body(c):\n"
+               "        open('/tmp/x').read()\n"
+               "        return c\n"
+               "    return jax.lax.while_loop(cond_fun=cond,\n"
+               "                              body_fun=body,\n"
+               "                              init_val=x)\n")
+        out = scan("jit-boundary", src)
+        assert len(out) == 1 and "open" in out[0].message
+
+    def test_scan_keyword_f_flagged(self):
+        src = ("import jax\n"
+               "def outer(xs):\n"
+               "    def step(c, x):\n"
+               "        print('trace')\n"
+               "        return c, x\n"
+               "    return jax.lax.scan(f=step, init=0, xs=xs)\n")
+        out = scan("jit-boundary", src)
+        assert len(out) == 1 and "print" in out[0].message
+
+    def test_cond_keyword_branches_flagged(self):
+        src = ("import jax\n"
+               "def outer(p, x):\n"
+               "    def yes(v):\n"
+               "        print('trace')\n"
+               "        return v\n"
+               "    def no(v):\n"
+               "        return v\n"
+               "    return jax.lax.cond(p, true_fun=yes,\n"
+               "                        false_fun=no, operand=x)\n")
+        out = scan("jit-boundary", src)
+        assert len(out) == 1 and "print" in out[0].message
+
+    def test_keyword_callable_clean_body_passes(self):
+        src = ("import jax\n"
+               "def outer(x):\n"
+               "    def cond(c):\n"
+               "        return c[0] < 3\n"
+               "    def body(c):\n"
+               "        return c * 2\n"
+               "    return jax.lax.while_loop(cond_fun=cond,\n"
+               "                              body_fun=body,\n"
+               "                              init_val=x)\n")
+        assert scan("jit-boundary", src) == []
+
 
 # =====================================================================
 # output contracts: JSON, SARIF, CLI
@@ -660,3 +713,43 @@ class TestOutputContracts:
             capture_output=True, text=True, cwd=REPO, env=env)
         assert p.returncode == 2
         assert "unknown rule" in p.stderr
+
+    def test_write_baseline_prunes_stale_entries(self, tmp_path):
+        """ISSUE 9 satellite: re-writing a baseline drops entries
+        that no longer fire AND reports the pruned count, so a
+        grandfather file cannot mask a fixed-then-regressed
+        finding."""
+        from tools.jaxlint.__main__ import main as cli
+
+        bad = tmp_path / "m.py"
+        bad.write_text("try:\n    x()\nexcept:\n    pass\n"
+                       "try:\n    y()\nexcept Exception:\n    pass\n")
+        bl = tmp_path / "baseline.json"
+        assert cli([str(bad), "--rules", "excepts",
+                    "--write-baseline", str(bl)]) == 0
+        assert len(load_baseline(str(bl))) == 2
+
+        # fix one of the two violations, re-write: one entry pruned
+        bad.write_text("try:\n    x()\nexcept:\n    pass\n"
+                       "y()\n")
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli([str(bad), "--rules", "excepts",
+                      "--write-baseline", str(bl)])
+        assert rc == 0
+        assert "1 stale entry pruned" in buf.getvalue()
+        assert len(load_baseline(str(bl))) == 1
+
+        # and --write-baseline ignores --baseline for the scan: the
+        # still-firing grandfathered finding is retained, not dropped
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli([str(bad), "--rules", "excepts",
+                      "--baseline", str(bl),
+                      "--write-baseline", str(bl)])
+        assert rc == 0
+        assert len(load_baseline(str(bl))) == 1
+        assert "0 stale entries pruned" in buf.getvalue()

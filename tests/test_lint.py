@@ -218,6 +218,72 @@ class TestLegacyShims:
         assert "bare 'except:'" in p.stdout
 
 
+class TestProgramPass:
+    """ISSUE 9: the JP2xx program pass runs in tier-1 over every
+    record_build site, with the probe-coverage self-check proving no
+    cached jit site is unaudited."""
+
+    def test_program_pass_runs_and_tree_is_clean(self):
+        rep = _unified()
+        assert rep.program is not None, \
+            "program pass did not run on the package scan"
+        jp = [f for f in rep.findings
+              if f.rule.startswith("program-")]
+        assert jp == [], jp
+
+    def test_probe_coverage_complete(self):
+        """EVERY site found statically has a registered probe AND
+        traced successfully; no probe is stale — a new cached jit
+        site without a probe fails here loudly."""
+        rep = _unified()
+        st = rep.program
+        assert st["sites"] >= 24, st
+        assert st["probed"] == st["sites"], (
+            f"{st['sites'] - st['probed']} record_build site(s) have "
+            f"no registered probe (obs/programs.py register_probe)")
+        assert st["traced"] == st["probed"], "probe trace failures"
+        assert st["stale_probes"] == [], (
+            "probes registered for sites that no longer exist: "
+            f"{st['stale_probes']}")
+
+    def test_jp_rules_registered(self):
+        rep = _unified()
+        assert set(rep.rules) >= {
+            "program-coverage", "program-dtype", "program-consts",
+            "program-hostcalls", "program-donation",
+            "program-fingerprint"}
+
+    def test_every_subsystem_contributes_sites(self):
+        rep = _unified()
+        prefixes = {s.split(".")[0]
+                    for s in rep.program["summaries"]}
+        assert prefixes >= {"ops", "fit", "thth", "parallel", "sim"}
+
+    def test_unregistered_site_fails_loudly(self, tmp_path):
+        """The coverage self-check end-to-end: a file introducing a
+        record_build site with no probe produces a JP200 finding."""
+        mod = tmp_path / "newsite.py"
+        mod.write_text(
+            "from scintools_tpu.obs import retrace\n"
+            "def build():\n"
+            "    retrace.record_build('ghost.new_site', None)\n")
+        rep = jaxlint_run([str(mod)], rules=["program-coverage"],
+                          config=Config(repo_root=REPO))
+        assert [f.rule for f in rep.findings] == ["program-coverage"]
+        assert "ghost.new_site" in rep.findings[0].message
+
+    def test_committed_fingerprint_baseline_is_current(self):
+        """The committed baseline matches the live tree site-for-site
+        (a formulation flip would make JP205 fire in the gate above;
+        this pins the inverse — no stale entries either)."""
+        with open(os.path.join(REPO, "tools", "jaxlint",
+                               "program_baseline.json"),
+                  encoding="utf-8") as fh:
+            doc = json.load(fh)
+        rep = _unified()
+        assert set(doc["sites"]) == set(rep.program["summaries"])
+
+
 class TestTier1CliGate:
     """The acceptance criterion verbatim: the CLI exits 0 on the
     merged tree, and its JSON self-check reports a real scan."""
@@ -232,6 +298,10 @@ class TestTier1CliGate:
         doc = json.loads(p.stdout)
         assert doc["n_findings"] == 0
         assert doc["files_scanned"] >= 60
-        assert len(doc["rules"]) >= 7
+        assert len(doc["rules"]) >= 13
         for pkg in sorted(EXPECTED_PACKAGES):
             assert doc["packages"].get(pkg, 0) > 0, doc["packages"]
+        # the program pass ran inside the CLI too, full coverage
+        assert doc["program"]["sites"] >= 24
+        assert doc["program"]["probed"] == doc["program"]["sites"]
+        assert doc["program"]["traced"] == doc["program"]["sites"]

@@ -1,0 +1,299 @@
+"""Program-level contract audit (ISSUE 9): obs/programs.py probes +
+the jaxlint JP2xx rules.
+
+Two layers under test:
+
+- the PROBE/TRACE machinery (scintools_tpu/obs/programs.py): every
+  registered site traces to a summary without execution, fingerprints
+  are deterministic, and the PR-7 incident is pinned as a standing
+  contract — the fused and staged ``sspec_thth`` programs MUST carry
+  different fingerprints (the bench timing the wrong one is exactly
+  what fingerprint equality would have hidden);
+- the JP RULES (tools/jaxlint/program.py): synthetic probes with a
+  deliberate f64 leak, an oversized captured constant, a staged
+  ``debug.print``, a hardcoded donation, and a tampered baseline each
+  trip their rule — the fixtures document what every rule catches.
+
+The tier-1 gate over the real tree (zero findings, full probe
+coverage) lives in tests/test_lint.py.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from scintools_tpu.obs import programs  # noqa: E402
+from tools.jaxlint import Config  # noqa: E402
+from tools.jaxlint.program import (ProgramAudit,  # noqa: E402
+                                   write_program_baseline)
+from tools.jaxlint.framework import RULES  # noqa: E402
+
+
+def _rule(name):
+    # importing tools.jaxlint registers the AST rules; the JP rules
+    # register when the program module loads
+    import tools.jaxlint.program  # noqa: F401
+
+    return RULES[name]
+
+
+def _audit(site, build, config=None, **spec_kw):
+    """Synthetic audit: trace a throwaway ProbeSpec and wrap it the
+    way run_program_pass would."""
+    spec = programs.ProbeSpec(site, build, **spec_kw)
+    audit = ProgramAudit(site, "test/fixture.py", 1, spec=spec)
+    audit.summary = programs.summarize(spec)
+    return audit
+
+
+def _findings(rule_name, audit, config=None):
+    config = config or Config(repo_root=REPO)
+    return list(_rule(rule_name).check_program(audit, config))
+
+
+class TestProbeRegistry:
+    def test_every_probe_module_imports_and_registers(self):
+        n = programs.load_probes()
+        assert n >= 24
+        sites = set(programs.probes())
+        # one per subsystem at least — the pass doubles as executable
+        # documentation of every program the package compiles
+        for prefix in ("ops.", "fit.", "thth.", "parallel.", "sim."):
+            assert any(s.startswith(prefix) for s in sites), \
+                f"no probes under {prefix}"
+
+    def test_summaries_trace_without_execution_and_memoise(self):
+        s1 = programs.summary("thth.eval")
+        s2 = programs.summary("thth.eval")
+        assert s1 is s2                      # memoised
+        assert s1["n_eqns"] > 0 and s1["primitives"]
+        assert s1["fingerprint"] == programs.fingerprint(s1)
+
+    def test_fingerprint_deterministic_across_retrace(self):
+        s1 = programs.summary("thth.fused")
+        s2 = programs.summary("thth.fused", refresh=True)
+        assert s1["fingerprint"] == s2["fingerprint"]
+
+    def test_cost_estimates_exported_via_metrics(self):
+        from scintools_tpu.obs import metrics
+
+        programs.summary("thth.fused", refresh=True)
+        snap = metrics.snapshot()["gauges"]
+        key = 'program_flops_estimate{site="thth.fused"}'
+        assert snap.get(key, 0) > 0
+
+
+class TestPR7IncidentFixture:
+    """The PR-7 regression as a standing contract: the fused and
+    staged sspec_thth programs are DIFFERENT programs."""
+
+    def test_fused_vs_staged_fingerprints_differ(self):
+        fused = programs.summary("thth.fused")
+        staged = programs.summary("thth.multi_eval")
+        assert fused["fingerprint"] != staged["fingerprint"]
+        # and not vacuously: the fused program contains the FFT front
+        # end the staged program leaves on the host
+        assert fused["primitives"].get("fft", 0) \
+            > staged["primitives"].get("fft", 0)
+
+    def test_fused_thin_vs_staged_thin_differ(self):
+        fused = programs.summary("thth.fused_thin")
+        staged = programs.summary("thth.thin_eval")
+        assert fused["fingerprint"] != staged["fingerprint"]
+
+
+class TestJPRuleFixtures:
+    def test_f64_leak_trips_jp201(self):
+        leak = np.linspace(0.0, 1.0, 16384)      # 128 KiB of float64
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            return (lambda x: x * jnp.asarray(leak)[:4].sum()
+                    + x @ leak[:4]), \
+                (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        audit = _audit("test.f64_leak", build)
+        out = _findings("program-dtype", audit)
+        assert len(out) == 1 and "f64" in out[0].message
+
+    def test_clean_f32_program_passes_jp201(self):
+        def build():
+            import jax
+
+            return (lambda x: x * 2.0), \
+                (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        assert _findings("program-dtype", _audit("test.ok", build)) \
+            == []
+
+    def test_oversized_const_trips_jp202(self):
+        big = np.zeros(1 << 19, dtype=np.float32)  # 2 MiB float32
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            return (lambda x: x + jnp.asarray(big).sum()), \
+                (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        audit = _audit("test.const", build)
+        out = _findings("program-consts", audit)
+        assert len(out) == 1 and "closure constants" in out[0].message
+        # but not JP201: the constant is float32
+        assert _findings("program-dtype", audit) == []
+
+    def test_debug_print_trips_jp203_in_hot_sites_only(self):
+        def build():
+            import jax
+
+            def fn(x):
+                jax.debug.print("x={x}", x=x)
+                return x * 2
+
+            return fn, (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        hot = _audit("test.hot", build)
+        out = _findings("program-hostcalls", hot)
+        assert len(out) == 1 and "debug_callback" in str(
+            out[0].data["callbacks"])
+        cold = _audit("test.cold", build, hot=False)
+        assert _findings("program-hostcalls", cold) == []
+
+    def test_hardcoded_donation_trips_jp204(self):
+        # donate_argnums bypassing backend.donation_argnums(): on CPU
+        # the 'jit.donate' formulation is off, so observed donation
+        # must be empty
+        def build():
+            import jax
+
+            fn = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+            return fn, (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        audit = _audit("test.donate", build)
+        out = _findings("program-donation", audit)
+        assert len(out) == 1
+        assert "donation_argnums" in out[0].message
+        assert out[0].data == {"observed": [0], "expected": []}
+
+    def test_gated_donation_passes_jp204(self):
+        def build():
+            import jax
+
+            return jax.jit(lambda x: x + 1.0), \
+                (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        audit = _audit("test.donate_ok", build, donate=(0,))
+        assert _findings("program-donation", audit) == []
+
+
+class TestFingerprintGate:
+    def _config(self, tmp_path, sites):
+        root = tmp_path / "repo"
+        base = root / "tools" / "jaxlint"
+        base.mkdir(parents=True)
+        (base / "program_baseline.json").write_text(json.dumps(
+            {"version": 1, "sites": sites}))
+        return Config(repo_root=str(root))
+
+    def _simple_audit(self):
+        def build():
+            import jax
+
+            return (lambda x: x * 2.0 + 1.0), \
+                (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        return _audit("test.fp", build)
+
+    def test_matching_baseline_passes(self, tmp_path):
+        audit = self._simple_audit()
+        cfg = self._config(tmp_path, {"test.fp": dict(
+            audit.summary, fingerprint=audit.summary["fingerprint"])})
+        assert _findings("program-fingerprint", audit, cfg) == []
+
+    def test_flip_fails_with_readable_diff(self, tmp_path):
+        audit = self._simple_audit()
+        tampered = dict(audit.summary)
+        tampered["fingerprint"] = "0" * 16
+        tampered["primitives"] = {"mul": 1, "fft": 2}
+        cfg = self._config(tmp_path, {"test.fp": tampered})
+        out = _findings("program-fingerprint", audit, cfg)
+        assert len(out) == 1
+        assert "DIFFERENT program" in out[0].message
+        assert "fft:2->0" in out[0].message  # the readable diff
+
+    def test_unknown_site_demands_baseline_refresh(self, tmp_path):
+        audit = self._simple_audit()
+        cfg = self._config(tmp_path, {})
+        out = _findings("program-fingerprint", audit, cfg)
+        assert len(out) == 1
+        assert "--write-fingerprints" in out[0].message
+
+    def test_write_baseline_prunes_vanished_sites(self, tmp_path):
+        audit = self._simple_audit()
+        path = tmp_path / "pb.json"
+        path.write_text(json.dumps({"version": 1, "sites": {
+            "gone.site": {"fingerprint": "dead"},
+            "test.fp": {"fingerprint": "old"}}}))
+        written, pruned = write_program_baseline(
+            str(path), {"test.fp": audit.summary})
+        assert (written, pruned) == (1, 1)
+        doc = json.loads(path.read_text())
+        assert set(doc["sites"]) == {"test.fp"}
+        assert doc["sites"]["test.fp"]["fingerprint"] \
+            == audit.summary["fingerprint"]
+
+
+class TestCoverageRule:
+    def test_missing_probe_is_a_loud_finding(self):
+        audit = ProgramAudit("ghost.site", "pkg/mod.py", 12, spec=None)
+        out = _findings("program-coverage", audit)
+        assert len(out) == 1
+        assert "unaudited" in out[0].message
+        assert out[0].rel == "pkg/mod.py" and out[0].line == 12
+
+    def test_trace_failure_is_a_loud_finding(self):
+        def build():
+            raise RuntimeError("probe exploded")
+
+        spec = programs.ProbeSpec("test.broken", build)
+        audit = ProgramAudit("test.broken", "pkg/mod.py", 3, spec=spec,
+                             error=RuntimeError("probe exploded"))
+        out = _findings("program-coverage", audit)
+        assert len(out) == 1 and "failed to trace" in out[0].message
+
+    def test_registered_and_traced_site_is_silent(self):
+        def build():
+            import jax
+
+            return (lambda x: x), \
+                (jax.ShapeDtypeStruct((4,), np.float32),)
+
+        assert _findings("program-coverage",
+                         _audit("test.covered", build)) == []
+
+
+class TestShardedProbesDeviceIndependence:
+    """Sharded probes trace over the fixed AbstractMesh: fingerprints
+    must not depend on the live device count (this suite runs with 8
+    virtual devices; the CLI runs with 1)."""
+
+    def test_survey_step_fingerprint_matches_committed_baseline(self):
+        path = os.path.join(REPO, "tools", "jaxlint",
+                            "program_baseline.json")
+        if not os.path.exists(path):
+            pytest.skip("no committed baseline")
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        for site in ("parallel.survey_step", "parallel.gs_sharded",
+                     "parallel.retrieval_sharded"):
+            assert programs.summary(site)["fingerprint"] \
+                == doc["sites"][site]["fingerprint"], site

@@ -20,11 +20,22 @@ JL102     lock-discipline  unlocked shared-state writes in threaded
 JL103     jit-boundary     host-only calls inside traced bodies
 ========  ===============  ==========================================
 
+Plus the JP2xx PROGRAM-LEVEL pass (ISSUE 9, ``program.py``): every
+``record_build`` jit-cache site is traced via its registered
+abstract probe (``scintools_tpu/obs/programs.py``) and the resulting
+jaxpr audited — probe coverage (JP200), dtype policy (JP201),
+closure-constant budgets (JP202), host callbacks in hot paths
+(JP203), donation-vs-formulation consistency (JP204), and the
+program-fingerprint regression gate against the committed
+``program_baseline.json`` (JP205).
+
 CLI::
 
     python -m tools.jaxlint [paths] [--format text|json|sarif]
                             [--rules r1,r2] [--baseline FILE]
-                            [--write-baseline FILE] [--list-rules]
+                            [--write-baseline FILE]
+                            [--write-fingerprints [FILE]]
+                            [--list-rules]
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error. Escape
 hatch: ``# lint-ok: <rule>: <reason>`` (legacy ``sync-ok`` /
@@ -36,3 +47,4 @@ from .framework import (Config, FileContext, Finding, Report, Rule,  # noqa: F40
                         RULES, load_baseline, package_rel, register,
                         run, write_baseline, __version__)
 from . import rules as _rules  # noqa: F401  (populates the registry)
+from . import program as _program  # noqa: F401  (JP2xx rules)

@@ -34,7 +34,19 @@ def build_parser():
                         "suppress")
     p.add_argument("--write-baseline", metavar="FILE",
                    help="write current findings as a new baseline "
-                        "and exit 0")
+                        "and exit 0 (stale entries that no longer "
+                        "fire are pruned and the pruned count "
+                        "reported; --baseline is ignored for the "
+                        "scan so still-firing grandfathered findings "
+                        "are retained)")
+    p.add_argument("--write-fingerprints", metavar="FILE", nargs="?",
+                   const="", default=None,
+                   help="write the JP205 program-fingerprint "
+                        "baseline from the current program pass and "
+                        "exit 0 (default FILE: "
+                        "tools/jaxlint/program_baseline.json; prunes "
+                        "entries for vanished sites and reports the "
+                        "count)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("-o", "--output", help="write report here instead "
                                           "of stdout")
@@ -77,18 +89,47 @@ def main(argv=None):
             return 2
 
     try:
+        # --write-baseline snapshots the FULL current findings, so
+        # the scan ignores any --baseline (else still-firing
+        # grandfathered findings would silently drop from the new
+        # file and regress un-gated)
         report = run(targets, rules=rules,
                      config=Config(repo_root=_repo_root()),
-                     baseline=baseline)
+                     baseline=None if args.write_baseline
+                     else baseline)
     except Exception as e:   # an internal rule crash must be LOUD
         print(f"jaxlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
         return 2
 
     if args.write_baseline:
+        pruned = 0
+        if os.path.exists(args.write_baseline):
+            old = load_baseline(args.write_baseline)
+            pruned = len(old - {f.fingerprint()
+                                for f in report.findings})
         write_baseline(args.write_baseline, report.findings)
         print(f"jaxlint: wrote {len(report.findings)} finding(s) to "
-              f"baseline {args.write_baseline}")
+              f"baseline {args.write_baseline} "
+              f"({pruned} stale entr{'y' if pruned == 1 else 'ies'} "
+              f"pruned)")
+        return 0
+
+    if args.write_fingerprints is not None:
+        from .program import baseline_path, write_program_baseline
+
+        if report.program is None:
+            print("jaxlint: program pass did not run (no "
+                  "record_build sites in the scanned targets or no "
+                  "JP rules active)", file=sys.stderr)
+            return 2
+        path = args.write_fingerprints or baseline_path(
+            Config(repo_root=_repo_root()))
+        written, pruned = write_program_baseline(
+            path, report.program["summaries"])
+        print(f"jaxlint: wrote {written} program fingerprint(s) to "
+              f"{path} ({pruned} stale site(s) pruned, "
+              f"{report.program['sites']} site(s) scanned)")
         return 0
 
     out = RENDERERS[args.fmt](report)

@@ -385,13 +385,14 @@ class Report:
         self.packages = {}        # first path component -> file count
         self.rules = []
         self.wall_time_s = 0.0
+        self.program = None       # JP2xx pass stats (when it ran)
 
     @property
     def exit_code(self):
         return 1 if self.findings else 0
 
     def as_dict(self):
-        return {
+        doc = {
             "tool": "jaxlint",
             "version": __version__,
             "wall_time_s": round(self.wall_time_s, 4),
@@ -404,6 +405,10 @@ class Report:
             "baselined": self.baselined,
             "findings": [f.as_dict() for f in self.findings],
         }
+        if self.program is not None:
+            doc["program"] = {k: v for k, v in self.program.items()
+                              if k != "summaries"}
+        return doc
 
 
 def iter_py_files(target):
@@ -447,6 +452,7 @@ def run(targets, rules=None, config=None, baseline=None,
     file regardless of its declared package scope (fixture runs).
     """
     from . import rules as _rules_pkg  # noqa: F401  (registers rules)
+    from . import program as _program  # registers the JP2xx rules
 
     t0 = time.perf_counter()
     config = config or Config()
@@ -457,6 +463,9 @@ def run(targets, rules=None, config=None, baseline=None,
     report = Report()
     report.rules = [r.name for r in active]
     p0 = FileContext.parse_count
+    program_rules = [r for r in active
+                     if getattr(r, "program", False)]
+    site_map = {}
 
     seen = set()
     for target in targets:
@@ -476,6 +485,8 @@ def run(targets, rules=None, config=None, baseline=None,
                     "parse", path, e.lineno or 0,
                     f"syntax error: {e.msg}", rel=ctx.rel))
                 continue
+            if program_rules:
+                _program.collect_sites(ctx, site_map)
             for rule in active:
                 if respect_scope and not rule.applies(ctx.rel):
                     continue
@@ -488,6 +499,19 @@ def run(targets, rules=None, config=None, baseline=None,
                         report.baselined += 1
                         continue
                     report.findings.append(f)
+
+    # JP2xx program pass: runs once over the statically-collected
+    # site map (skipped entirely — no jax import — when the scanned
+    # targets contain no record_build sites, e.g. fixture runs)
+    if program_rules and site_map:
+        pfindings, pstats = _program.run_program_pass(
+            site_map, program_rules, config)
+        report.program = pstats
+        for f in pfindings:
+            if f.fingerprint() in baseline:
+                report.baselined += 1
+                continue
+            report.findings.append(f)
 
     report.parse_count = FileContext.parse_count - p0
     report.findings.sort(key=lambda f: (f.rel, f.line, f.rule,

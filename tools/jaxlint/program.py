@@ -1,0 +1,447 @@
+"""JP2xx — program-level contract rules over every cached jit site.
+
+The AST rules (JL1xx) see source text; the failure modes that cost
+this repo the most are only visible in the *traced program*. PR 7
+found the bench silently timing the staged ``sspec_thth`` path
+(stamped 0.31x while the fused path measured 2.36x): the source was
+clean, the wrong PROGRAM was compiled. This pass audits the programs
+themselves — for every site in the ``obs/retrace.py`` ``record_build``
+registry it traces the site's registered abstract probe
+(``scintools_tpu/obs/programs.py``: ``jax.make_jaxpr`` over
+``ShapeDtypeStruct`` inputs — no execution, no compile, CPU-only,
+~5 s for the whole registry) and checks the resulting program against
+per-site contracts:
+
+========  ====================  ===================================
+id        rule                  catches
+========  ====================  ===================================
+JP200     program-coverage      a ``record_build`` site with no
+                                registered probe (an unaudited
+                                program), or a probe that fails to
+                                trace
+JP201     program-dtype         f64/c128 leaks in a float32-policy
+                                program: wide avals, or wide closure
+                                constants above the site budget
+JP202     program-consts        closure-captured array constants
+                                baked into the program above the
+                                site's byte budget (compile bloat
+                                the AST retrace rule cannot see)
+JP203     program-hostcalls     host-callback primitives
+                                (pure_callback / io_callback /
+                                debug_callback) in hot-path sites
+JP204     program-donation      observed buffer donation
+                                inconsistent with the declared
+                                argnums under the 'jit.donate'
+                                formulation, or donated buffers no
+                                output can reuse
+JP205     program-fingerprint   the site's program fingerprint
+                                (avals + primitive multiset + consts
+                                + formulations + donation) differs
+                                from the committed baseline
+                                (``tools/jaxlint/program_baseline
+                                .json``) — the PR-7 regression class,
+                                failed loudly with a readable diff
+========  ====================  ===================================
+
+Sites are discovered STATICALLY during the normal file scan
+(:func:`collect_sites`: literal first arguments of ``record_build``
+calls plus literal ``site=`` keywords of ``keyed_jit_cache``-style
+calls), then cross-checked against the probe registry — so a new
+cached jit site without a probe fails tier-1 loudly (JP200), and a
+probe whose site vanished is reported stale. Summaries are memoised
+per process (obs/programs.py), so repeated ``run()`` calls after the
+first pay only the rule checks.
+
+Baseline workflow::
+
+    python -m tools.jaxlint --write-fingerprints   # refresh baseline
+    git diff tools/jaxlint/program_baseline.json   # REVIEW the flip
+
+A fingerprint change is a formulation/program change: review it like
+a semantics change, not like churn.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from .framework import Finding, Rule, package_rel, register
+
+#: default committed fingerprint baseline, relative to the repo root
+BASELINE_RELPATH = os.path.join("tools", "jaxlint",
+                                "program_baseline.json")
+
+#: primitive names that cross the host boundary at run time
+_HOST_CALLBACK_MARKER = "callback"
+
+
+def _callee_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def collect_sites(ctx, into):
+    """Accumulate ``{site: (rel, line)}`` from one FileContext:
+    literal first arguments of ``record_build(...)`` calls and
+    literal ``site="..."`` keywords anywhere (the
+    ``keyed_jit_cache(site=...)`` convention). Non-literal site names
+    are reported by the retrace-hazard AST machinery, not here."""
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node.func)
+        if name == "record_build" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                into.setdefault(arg.value, (ctx.rel, arg.lineno))
+        for kw in node.keywords:
+            if kw.arg == "site" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                into.setdefault(kw.value.value,
+                                (ctx.rel, kw.value.lineno))
+
+
+class ProgramAudit:
+    """One site's audit input: its static location, registered probe
+    (or None) and traced summary (or the trace error)."""
+
+    __slots__ = ("site", "rel", "line", "spec", "summary", "error")
+
+    def __init__(self, site, rel, line, spec=None, summary=None,
+                 error=None):
+        self.site = site
+        self.rel = rel
+        self.line = line
+        self.spec = spec
+        self.summary = summary
+        self.error = error
+
+    def anchor(self):
+        """(rel, line) for findings: the probe registration when one
+        exists (the contract lives there), else the record_build call
+        site."""
+        if self.spec is not None:
+            rel = package_rel(self.spec.path)
+            if rel is not None:
+                return rel, self.spec.lineno
+        return self.rel, self.line
+
+
+class ProgramRule(Rule):
+    """Base for JP rules: no per-file findings; the runner calls
+    :meth:`check_program` once per audited site after the file scan.
+    Line markers cannot apply (there is no flagged source line), so
+    suppression is baseline-only; ``code`` carries the site name to
+    keep baseline fingerprints line-insensitive AND content-stable."""
+
+    program = True
+    self_markers = True
+    scope = None
+
+    def check(self, ctx, config):
+        return ()
+
+    def check_program(self, audit, config):
+        raise NotImplementedError
+
+    def site_finding(self, audit, message, data=None):
+        rel, line = audit.anchor()
+        return Finding(self.name, rel, line, message, rel=rel,
+                       data=data, code=f"site:{audit.site}")
+
+
+@register
+class ProgramCoverageRule(ProgramRule):
+    id = "JP200"
+    name = "program-coverage"
+    short = ("record_build sites without a registered abstract probe "
+             "(unaudited programs), or probes that fail to trace")
+
+    def check_program(self, audit, config):
+        if audit.spec is None:
+            yield self.site_finding(
+                audit,
+                f"jit-cache site '{audit.site}' has no registered "
+                f"abstract probe — its compiled program is unaudited. "
+                f"Register one next to the site with "
+                f"@obs.programs.register_probe({audit.site!r}) (and "
+                f"list the module in obs.programs.PROBE_MODULES)")
+        elif audit.error is not None:
+            yield self.site_finding(
+                audit,
+                f"probe for site '{audit.site}' failed to trace: "
+                f"{type(audit.error).__name__}: {audit.error}")
+
+
+@register
+class ProgramDtypeRule(ProgramRule):
+    id = "JP201"
+    name = "program-dtype"
+    short = ("f64/c128 leaks in float32-policy programs (wide avals "
+             "or oversized wide closure constants)")
+
+    def check_program(self, audit, config):
+        s = audit.summary
+        if s is None or audit.spec.policy != "float32":
+            return
+        if s["wide_avals"]:
+            yield self.site_finding(
+                audit,
+                f"site '{audit.site}' (float32 policy) computes wide "
+                f"intermediates: {', '.join(s['wide_avals'][:4])} — "
+                f"a mixed-precision leak; cast at the program "
+                f"boundary or declare policy='float64' on the probe")
+        budget = audit.spec.f64_const_budget
+        if s["wide_const_bytes"] > budget:
+            yield self.site_finding(
+                audit,
+                f"site '{audit.site}' (float32 policy) bakes "
+                f"{s['wide_const_bytes']} bytes of f64/c128 closure "
+                f"constants (budget {budget}) — host geometry should "
+                f"be cast to float32 before capture",
+                data={"wide_const_bytes": s["wide_const_bytes"]})
+
+
+@register
+class ProgramConstsRule(ProgramRule):
+    id = "JP202"
+    name = "program-consts"
+    short = ("closure-captured array constants baked into a program "
+             "above the site's byte budget (compile bloat)")
+
+    def check_program(self, audit, config):
+        s = audit.summary
+        if s is None:
+            return
+        budget = audit.spec.const_budget
+        if s["const_bytes"] > budget:
+            yield self.site_finding(
+                audit,
+                f"site '{audit.site}' bakes {s['const_bytes']} bytes "
+                f"of closure constants into the program (budget "
+                f"{budget}, largest {s['max_const_bytes']}) — pass "
+                f"large arrays as traced arguments so they are not "
+                f"re-embedded (and re-hashed) per compile",
+                data={"const_bytes": s["const_bytes"]})
+
+
+@register
+class ProgramHostcallsRule(ProgramRule):
+    id = "JP203"
+    name = "program-hostcalls"
+    short = ("host-callback primitives (pure_callback/io_callback/"
+             "debug_callback) inside hot-path programs")
+
+    def check_program(self, audit, config):
+        s = audit.summary
+        if s is None or not audit.spec.hot:
+            return
+        hits = {p: n for p, n in s["primitives"].items()
+                if _HOST_CALLBACK_MARKER in p}
+        if hits:
+            yield self.site_finding(
+                audit,
+                f"hot-path site '{audit.site}' stages host callbacks "
+                f"{hits} — each fences the device per call; remove "
+                f"it or mark the probe hot=False with a reason",
+                data={"callbacks": hits})
+
+
+@register
+class ProgramDonationRule(ProgramRule):
+    id = "JP204"
+    name = "program-donation"
+    short = ("observed buffer donation inconsistent with the "
+             "declared argnums under the 'jit.donate' formulation")
+
+    def check_program(self, audit, config):
+        s = audit.summary
+        if s is None:
+            return
+        from scintools_tpu.backend import formulation
+
+        active = formulation("jit.donate", platform="cpu") == "on"
+        expected = sorted(audit.spec.donate) if active else []
+        observed = sorted(s["donated"])
+        if observed != expected:
+            yield self.site_finding(
+                audit,
+                f"site '{audit.site}' donates argnums {observed} but "
+                f"the 'jit.donate' formulation "
+                f"({'on' if active else 'off'} on this platform) "
+                f"implies {expected} — donation must route through "
+                f"backend.donation_argnums(), never be hardcoded",
+                data={"observed": observed, "expected": expected})
+            return
+        out_avals = set(s["out_avals"])
+        for argnum in observed:
+            if argnum < len(s["in_avals"]) \
+                    and s["in_avals"][argnum] not in out_avals:
+                yield self.site_finding(
+                    audit,
+                    f"site '{audit.site}' donates argnum {argnum} "
+                    f"({s['in_avals'][argnum]}) but no output matches "
+                    f"its shape/dtype — XLA cannot reuse the buffer "
+                    f"and warns on every compile")
+
+
+@register
+class ProgramFingerprintRule(ProgramRule):
+    id = "JP205"
+    name = "program-fingerprint"
+    short = ("program fingerprint differs from the committed "
+             "baseline — the compiler picked a different program")
+
+    def check_program(self, audit, config):
+        s = audit.summary
+        if s is None:
+            return
+        baseline = load_program_baseline(config)
+        if baseline is None:
+            yield self.site_finding(
+                audit,
+                f"no committed program-fingerprint baseline at "
+                f"{BASELINE_RELPATH} — run `python -m tools.jaxlint "
+                f"--write-fingerprints` and commit it")
+            return
+        entry = baseline.get("sites", {}).get(audit.site)
+        if entry is None:
+            yield self.site_finding(
+                audit,
+                f"site '{audit.site}' has no committed fingerprint "
+                f"(new program) — run `python -m tools.jaxlint "
+                f"--write-fingerprints`, review and commit the diff")
+            return
+        if entry.get("fingerprint") == s["fingerprint"]:
+            return
+        yield self.site_finding(
+            audit,
+            f"site '{audit.site}' compiles a DIFFERENT program than "
+            f"the committed baseline ({entry.get('fingerprint')} -> "
+            f"{s['fingerprint']}): {summary_diff(entry, s)} — if "
+            f"deliberate, refresh with --write-fingerprints and "
+            f"commit the reviewed diff",
+            data={"diff": summary_diff(entry, s)})
+
+
+def summary_diff(old, new):
+    """Readable one-line structural diff between a baseline entry and
+    a live summary — what changed, not just that something did."""
+    parts = []
+    po, pn = old.get("primitives", {}), new.get("primitives", {})
+    prim_delta = []
+    for p in sorted(set(po) | set(pn)):
+        a, b = po.get(p, 0), pn.get(p, 0)
+        if a != b:
+            prim_delta.append(f"{p}:{a}->{b}")
+    if prim_delta:
+        parts.append("primitives{" + ", ".join(prim_delta[:8])
+                     + (", ..." if len(prim_delta) > 8 else "") + "}")
+    for key in ("in_avals", "out_avals", "formulations", "donated",
+                "policy", "const_count", "const_dtypes"):
+        a, b = old.get(key), new.get(key)
+        if a != b:
+            parts.append(f"{key}: {a} -> {b}")
+    return "; ".join(parts) or "identity fields unchanged (hash " \
+                                "inputs reordered?)"
+
+
+# ---------------------------------------------------------------------
+# pass runner + baseline I/O
+# ---------------------------------------------------------------------
+
+_BASELINE_CACHE = {}
+
+
+def baseline_path(config):
+    return os.path.join(config.repo_root, BASELINE_RELPATH)
+
+
+def load_program_baseline(config):
+    """The committed fingerprint baseline document, or None when the
+    file does not exist (cached per path per process)."""
+    path = baseline_path(config)
+    if path not in _BASELINE_CACHE:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                _BASELINE_CACHE[path] = json.load(fh)
+        except FileNotFoundError:
+            _BASELINE_CACHE[path] = None
+    return _BASELINE_CACHE[path]
+
+
+def write_program_baseline(path, summaries):
+    """Write ``{site: summary}`` as the new fingerprint baseline;
+    returns ``(written, pruned)`` counts vs any previous file. The
+    stored entries keep the full identity fields so JP205 diffs stay
+    readable offline."""
+    from scintools_tpu.obs.programs import FINGERPRINT_FIELDS
+
+    old_sites = set()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            old_sites = set(json.load(fh).get("sites", {}))
+    except (OSError, ValueError):
+        pass
+    sites = {}
+    for site, s in sorted(summaries.items()):
+        entry = {k: s[k] for k in FINGERPRINT_FIELDS if k in s}
+        entry["fingerprint"] = s["fingerprint"]
+        sites[site] = entry
+    doc = {
+        "version": 1,
+        "note": ("program fingerprints per jit-cache site — traced "
+                 "CPU-canonical over a fixed AbstractMesh "
+                 "(obs/programs.py); refresh with `python -m "
+                 "tools.jaxlint --write-fingerprints` and REVIEW the "
+                 "diff like a semantics change"),
+        "sites": sites,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    _BASELINE_CACHE.pop(path, None)
+    return len(sites), len(old_sites - set(sites))
+
+
+def run_program_pass(site_map, rules, config):
+    """Audit every site in ``site_map`` with the active program
+    ``rules``. Returns ``(findings, stats)`` where ``stats`` also
+    carries the traced summaries (for ``--write-fingerprints``)."""
+    findings = []
+    stats = {"sites": len(site_map), "probed": 0, "traced": 0,
+             "stale_probes": [], "summaries": {}}
+    if not site_map:
+        return findings, stats
+
+    from scintools_tpu.obs import programs
+
+    registry = programs.probes()
+    audits = []
+    for site, (rel, line) in sorted(site_map.items()):
+        spec = registry.get(site)
+        audit = ProgramAudit(site, rel, line, spec=spec)
+        if spec is not None:
+            stats["probed"] += 1
+            try:
+                audit.summary = programs.summary(site)
+                stats["traced"] += 1
+                stats["summaries"][site] = audit.summary
+            except Exception as e:  # surfaced as a JP200 finding
+                audit.error = e
+        audits.append(audit)
+
+    # probes whose site vanished from the tree: report as stale so a
+    # renamed site cannot keep shipping a green-but-dead audit
+    stats["stale_probes"] = sorted(set(registry) - set(site_map))
+
+    for audit in audits:
+        for rule in rules:
+            findings.extend(rule.check_program(audit, config))
+    return findings, stats

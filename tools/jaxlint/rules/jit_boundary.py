@@ -16,8 +16,11 @@ Detection: within one module, a function is **traced** when it (a
 ``def`` or ``lambda``) is passed to a trace consumer (``jit``,
 ``vmap``, ``pmap``, ``grad``, ``value_and_grad``, ``checkpoint``,
 ``remat``, ``lax.scan``/``map``/``cond``/``while_loop``/
-``fori_loop``/``switch``/``associative_scan``), directly or through
-the module-local call graph (a helper called from a traced body is
+``fori_loop``/``switch``/``associative_scan``), positionally OR
+through a branch/body keyword (``cond_fun=``/``body_fun=``/``f=``/
+``true_fun=``/``false_fun=``/``branches=`` — the keyword form was
+the known blind spot closed in ISSUE 9), directly or through the
+module-local call graph (a helper called from a traced body is
 traced too; resolution is name-based within the file).
 
 Flagged inside traced bodies:
@@ -47,9 +50,17 @@ from ..framework import Rule, register
 #: callee names whose first functional argument is traced
 _WRAPPERS = {"jit", "vmap", "pmap", "grad", "value_and_grad",
              "checkpoint", "remat"}
+#: keyword names a wrapper's traced callable may arrive through
+_WRAPPER_KWARGS = {"fun", "f"}
 #: lax-style consumers — every function-valued argument is traced
 _LAX_CONSUMERS = {"scan", "while_loop", "fori_loop", "cond", "switch",
                   "map", "associative_scan"}
+#: keyword names lax consumers accept their branch/body callables
+#: through (``lax.while_loop(cond_fun=..., body_fun=...)``,
+#: ``lax.scan(f=...)``, ``lax.cond(pred, true_fun=..., ...)``) — the
+#: keyword-passed form was the known AST blind spot before ISSUE 9
+_LAX_CALLABLE_KWARGS = {"f", "fun", "fn", "cond_fun", "body_fun",
+                        "true_fun", "false_fun", "branches"}
 _ALL_CONSUMERS = _WRAPPERS | _LAX_CONSUMERS
 
 _NP_WRITERS = {"save", "savez", "savez_compressed", "savetxt"}
@@ -118,12 +129,17 @@ def _resolve(ctx, scopes, site, name):
 
 
 def _functional_args(call):
-    """Argument expressions of ``call`` that may be traced functions."""
+    """Argument expressions of ``call`` that may be traced functions
+    — positional AND keyword (``lax.while_loop(cond_fun=c,
+    body_fun=b, init_val=x)`` traces ``c``/``b`` exactly like the
+    positional form)."""
     name = _callee_name(call.func)
     if name in _WRAPPERS:
-        return call.args[:1]
+        return call.args[:1] + [kw.value for kw in call.keywords
+                                if kw.arg in _WRAPPER_KWARGS]
     if name in _LAX_CONSUMERS:
-        return list(call.args)
+        return list(call.args) + [kw.value for kw in call.keywords
+                                  if kw.arg in _LAX_CALLABLE_KWARGS]
     return []
 
 
