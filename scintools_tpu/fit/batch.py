@@ -214,15 +214,25 @@ def scint_params_acf2d_batch(params, ydatas, weights=None, n_iter=60,
 
 
 def scint_params_batch(dyns, dt, df, alpha=5 / 3, n_iter=100,
-                       bartlett=True, weighted=True, backend="jax"):
+                       bartlett=True, weighted=True, backend="jax",
+                       device_out=False):
     """Fit (τ_d, Δν_d, amp) on a whole batch of epochs in one program:
     batched ACF → one-sided cuts → vmapped LM (the survey-scale path
     the reference runs serially at dynspec.py:2698 per epoch).
 
-    ``dyns[B, nf, nt]`` → dict of per-epoch numpy arrays.
+    ``dyns[B, nf, nt]`` → dict of per-epoch numpy arrays. A
+    device-resident ``dyns`` stack (e.g. straight out of the scenario
+    factory, sim/factory.py) is consumed IN FLIGHT on the jax
+    backend — no host round trip on entry — and ``device_out=True``
+    skips the result fetch too, so a composing device pipeline fences
+    only at its own consumption point.
     """
-    dyns = np.asarray(dyns, dtype=np.float32) if backend == "jax" \
-        else np.asarray(dyns)
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        dyns = jnp.asarray(dyns, dtype=jnp.float32)
+    else:
+        dyns = np.asarray(dyns)
     B, nf, nt = dyns.shape
     tcuts, fcuts = acf_cuts_batch(dyns, backend=backend)
     fit = make_acf1d_batch(nt, nf, dt, df, alpha=alpha, n_iter=n_iter,
@@ -230,6 +240,8 @@ def scint_params_batch(dyns, dt, df, alpha=5 / 3, n_iter=100,
     import jax.numpy as jnp
 
     out = fit(jnp.asarray(tcuts), jnp.asarray(fcuts))
+    if device_out:
+        return out
     return {k: np.asarray(v) for k, v in out.items()}
 
 

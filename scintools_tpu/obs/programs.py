@@ -82,6 +82,8 @@ PROBE_MODULES = (
     "scintools_tpu.parallel.fft",
     "scintools_tpu.parallel.survey",
     "scintools_tpu.sim.simulation",
+    "scintools_tpu.sim.factory",
+    "scintools_tpu.sim.scenario",
 )
 
 _WIDE_DTYPES = ("float64", "complex128")

@@ -12,7 +12,8 @@ from .survey import (make_survey_step, make_eta_search_sharded,
                      make_arc_profile_sharded, make_arc_fit_sharded,
                      make_thth_grid_search_sharded,
                      make_thth_thin_grid_search_sharded,
-                     make_fused_grid_search_sharded)
+                     make_fused_grid_search_sharded,
+                     make_scenario_factory_sharded)
 from .checkpoint import (EpochJournal, atomic_write_bytes,
                          atomic_write_json)
 from .pipeline import (PrefetchLoader, AsyncJournalWriter,
@@ -31,4 +32,5 @@ __all__ = [
     "make_thth_grid_search_sharded",
     "make_thth_thin_grid_search_sharded",
     "make_fused_grid_search_sharded", "chunk_shardings",
+    "make_scenario_factory_sharded",
 ]

@@ -415,6 +415,47 @@ def make_survey_step(mesh, nf, nt, dt=1.0, df=1.0, alpha=5 / 3,
     return jax.jit(step, in_shardings=(dyn_sh,), **kwargs)
 
 
+def make_scenario_factory_sharded(mesh, ns=128, nf=64, dlam=0.25,
+                                  rf=1.0, ds=0.01, inner=0.001,
+                                  nscreens=64, precision=None,
+                                  screen=None, propagate=None,
+                                  levels=1, lamsteps=False):
+    """Epoch-sharded scenario factory: the device-native batched
+    simulator (sim/factory.py:build_scenario_fn) as one SPMD program
+    ``fn(keys[B, 2], mb2[B], ar[B], psi[B], alpha[B]) →
+    (dynspec[B, ns, nf], ok[B])`` with the lane axis B split across
+    every device of ``mesh`` — a pod generates a million-epoch
+    scenario campaign the way it searches one (ROADMAP item 1's
+    fleet gets its synthetic workload from here). B must be divisible
+    by the mesh device count. Per-lane regime params stay traced, so
+    one compile per geometry serves every sweep; the in-program
+    ``lax.map`` grouping is disabled (the mesh itself bounds the
+    per-device working set)."""
+    jax = get_jax()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..sim.factory import build_scenario_fn
+
+    fn = build_scenario_fn(
+        ns=ns, nf=nf, dlam=dlam, rf=rf, ds=ds, inner=inner,
+        nscreens=nscreens, group_size=nscreens, precision=precision,
+        screen=screen, propagate=propagate, levels=levels,
+        lamsteps=lamsteps)
+    lane = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS)))
+    lane2 = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS), None))
+    lane3 = NamedSharding(mesh, P((DATA_AXIS, SEQ_AXIS), None, None))
+    from ..obs import retrace as _retrace
+
+    _retrace.record_build(
+        "parallel.scenario_sharded",
+        (int(ns), int(nf), float(dlam), float(rf), float(ds),
+         float(inner), int(nscreens), precision, screen, propagate,
+         int(levels), bool(lamsteps)))
+    return jax.jit(fn,
+                   in_shardings=(lane2, lane, lane, lane, lane),
+                   out_shardings=(lane3, lane))
+
+
 # ---------------------------------------------------------------------
 # abstract program probes (obs/programs.py) — audited by the jaxlint
 # JP2xx program pass (tools/jaxlint/program.py). Every sharded probe
@@ -550,3 +591,15 @@ def _probe_survey_step():
     fn = make_survey_step(abstract_mesh(), 16, 16, n_iter=8)
     S = jax.ShapeDtypeStruct
     return fn, (S((2, 16, 16), np.float32),)
+
+
+@_register_probe("parallel.scenario_sharded",
+                 formulations=("sim.screen", "sim.propagate"))
+def _probe_scenario_sharded():
+    import jax
+
+    fn = make_scenario_factory_sharded(abstract_mesh(), ns=8, nf=4,
+                                       nscreens=4)
+    S = jax.ShapeDtypeStruct
+    lane = S((4,), np.float32)
+    return fn, (S((4, 2), np.uint32), lane, lane, lane, lane)

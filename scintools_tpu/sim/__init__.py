@@ -1,8 +1,19 @@
 """Simulation subpackage: Coles-2010 EM simulation, Rickett-2014
-analytic ACF, Yao-2020 brightness (scint_sim.py re-design)."""
+analytic ACF, Yao-2020 brightness (scint_sim.py re-design), and the
+device-native batched scenario factory + closed-loop scenario survey
+(ISSUE 10)."""
 
 from .simulation import Simulation, simulate_dynspec_batch
+from .factory import (make_scenario_factory, simulate_scenarios,
+                      simulate_screens, lane_keys_from_seeds,
+                      SIM_GROUP_SIZE)
+from .scenario import (run_scenario_survey, scenario_truths,
+                       recovery_summary, DEFAULT_REGIMES)
 from .acf_model import ACF
 from .brightness import Brightness
 
-__all__ = ["Simulation", "simulate_dynspec_batch", "ACF", "Brightness"]
+__all__ = ["Simulation", "simulate_dynspec_batch",
+           "make_scenario_factory", "simulate_scenarios",
+           "simulate_screens", "lane_keys_from_seeds",
+           "SIM_GROUP_SIZE", "run_scenario_survey", "scenario_truths",
+           "recovery_summary", "DEFAULT_REGIMES", "ACF", "Brightness"]

@@ -1,0 +1,372 @@
+"""Closed-loop scenario survey: generate → search → fit, on device.
+
+The device-native factory (sim/factory.py) makes synthetic epochs
+cheap enough to be a SURVEY PRODUCT: this module flows
+factory-generated epochs straight into the existing batched
+search/fit through the full robustness stack
+(robust/runner.py:run_survey_batched — ladder fallback, CRC journal,
+SIGKILL resume, RunReport), pinning η / τ_d / Δν_d recovery against
+each lane's closed-form ground truth across regime sweeps. It is the
+fuzzing loop ROADMAP item 4 calls for (≥10⁴ synthetic epochs per run;
+the bench `scenario_loop` config runs ≥10³ on the 1-core CPU host)
+and the workload that makes a multi-host fleet worth scaling.
+
+Shape of one batch (one device program each stage, epochs resident in
+HBM throughout — the dynspec stack never round-trips the host on the
+fused tier):
+
+1. **generate** — ``simulate_scenarios(device_out=True)``: per-lane
+   regime params (mb2/ar/psi/alpha) ride the batch axis of ONE
+   compiled factory program; lanes are keyed by their epoch seed
+   (``lane_keys_from_seeds``), so an epoch's data is independent of
+   batch grouping, quarantined neighbours, and resume boundaries.
+2. **search** — batched secondary spectra (cached ``sim.scenario_sspec``
+   program) → ``ops/fitarc.py:fit_arc_batch`` arc-curvature
+   measurement, with the per-lane η search window derived from the
+   lane's theoretical curvature.
+3. **fit** — ``fit/batch.py:scint_params_batch(device=stack)``:
+   vmapped LM over the whole stack for (τ_d, Δν_d, amp).
+
+Fallback ladder: a lane the batch path rejects descends to the STAGED
+tier (single-lane factory at ``precision='highest'`` + the same jax
+fits) and finally to the NUMPY tier (the reference ``Simulation``
+class + host scipy fits) — the closed loop exercises every tier the
+real surveys use.
+
+Ground truths (per lane, closed form — scint_sim.py:123-134 and the
+``set_constants`` normalisation): the arc curvature η is exact; the
+scintillation timescale is ``τ_d = s0/V`` (s0 the diffractive scale,
+V = ds/dt the effective velocity); the decorrelation bandwidth scales
+as ``Δν_d ∝ f · (s0/rf)²`` with an O(1) constant calibrated once
+against the simulator's own convention (``DNU_CAL``, measured on the
+f64 oracle path and pinned in tests/test_sim_factory.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.special import gamma as _gamma
+
+from ..backend import get_jax
+from ..utils import slog
+
+#: τ_d / Δν_d calibration of the Fresnel↔diffractive crossover to
+#: THIS simulator's convention, measured on the f64 oracle path at
+#: ns=256 (and cross-checked at ns=128/ds=0.02 and ns=64/ds=0.04 —
+#: recovered/true ratios 0.8–1.2 across mb2 ∈ [0.5, 32] and ar ∈
+#: [1, 2]): the intensity decorrelation scale saturates at
+#: ``TAU_FRES·rf`` in weak scattering and follows ``TAU_DIFF·s0`` in
+#: strong scattering; the decorrelation bandwidth saturates at
+#: ``DNU_FRES`` of the band and falls as ``DNU_DIFF·(s0/rf)`` of it
+#: when diffractive. Harmonic (inverse-quadrature) crossover between
+#: the limits; ``ar``'s calibrated effect is ``τ ∝ ar^-1/2``,
+#: ``Δν ∝ ar^1/4`` at ψ=30°.
+TAU_FRES = 0.19
+TAU_DIFF = 1.3
+DNU_FRES = 0.65
+DNU_DIFF = 1.95
+
+#: default regime sweep: weak (Fresnel-limited) / strong
+#: (diffractive) scattering and anisotropy — one compiled factory
+#: program serves all of them (traced lane params).
+DEFAULT_REGIMES = (
+    {"name": "weak", "mb2": 0.5, "ar": 1.0, "psi": 0.0,
+     "alpha": 5 / 3},
+    {"name": "strong", "mb2": 16.0, "ar": 1.0, "psi": 0.0,
+     "alpha": 5 / 3},
+    {"name": "aniso", "mb2": 16.0, "ar": 2.0, "psi": 30.0,
+     "alpha": 5 / 3},
+)
+
+
+def scenario_truths(mb2, ar, psi, alpha, rf=1.0, ds=0.02, dt=30.0,
+                    freq=1400.0, dlam=0.05):
+    """Closed-form per-lane ground truths ``{eta, tau, dnu}`` (host
+    numpy, broadcastable lane arrays).
+
+    ``eta`` [s³] is the reference's exact theoretical arc curvature
+    (scint_sim.py:123-133; numerically identical to us/mHz² on the
+    sspec axes ``sspec_axes`` builds). ``tau`` [s] and ``dnu`` [MHz]
+    are the calibrated Fresnel↔diffractive crossover forms (constants
+    above): the diffractive scale is ``s0 = rf·cdrf^(1/α)``
+    (``set_constants``), the effective velocity ``V = ds/dt``."""
+    mb2, ar, psi, alpha = np.broadcast_arrays(
+        *(np.asarray(v, dtype=float) for v in (mb2, ar, psi, alpha)))
+    a2 = alpha * 0.5
+    cdrf = (2.0 ** alpha * np.cos(alpha * np.pi * 0.25)
+            * _gamma(1.0 + a2) / mb2)
+    s0 = rf * cdrf ** (1.0 / alpha)
+    V = ds / dt
+    k_wave = 2 * np.pi / freq
+    eta = (rf ** 2 * k_wave / (2 * V ** 2) / 1e6
+           / np.cos(psi * np.pi / 180) ** 2)
+    tau = 1.0 / (V * np.sqrt((1 / (TAU_FRES * rf)) ** 2
+                             + (1 / (TAU_DIFF * s0)) ** 2)
+                 * np.sqrt(ar))
+    band = freq * dlam
+    dnu = (band / np.sqrt(1 / DNU_FRES ** 2
+                          + (rf / (DNU_DIFF * s0)) ** 2)
+           * ar ** 0.25)
+    return {"eta": eta, "tau": tau, "dnu": dnu}
+
+
+_SSPEC_DB_CACHE = {}
+
+
+def make_sspec_db_batch(nt, nf, window="hanning", window_frac=0.1):
+    """Cached jitted batched secondary spectrum in dB:
+    ``fn(dyns[B, nf, nt]) → sec_db[B, ntdel, nfdop]`` — the search
+    stage's front half, one program per epoch geometry
+    (``sim.scenario_sspec`` retrace site)."""
+    from ..ops.sspec import secondary_spectrum_power
+    from ..ops.windows import get_window
+
+    key = (int(nt), int(nf), window, float(window_frac))
+    fn = _SSPEC_DB_CACHE.get(key)
+    if fn is None:
+        jax = get_jax()
+        import jax.numpy as jnp
+
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("sim.scenario_sspec", key)
+        wins = get_window(nt, nf, window=window, frac=window_frac)
+
+        def run(dyns):
+            power = jax.vmap(lambda d: secondary_spectrum_power(
+                d, window_arrays=wins, backend="jax"))(dyns)
+            return 10.0 * jnp.log10(power)
+
+        fn = jax.jit(run)
+        if len(_SSPEC_DB_CACHE) >= 16:
+            _SSPEC_DB_CACHE.pop(next(iter(_SSPEC_DB_CACHE)))
+        _SSPEC_DB_CACHE[key] = fn
+    return fn
+
+
+def _lane_table(regimes, epochs_per_regime, seed):
+    """The survey's epoch list: ``(epoch_id, payload)`` with tiny
+    host payloads carrying the lane's regime params and its
+    deterministic integer seed (the device key derives from it)."""
+    epochs = []
+    for ri, reg in enumerate(regimes):
+        for i in range(epochs_per_regime):
+            lane_seed = int(seed) * 1000003 + ri * 100003 + i
+            epochs.append((f"{reg['name']}/{i:05d}", {
+                "regime": reg["name"],
+                "mb2": float(reg.get("mb2", 2.0)),
+                "ar": float(reg.get("ar", 1.0)),
+                "psi": float(reg.get("psi", 0.0)),
+                "alpha": float(reg.get("alpha", 5 / 3)),
+                "seed": lane_seed & 0x7FFFFFFF,
+            }))
+    return epochs
+
+
+def run_scenario_survey(workdir, regimes=DEFAULT_REGIMES,
+                        epochs_per_regime=128, ns=128, nf=64,
+                        dlam=0.05, rf=1.0, ds=0.02, dt=30.0,
+                        freq=1400.0, inner=0.001, batch_size=64,
+                        seed=0, numsteps=1500, n_iter=60,
+                        eta_window=(0.2, 5.0), resume=True,
+                        heartbeat=None, report=True, retries=1):
+    """The closed generate → search → fit loop as a journaled survey
+    (module docstring). Returns the :func:`run_survey_batched` result
+    extended with ``"recovery"``: per-regime median relative errors
+    of η / τ_d / Δν_d against the closed-form truths, over healthy
+    lanes.
+
+    Every per-epoch result dict carries the recovered AND true
+    parameter values plus the lane health code, so the journal (and
+    therefore resume, the RunReport, and any downstream reader) is a
+    self-contained record of the recovery experiment."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    from ..fit.batch import scint_params_batch
+    from ..ops.fitarc import fit_arc, fit_arc_batch
+    from ..ops.sspec import sspec_axes
+    from ..robust import run_survey_batched
+    from ..robust.ladder import TIER_NUMPY
+    from .factory import lane_keys_from_seeds, simulate_scenarios
+    from .simulation import Simulation
+
+    nt = ns                                   # factory: (ns time, nf)
+    df = freq * dlam / (nf - 1)
+    fdop, tdel, _ = sspec_axes(nf, nt, dt, df)
+    sspec_db = make_sspec_db_batch(nt, nf)
+    epochs = _lane_table(regimes, epochs_per_regime, seed)
+
+    def _truths(p):
+        t = scenario_truths(p["mb2"], p["ar"], p["psi"], p["alpha"],
+                            rf=rf, ds=ds, dt=dt, freq=freq, dlam=dlam)
+        return {k: float(v) for k, v in t.items()}
+
+    def _result(p, eta, etaerr, fits, i, code):
+        t = _truths(p)
+        return {
+            "ok": int(code), "regime": p["regime"],
+            "eta": float(eta), "etaerr": float(etaerr),
+            "tau": float(fits["tau"][i]),
+            "tauerr": float(fits["tauerr"][i]),
+            "dnu": float(fits["dnu"][i]),
+            "dnuerr": float(fits["dnuerr"][i]),
+            "eta_true": t["eta"], "tau_true": t["tau"],
+            "dnu_true": t["dnu"],
+        }
+
+    def _fit_stack(dyns_dev, payloads):
+        """Search + fit a device-resident epoch stack (B, nf, nt):
+        batched sspec → arc fit, batched acf1d LM."""
+        sec_db = sspec_db(dyns_dev)
+        truths = [_truths(p) for p in payloads]
+        etas_t = np.array([t["eta"] for t in truths])
+        arcs = fit_arc_batch(
+            np.asarray(sec_db), tdel, fdop, numsteps=numsteps,
+            etamin=eta_window[0] * etas_t,
+            etamax=eta_window[1] * etas_t,
+            sspecs_device=sec_db, full_output=False)
+        fits = scint_params_batch(dyns_dev, dt, df, n_iter=n_iter)
+        return arcs, fits
+
+    def process_batch(payloads, tier=None):
+        B = len(payloads)
+        keys = lane_keys_from_seeds([p["seed"] for p in payloads])
+        dyn, code = simulate_scenarios(
+            B, mb2=[p["mb2"] for p in payloads],
+            ar=[p["ar"] for p in payloads],
+            psi=[p["psi"] for p in payloads],
+            alpha=[p["alpha"] for p in payloads],
+            ns=ns, nf=nf, dlam=dlam, rf=rf, ds=ds, inner=inner,
+            keys=keys, with_ok=True, device_out=True)
+        dyns = jnp.transpose(dyn, (0, 2, 1))          # (B, nf, nt)
+        arcs, fits = _fit_stack(dyns, payloads)
+        code = np.asarray(code)
+        out = []
+        for i, p in enumerate(payloads):
+            eta = getattr(arcs[i], "eta", np.nan)
+            err = getattr(arcs[i], "etaerr", np.nan)
+            lane = int(code[i])
+            if lane == 0 and not (np.isfinite(eta)
+                                  and np.isfinite(fits["tau"][i])
+                                  and np.isfinite(fits["dnu"][i])):
+                lane = 8                    # fit refused (guards.BAD_FIT)
+            out.append(_result(p, eta, err, fits, i, lane))
+        return out
+
+    def _params_ok(p):
+        vals = (p["mb2"], p["ar"], p["psi"], p["alpha"])
+        return (all(np.isfinite(v) for v in vals) and p["mb2"] > 0
+                and p["ar"] > 0 and 0 < p["alpha"] < 2)
+
+    def process(p, tier=None):
+        """Per-epoch fallback tiers: STAGED = single-lane factory on
+        the exact oracle path + jax fits; NUMPY = the reference
+        ``Simulation`` + host scipy arc fit. Invalid lane params are
+        the sim-side malformed input — no tier can fix them, so the
+        ladder aborts straight to quarantine."""
+        from ..io import MalformedInputError
+
+        if not _params_ok(p):
+            raise MalformedInputError(
+                f"<lane seed={p['seed']}>",
+                "invalid regime params (non-finite or out of range)")
+        if tier == TIER_NUMPY:
+            sim = Simulation(ns=ns, nf=nf, dlam=dlam, seed=p["seed"],
+                             mb2=p["mb2"], ar=p["ar"], psi=p["psi"],
+                             alpha=p["alpha"], rf=rf, ds=ds,
+                             inner=inner, dt=dt, freq=freq,
+                             backend="numpy")
+            dyn1 = np.asarray(sim.dyn, dtype=float)[None]
+            from ..ops.sspec import secondary_spectrum
+
+            _, _, sec = secondary_spectrum(dyn1[0], dt, df,
+                                           backend="numpy")
+            t = _truths(p)
+            arc = fit_arc(np.asarray(sec), tdel, fdop,
+                          numsteps=numsteps,
+                          etamin=eta_window[0] * t["eta"],
+                          etamax=eta_window[1] * t["eta"],
+                          backend="numpy")[0]
+            fits = scint_params_batch(dyn1, dt, df, n_iter=n_iter,
+                                      backend="numpy")
+            return _result(p, arc.eta, arc.etaerr, fits, 0, 0)
+        # staged oracle tier: exact-exp column propagation, highest
+        # precision, single lane
+        keys = lane_keys_from_seeds([p["seed"]])
+        dyn, code = simulate_scenarios(
+            1, mb2=p["mb2"], ar=p["ar"], psi=p["psi"],
+            alpha=p["alpha"], ns=ns, nf=nf, dlam=dlam, rf=rf, ds=ds,
+            inner=inner, keys=keys, precision="highest",
+            with_ok=True, device_out=True)
+        lane = int(np.asarray(code)[0])
+        if lane != 0:
+            # a flagged staged lane is a FAILED attempt, not a result
+            # — raise so the ladder descends to the numpy tier
+            raise ValueError(f"staged lane unhealthy (code {lane})")
+        dyns = jnp.transpose(dyn, (0, 2, 1)).astype(jnp.float32)
+        arcs, fits = _fit_stack(dyns, [p])
+        return _result(p, getattr(arcs[0], "eta", np.nan),
+                       getattr(arcs[0], "etaerr", np.nan), fits, 0,
+                       lane)
+
+    with slog.span("sim.scenario_survey", n_epochs=len(epochs),
+                   n_regimes=len(regimes), ns=ns, nf=nf,
+                   batch_size=batch_size):
+        out = run_survey_batched(
+            epochs, process_batch, workdir, process=process,
+            batch_size=batch_size, retries=retries, resume=resume,
+            heartbeat=heartbeat, report=report)
+    out["recovery"] = recovery_summary(out["results"])
+    slog.log_event("sim.scenario_summary",
+                   n_epochs=len(epochs),
+                   recovery={r: {k: round(v, 4) for k, v in d.items()}
+                             for r, d in out["recovery"].items()})
+    return out
+
+
+def recovery_summary(results):
+    """Per-regime median relative recovery errors (and lane counts)
+    over the healthy lanes of a scenario-survey result map."""
+    by_regime = {}
+    for rec in results.values():
+        if not isinstance(rec, dict) or "eta_true" not in rec:
+            continue
+        by_regime.setdefault(rec.get("regime", "?"), []).append(rec)
+    out = {}
+    for regime, recs in sorted(by_regime.items()):
+        rel = {"eta": [], "tau": [], "dnu": []}
+        n_ok = 0
+        for r in recs:
+            if int(r.get("ok", 1)) != 0:
+                continue
+            n_ok += 1
+            for k in rel:
+                truth = r[f"{k}_true"]
+                if np.isfinite(r[k]) and truth:
+                    rel[k].append(abs(r[k] - truth) / abs(truth))
+        out[regime] = {
+            "n": len(recs), "n_ok": n_ok,
+            **{f"{k}_med_rel": float(np.median(v)) if v else np.nan
+               for k, v in rel.items()},
+        }
+    return out
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("sim.scenario_sspec")
+def _probe_scenario_sspec():
+    """The cached batched sspec-dB program (search-stage front half)
+    at a fixed 16x16 epoch geometry, 2 lanes."""
+    import jax
+
+    fn = make_sspec_db_batch(16, 16)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16, 16), np.float32),)

@@ -25,6 +25,8 @@ Design notes (vs the reference):
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 from numpy import random as nprandom
 from scipy.special import gamma as _gamma
@@ -52,38 +54,57 @@ def _swdsp(kx, ky, psi, ar, alpha, inner, consp):
     return out
 
 
-def screen_weights(nx, ny, dx, dy, psi, ar, alpha, inner, consp):
-    """Spectral weight array ``w[nx, ny]`` with the reference's exact
-    hermitian fill (scint_sim.py:175-198), vectorised."""
+def hermitian_fill(nx, ny, dqx, dqy, swdsp):
+    """The reference's exact hermitian fill pattern
+    (scint_sim.py:175-198), vectorised, with the spectral function
+    abstracted out: ``swdsp(kx, ky)`` is evaluated on the reference's
+    wavenumber arguments and its VALUES are mirrored into the
+    conjugate cells (value copies, so the reference's one-off mirror
+    indexing quirks are reproduced bit-for-bit).
+
+    Because only values are copied, calling this with extractor
+    functions (``lambda kx, ky: kx + 0 * ky``) recovers the EFFECTIVE
+    per-cell wavenumber grids — which is how the batched factory
+    (sim/factory.py:effective_wavenumbers) rebuilds the same w from
+    traced per-lane spectral parameters."""
     nx2 = int(nx / 2 + 1)
     ny2 = int(ny / 2 + 1)
     w = np.zeros([nx, ny])
-    dqx = 2 * np.pi / (dx * nx)
-    dqy = 2 * np.pi / (dy * ny)
-
-    def swdsp(kx, ky):
-        return _swdsp(kx, ky, psi, ar, alpha, inner, consp)
 
     # ky=0 line
     k = np.arange(2, nx2 + 1)
-    w[k - 1, 0] = swdsp((k - 1) * dqx, 0)
+    w[k - 1, 0] = swdsp((k - 1) * dqx, np.zeros(len(k)))
     w[nx + 1 - k, 0] = w[k, 0]
     # kx=0 line
     ll = np.arange(2, ny2 + 1)
-    w[0, ll - 1] = swdsp(0, (ll - 1) * dqy)
+    w[0, ll - 1] = swdsp(np.zeros(len(ll)), (ll - 1) * dqy)
     w[0, ny + 1 - ll] = w[0, ll - 1]
     # rest of the field (vectorised over the reference's il loop)
     kp = np.arange(2, nx2 + 1)
     k = np.arange(nx2 + 1, nx + 1)
     km = -(nx - k + 1)
     il = np.arange(2, ny2 + 1)
-    w[np.ix_(kp - 1, il - 1)] = swdsp(((kp - 1) * dqx)[:, None],
-                                      ((il - 1) * dqy)[None, :])
-    w[np.ix_(k - 1, il - 1)] = swdsp((km * dqx)[:, None],
-                                     ((il - 1) * dqy)[None, :])
+    w[np.ix_(kp - 1, il - 1)] = swdsp(((kp - 1) * dqx)[:, None]
+                                      + 0 * il[None, :],
+                                      ((il - 1) * dqy)[None, :]
+                                      + 0 * kp[:, None])
+    w[np.ix_(k - 1, il - 1)] = swdsp((km * dqx)[:, None]
+                                     + 0 * il[None, :],
+                                     ((il - 1) * dqy)[None, :]
+                                     + 0 * km[:, None])
     w[np.ix_(nx + 1 - kp, ny + 1 - il)] = w[np.ix_(kp - 1, il - 1)]
     w[np.ix_(nx + 1 - k, ny + 1 - il)] = w[np.ix_(k - 1, il - 1)]
     return w
+
+
+def screen_weights(nx, ny, dx, dy, psi, ar, alpha, inner, consp):
+    """Spectral weight array ``w[nx, ny]`` with the reference's exact
+    hermitian fill (scint_sim.py:175-198), vectorised."""
+    dqx = 2 * np.pi / (dx * nx)
+    dqy = 2 * np.pi / (dy * ny)
+    return hermitian_fill(
+        nx, ny, dqx, dqy,
+        lambda kx, ky: _swdsp(kx, ky, psi, ar, alpha, inner, consp))
 
 
 def fresnel_filter_q2(nx, ny, ffconx, ffcony):
@@ -288,22 +309,36 @@ class Simulation:
 
     def get_screen(self):
         """Phase screen φ(x,y) = Re fft2(w·(N + iN))
-        (scint_sim.py:169-207)."""
+        (scint_sim.py:169-207).
+
+        Reproducibility contract: an explicit integer ``seed`` (≥ 0)
+        is deterministic on both backends — same seed, same screen,
+        run to run. ``seed=None`` (and the reference's ``-1``
+        sentinel) draws FRESH entropy at this driver level on every
+        call — two unseeded simulations differ. (Before ISSUE 10 the
+        jax path silently mapped None/-1 to ``PRNGKey(0)``, so every
+        "unseeded" simulation was the same deterministic screen; the
+        numpy path already drew fresh entropy via
+        ``np.random.seed(None)``.) The seed actually used is recorded
+        as ``self.seed_used`` so an interesting unseeded run can be
+        reproduced afterwards."""
         w = screen_weights(self.nx, self.ny, self.dx, self.dy, self.psi,
                            self.ar, self.alpha, self.inner, self.consp)
         self.w = w
+        self.seed_used = (int.from_bytes(os.urandom(4), "little")
+                          & 0x7FFFFFFF) \
+            if self.seed in (None, -1) else int(self.seed)
         if self.backend == "jax":
             jax = get_jax()
             import jax.numpy as jnp
-            key = jax.random.PRNGKey(0 if self.seed in (None, -1)
-                                     else int(self.seed))
+            key = jax.random.PRNGKey(self.seed_used)
             # one jitted program, real in / real out (complex buffers
             # cannot cross program boundaries on the tunneled TPU);
             # real buffers can, so keep the device copy for propagate
             self._xyp_dev = _jax_screen_program()(jnp.asarray(w), key)
             xyp = np.asarray(self._xyp_dev)
         else:
-            nprandom.seed(self.seed)
+            nprandom.seed(self.seed_used)
             xyp = np.real(np.fft.fft2(
                 w * (nprandom.randn(self.nx, self.ny)
                      + 1j * nprandom.randn(self.nx, self.ny))))
@@ -405,84 +440,39 @@ class Simulation:
         return plot_sim_all(self, **kwargs)
 
 
-_BATCH_SIM_CACHE = {}
-
-
 def make_dynspec_batch_fn(mb2=2, rf=1, ds=0.01, alpha=5 / 3,
                           ar=1, psi=0, inner=0.001, ns=128, nf=128,
                           dlam=0.25):
-    """Build (and memoise) the jitted batched simulator
-    ``fn(keys[B]) → dynspecs[B, ns, nf]``. Memoisation matters:
-    re-jitting a fresh closure per call would retrace + recompile the
-    whole Fresnel loop on every invocation."""
-    cache_key = (mb2, rf, ds, alpha, ar, psi, inner, ns, nf, dlam)
-    if cache_key in _BATCH_SIM_CACHE:
-        return _BATCH_SIM_CACHE[cache_key]
-    jax = get_jax()
-    import jax.numpy as jnp
+    """Batched simulator ``fn(keys[B]) → dynspecs[B, ns, nf]`` — an
+    API-continuity wrapper over the device-native scenario factory
+    (sim/factory.py, ISSUE 10): the fixed scalar parameters ride the
+    batch axis as traced per-lane inputs, so every parameter set
+    shares ONE compiled program per geometry (``sim.factory`` retrace
+    site) instead of one per parameter tuple, and screens default to
+    the ``'compensated'`` low-frequency formulation."""
+    from .factory import simulate_scenarios
 
-    from ..obs import retrace as _retrace
+    def fn(keys):
+        return simulate_scenarios(
+            int(np.shape(keys)[0]), mb2=mb2, ar=ar, psi=psi,
+            alpha=alpha, ns=ns, nf=nf, dlam=dlam, rf=rf, ds=ds,
+            inner=inner, keys=keys, device_out=True)
 
-    _retrace.record_build("sim.dynspec_batch", cache_key)
-
-    sim = Simulation.__new__(Simulation)
-    sim.mb2, sim.rf, sim.ds = mb2, rf, ds
-    sim.dx = sim.dy = ds
-    sim.alpha, sim.ar, sim.psi, sim.inner = alpha, ar, psi, inner
-    sim.nx = sim.ny = ns
-    sim.nf, sim.dlam, sim.lamsteps = nf, dlam, False
-    sim.set_constants()
-    w = jnp.asarray(screen_weights(ns, ns, ds, ds, psi, ar, alpha, inner,
-                                   sim.consp))
-    q2 = jnp.asarray(fresnel_filter_q2(ns, ns, sim.ffconx, sim.ffcony))
-    scales = jnp.asarray(
-        1.0 / (1.0 + dlam * (-0.5 + np.arange(nf) / nf)))
-    column = int(np.floor(ns / 2))
-
-    def screens(keys):
-        k1, k2 = jax.vmap(jax.random.split, out_axes=1)(keys)
-        noise = (jax.vmap(jax.random.normal, in_axes=(0, None))(
-                     k1, (ns, ns))
-                 + 1j * jax.vmap(jax.random.normal, in_axes=(0, None))(
-                     k2, (ns, ns)))
-        return jnp.real(jnp.fft.fft2(w[None] * noise))
-
-    def one_freq(xyp, scale):
-        xye = jnp.fft.ifft2(
-            jnp.fft.fft2(jnp.exp(1j * xyp * scale))
-            * jnp.exp(-1j * q2 * scale)[None])
-        return xye[:, :, column]
-
-    def propagate_batch(xyp):
-        # screens stay the (large, MXU-friendly) batch axis; the
-        # frequency loop is a sequential lax.map — vmapping both axes
-        # materialises (nscreens, nf, ns, ns) FFT temporaries (several
-        # multi-GB complex64 buffers at config-#4 sizes; observed 24 GB
-        # total on a 16 GB chip) and OOMs HBM
-        spe = jax.lax.map(lambda s: one_freq(xyp, s), scales)
-        return jnp.transpose(spe, (1, 2, 0))      # (B, ns, nf)
-
-    def run(keys):
-        spe = propagate_batch(screens(keys))
-        return jnp.real(spe * jnp.conj(spe))
-
-    fn = jax.jit(run)
-    _BATCH_SIM_CACHE[cache_key] = fn
     return fn
 
 
 def simulate_dynspec_batch(nscreens, mb2=2, rf=1, ds=0.01, alpha=5 / 3,
                            ar=1, psi=0, inner=0.001, ns=128, nf=128,
                            dlam=0.25, seed=0):
-    """Batched screens → dynspecs, fully vmapped on the jax backend
-    (BASELINE config #4): one jit, batch dimension over seeds."""
-    jax = get_jax()
+    """Batched screens → dynspecs on the jax backend (BASELINE config
+    #4): one geometry-keyed program, batch dimension over on-device
+    key splits of ``PRNGKey(seed)`` (sim/factory.py)."""
+    from .factory import simulate_scenarios
 
-    fn = make_dynspec_batch_fn(mb2=mb2, rf=rf, ds=ds, alpha=alpha,
-                               ar=ar, psi=psi, inner=inner, ns=ns,
-                               nf=nf, dlam=dlam)
-    keys = jax.random.split(jax.random.PRNGKey(seed), nscreens)
-    return fn(keys)
+    return simulate_scenarios(
+        nscreens, mb2=mb2, ar=ar, psi=psi, alpha=alpha, ns=ns, nf=nf,
+        dlam=dlam, rf=rf, ds=ds, inner=inner, seed=seed,
+        device_out=True)
 
 
 # ---------------------------------------------------------------------
@@ -517,12 +507,5 @@ def _probe_sim_propagate():
         S((4,), np.float32))
 
 
-@_register_probe("sim.dynspec_batch")
-def _probe_sim_dynspec_batch():
-    """The memoised batched simulator (screens → Fresnel → dynspec)
-    at a fixed 8x8 screen, 2 frequencies, 2 seeds."""
-    import jax
-
-    fn = make_dynspec_batch_fn(ns=8, nf=2)
-    S = jax.ShapeDtypeStruct
-    return fn, (S((2, 2), np.uint32),)
+# (the former ``sim.dynspec_batch`` site/probe is gone: the batch
+# path is the ``sim.factory`` program now — probed in sim/factory.py)

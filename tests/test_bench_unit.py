@@ -320,7 +320,9 @@ class TestBudgetFallback:
         assert d["platform"] == "cpu"
         assert d["probe"]["attempts"][0]["ok"] is False
         # every config is present and explicitly marked skipped
-        assert len(d["configs"]) == 14
+        # ISSUE 10: +sim_factory +scenario_loop (sim_batch kept as the
+        # legacy-entry continuity measurement)
+        assert len(d["configs"]) == 16
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9
