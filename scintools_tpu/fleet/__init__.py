@@ -1,0 +1,42 @@
+"""Distributed survey scheduler: work queue, workers, merge, pod.
+
+Everything below this package scales ONE process; the fleet tier is
+how a survey keeps N accelerators busy (ROADMAP item 1 — the
+telescope-survey throughput model of the real-time GPU pulsar
+pipelines, Dimoudi et al. arXiv:1711.10855, Adámek et al.
+arXiv:1804.05335): an epoch-sharded work queue that coordinates
+worker processes through nothing but atomic filesystem operations —
+no collectives, no coordinator service — so any worker's death is
+survivable and any host sharing the queue directory can join.
+
+- :mod:`.queue` — filesystem work queue: claim-by-rename (atomic,
+  race-safe), heartbeat-stamped leases, work-stealing of expired
+  leases, clock-skew-tolerant expiry;
+- :mod:`.worker` — the worker loop wrapping the unchanged
+  ``robust/runner.py`` engine (same ladder/quarantine/journal/resume
+  semantics), one per-worker journal, lease + file heartbeats;
+- :mod:`.merge` — deterministic merge of per-worker CRC-JSONL
+  journals into one canonical survey journal (epoch total order,
+  duplicate-claim resolution first-committed-wins, byte-reproducible
+  regardless of which worker ran which epoch);
+- :mod:`.pod` — the coordinator: seeds the queue, launches/monitors
+  local worker processes, aggregates heartbeats + metrics into
+  pod-level gauges, merges, and emits one merged RunReport.
+
+The proving workload is the closed-loop scenario survey
+(``sim/scenario.py:run_scenario_fleet``). Operator docs:
+docs/fleet.md.
+"""
+
+from .merge import ATTRIBUTION_FIELDS, merge_journals, merge_records
+from .pod import Pod, run_pod
+from .queue import Task, WorkQueue, claim_by_rename
+from .worker import (FleetWorker, demo_workload, resolve_workload,
+                     run_worker)
+
+__all__ = [
+    "ATTRIBUTION_FIELDS", "merge_journals", "merge_records",
+    "Pod", "run_pod",
+    "Task", "WorkQueue", "claim_by_rename",
+    "FleetWorker", "demo_workload", "resolve_workload", "run_worker",
+]

@@ -1,0 +1,141 @@
+"""Deterministic merge of per-worker CRC-JSONL journals.
+
+A fleet run leaves one epoch journal per worker
+(``robust/runner.py`` journals, with the worker-attribution columns
+``worker``/``t_commit`` appended by ``journal_extra``). The merge
+turns them into ONE canonical survey journal with a hard contract
+(pinned by tests/test_fleet.py and documented in docs/fleet.md):
+
+- **epoch-id total order** — output lines follow the survey's own
+  epoch order (the ``order`` argument; ids the caller didn't list
+  sort lexicographically at the end), never the arrival order of
+  work across workers, so the merged journal is independent of which
+  worker ran which epoch and of scheduling/stealing history;
+- **duplicate-claim resolution, first-committed-wins** — a stolen
+  task can leave the same epoch journaled by two workers (the dead
+  holder's fsynced lines survive, the stealer re-ran the whole
+  task). The record with the earliest ``t_commit`` stamp wins; ties
+  break on worker id, then journal order — a total order, so the
+  winner is deterministic. Epoch results are deterministic by
+  construction (factory lanes are keyed by epoch seed, independent
+  of batch grouping), so losers are byte-duplicates after stripping
+  attribution; a post-strip difference is counted as a ``conflict``
+  and surfaced (it means the workload broke determinism);
+- **torn-tail tolerance** — input journals are read through
+  :meth:`EpochJournal.iter_records`, which CRC-skips the torn tail a
+  SIGKILLed worker leaves;
+- **byte-reproducibility** — output lines are re-serialised through
+  the one line formatter (:meth:`EpochJournal.format_line`) with the
+  attribution fields stripped; because ``journal_extra`` appends
+  those fields at the END of each record, stripping restores the
+  exact field order a single-process run writes — so the merged
+  journal of an N-worker (or killed-and-stolen) run is byte-identical
+  to an uninterrupted single-process run's journal.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..obs import metrics as _metrics
+from ..parallel.checkpoint import EpochJournal, atomic_write_bytes
+from ..utils import slog
+
+#: the worker-attribution columns stripped from merged lines — the
+#: documented "modulo" of the byte-identity contract (docs/fleet.md).
+ATTRIBUTION_FIELDS = ("worker", "t_commit")
+
+
+def _commit_key(rec, path_index, line_index):
+    """First-committed-wins total order: commit stamp, then worker
+    id, then (journal, line) position for records without stamps."""
+    try:
+        t = float(rec.get("t_commit"))
+    except (TypeError, ValueError):
+        t = float("inf")
+    return (t, str(rec.get("worker", "")), path_index, line_index)
+
+
+def merge_records(journal_paths, order=None,
+                  strip=ATTRIBUTION_FIELDS):
+    """Merge per-worker journals into ``(lines, stats)`` without
+    touching disk: ``lines`` are the canonical merged journal lines
+    (sans newline) in epoch total order, ``stats`` counts what the
+    merge saw. See the module docstring for the contract."""
+    candidates = {}                     # epoch -> (commit_key, rec)
+    duplicates = 0
+    conflicts = 0
+    n_read = 0
+    for pi, path in enumerate(sorted(os.fspath(p)
+                                     for p in journal_paths)):
+        for li, rec in enumerate(EpochJournal(path).iter_records()):
+            n_read += 1
+            key = str(rec.get("epoch"))
+            ck = _commit_key(rec, pi, li)
+            held = candidates.get(key)
+            if held is None:
+                candidates[key] = (ck, rec)
+                continue
+            duplicates += 1
+            first, second = ((held[1], rec) if held[0] <= ck
+                             else (rec, held[1]))
+            if _stripped(first, strip) != _stripped(second, strip):
+                conflicts += 1
+                slog.log_failure(
+                    "fleet.merge_conflict", epoch=key, stage="merge",
+                    error=ValueError(
+                        "duplicate records differ after stripping "
+                        "attribution — workload is not deterministic"),
+                    winner=str(first.get("worker", "")),
+                    loser=str(second.get("worker", "")))
+            if ck < held[0]:
+                candidates[key] = (ck, rec)
+    ordered_keys = _total_order(candidates, order)
+    lines = []
+    for key in ordered_keys:
+        rec = _stripped(candidates[key][1], strip)
+        epoch = rec.pop("epoch")
+        lines.append(EpochJournal.format_line(epoch, **rec))
+    stats = {"epochs": len(lines), "records_read": n_read,
+             "duplicates": duplicates, "conflicts": conflicts,
+             "sources": len(list(journal_paths))}
+    return lines, stats
+
+
+def _stripped(rec, strip):
+    return {k: v for k, v in rec.items() if k not in strip}
+
+
+def _total_order(candidates, order):
+    """Canonical epoch order: the caller's survey order first (ids
+    not present in the journals are simply absent — an incomplete
+    run merges deterministically too), then any journaled ids the
+    caller didn't list, sorted."""
+    keys = []
+    seen = set()
+    for key in (order or ()):
+        key = str(key)
+        if key in candidates and key not in seen:
+            keys.append(key)
+            seen.add(key)
+    keys.extend(sorted(k for k in candidates if k not in seen))
+    return keys
+
+
+def merge_journals(journal_paths, out_path, order=None,
+                   strip=ATTRIBUTION_FIELDS):
+    """Merge per-worker journals into the canonical survey journal at
+    ``out_path`` (written atomically: temp + rename, so a reader —
+    or a re-merge after a crash — never sees a torn merge). Returns
+    the merge stats dict; the merged file re-verifies line-for-line
+    through the normal :class:`EpochJournal` reader."""
+    lines, stats = merge_records(journal_paths, order=order,
+                                 strip=strip)
+    data = ("\n".join(lines) + "\n") if lines else ""
+    atomic_write_bytes(os.fspath(out_path), data.encode())
+    _metrics.counter(
+        "fleet_merge_epochs_total",
+        help="epochs written to merged fleet journals").inc(
+            stats["epochs"])
+    slog.log_event("fleet.merge", out=os.fspath(out_path), **stats)
+    return stats

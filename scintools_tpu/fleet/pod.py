@@ -1,0 +1,419 @@
+"""Pod coordinator: launch, monitor, merge — one survey, N workers.
+
+The pod is the fleet's single-controller view: it owns the shared
+queue directory, seeds it with epoch-batch tasks, launches N worker
+processes (fleet/worker.py), watches their heartbeat files and exit
+codes, aggregates their metrics into pod-level gauges through
+``obs/``, and — once the queue drains — merges the per-worker
+journals into the canonical survey journal (fleet/merge.py) and one
+merged RunReport.
+
+Failure model (docs/fleet.md):
+
+- a worker SIGKILLed mid-task stops heartbeating; its lease expires
+  and a surviving worker STEALS the task — the pod just counts the
+  death (``fleet.worker_dead``, ``fleet_workers_dead_total``) and
+  keeps watching;
+- if EVERY worker dies with work outstanding, the pod spawns recovery
+  workers (up to ``max_recoveries``) — losing the whole fleet must
+  not strand a half-finished survey when one fresh process can drain
+  the queue from the journals;
+- the merged journal is byte-identical to an uninterrupted
+  single-worker run's (modulo the stripped attribution columns) no
+  matter how many workers ran, died, or stole — the merge contract
+  (fleet/merge.py) plus deterministic per-epoch results make
+  scheduling history unobservable in the output.
+
+Worker processes are plain subprocesses coordinating through the
+queue directory — nothing here uses jax collectives, so the same pod
+runs N processes on one host or (with the queue on a shared
+filesystem) one process per host. ``mode="thread"`` runs the workers
+as in-process threads instead (tests; claim/steal race coverage
+without process spawn cost).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+from ..obs import heartbeat as _hb
+from ..obs import metrics as _metrics
+from ..obs import report as _report
+from ..parallel.checkpoint import atomic_write_json
+from ..robust.runner import EpochOutcome
+from ..utils import slog
+from .merge import merge_journals
+from .queue import WorkQueue
+from .worker import resolve_workload, run_worker
+
+#: repo root (the directory holding the ``scintools_tpu`` package) —
+#: prepended to the worker subprocess PYTHONPATH so spawn works from
+#: any caller cwd.
+_PKG_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+class _ProcessWorker:
+    """Handle on one spawned worker subprocess."""
+
+    def __init__(self, worker_id, cmd, env, log_path):
+        self.worker_id = worker_id
+        self._log = open(log_path, "ab")
+        self.proc = subprocess.Popen(cmd, env=env, stdout=self._log,
+                                     stderr=subprocess.STDOUT)
+        self.pid = self.proc.pid
+
+    def alive(self):
+        return self.proc.poll() is None
+
+    def returncode(self):
+        return self.proc.poll()
+
+    def kill(self):
+        if self.alive():
+            self.proc.kill()
+        self.close()
+
+    def close(self):
+        try:
+            self._log.close()
+        except OSError:
+            pass
+
+
+class _ThreadWorker:
+    """Handle on one in-process worker thread (test mode)."""
+
+    def __init__(self, worker_id, fn):
+        import threading
+
+        self.worker_id = worker_id
+        self.pid = None
+        self.error = None
+
+        def _run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — surfaced by the
+                # pod as a dead worker; a thread must not kill the pod
+                self.error = e
+                slog.log_failure("fleet.worker_error",
+                                 stage="thread", error=e,
+                                 epoch=worker_id)
+
+        self.thread = threading.Thread(target=_run, daemon=True,
+                                       name=f"fleet-{worker_id}")
+        self.thread.start()
+
+    def alive(self):
+        return self.thread.is_alive()
+
+    def returncode(self):
+        if self.thread.is_alive():
+            return None
+        return 1 if self.error is not None else 0
+
+    def kill(self):                     # threads can't be killed —
+        pass                            # process mode covers SIGKILL
+
+    def close(self):
+        pass
+
+
+class Pod:
+    """Coordinator for one fleet survey run. ``start()`` seeds the
+    queue and spawns the workers; ``wait()`` monitors to completion,
+    merges, and returns the result dict (see :func:`run_pod`)."""
+
+    def __init__(self, workdir, workload, epochs=None, n_workers=3,
+                 batch_size=32, lease_s=15.0, skew_s=2.0,
+                 poll_s=0.25, monitor_s=0.2, mode="process",
+                 worker_env=None, worker_options=None,
+                 max_recoveries=2, journal_name="journal.merged.jsonl"):
+        self.workdir = os.fspath(workdir)
+        self.workload_spec = workload
+        self.n_workers = int(n_workers)
+        self.batch_size = max(1, int(batch_size))
+        self.lease_s = float(lease_s)
+        self.skew_s = float(skew_s)
+        self.poll_s = float(poll_s)
+        self.monitor_s = float(monitor_s)
+        self.mode = mode
+        self.worker_env = dict(worker_env or {})
+        self.worker_options = dict(worker_options or {})
+        self.max_recoveries = int(max_recoveries)
+        self.journal_name = journal_name
+
+        self.queue_root = os.path.join(self.workdir, "queue")
+        self.out_root = self.workdir
+        os.makedirs(self.workdir, exist_ok=True)
+        if epochs is None:
+            # resolving builds the epoch table (cheap — no device
+            # program runs until a worker processes a task)
+            epochs = resolve_workload(workload).get("epochs")
+            if epochs is None:
+                raise ValueError(
+                    "workload resolves to no epoch list — pass "
+                    "epochs= explicitly")
+        self.epochs = [(str(e), p) for e, p in epochs]
+        self.order = [e for e, _ in self.epochs]
+        self.workers = []
+        self._dead = set()
+        self._recoveries = 0
+        self._t0 = None
+        self._queue = WorkQueue(self.queue_root, worker="pod",
+                                lease_s=self.lease_s,
+                                skew_s=self.skew_s)
+
+    # ---- lifecycle --------------------------------------------------
+    def tasks(self):
+        """The epoch batches: ``("t<index>", epochs[i:i+batch])`` —
+        task granularity = one batched device dispatch."""
+        return [(f"t{i // self.batch_size:06d}",
+                 self.epochs[i:i + self.batch_size])
+                for i in range(0, len(self.epochs), self.batch_size)]
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        tasks = self.tasks()
+        seeded = self._queue.seed(tasks)
+        slog.log_event("fleet.pod_start", workdir=self.workdir,
+                       n_workers=self.n_workers, n_tasks=len(tasks),
+                       seeded=seeded, n_epochs=len(self.epochs),
+                       mode=self.mode)
+        spec = {"workload": self.workload_spec,
+                "options": {"lease_s": self.lease_s,
+                            "skew_s": self.skew_s,
+                            "poll_s": self.poll_s,
+                            **self.worker_options}}
+        self._spec_path = os.path.join(self.workdir,
+                                       "worker_spec.json")
+        atomic_write_json(self._spec_path, spec)
+        for i in range(self.n_workers):
+            self.workers.append(self._spawn(f"w{i}"))
+        return self
+
+    def _spawn(self, worker_id):
+        if self.mode == "thread":
+            spec = {"workload": self.workload_spec,
+                    "options": {"lease_s": self.lease_s,
+                                "skew_s": self.skew_s,
+                                "poll_s": self.poll_s,
+                                **self.worker_options}}
+            return _ThreadWorker(
+                worker_id,
+                lambda: run_worker(self.queue_root, self.out_root,
+                                   spec["workload"],
+                                   worker_id=worker_id,
+                                   **spec["options"]))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _PKG_ROOT + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        env.update(self.worker_env)
+        cmd = [sys.executable, "-m", "scintools_tpu.fleet.worker",
+               "--queue", self.queue_root, "--out", self.out_root,
+               "--worker-id", worker_id, "--spec", self._spec_path]
+        log_path = os.path.join(self.workdir, "workers", worker_id)
+        os.makedirs(log_path, exist_ok=True)
+        return _ProcessWorker(worker_id, cmd, env,
+                              os.path.join(log_path, "worker.log"))
+
+    # ---- monitoring -------------------------------------------------
+    def heartbeats(self):
+        """``{worker_id: record}`` of the last complete heartbeat of
+        every worker that ever wrote one."""
+        hb_dir = os.path.join(self.out_root, "heartbeats")
+        out = {}
+        try:
+            names = sorted(os.listdir(hb_dir))
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            rec = _hb.read_heartbeat_file(os.path.join(hb_dir, name))
+            if rec is not None:
+                out[name[:-5]] = rec
+        return out
+
+    def poll(self):
+        """One monitor pass: pod-level gauges from the queue and the
+        heartbeat files, dead-worker detection, recovery spawn when
+        the whole fleet is gone with work outstanding. Returns the
+        queue counts."""
+        counts = self._queue.counts()
+        beats = self.heartbeats()
+        _metrics.gauge("fleet_queue_pending",
+                       help="tasks waiting in the fleet queue"
+                       ).set(counts["pending"])
+        _metrics.gauge("fleet_queue_claimed",
+                       help="tasks currently claimed by workers"
+                       ).set(counts["claimed"])
+        _metrics.gauge("fleet_queue_done",
+                       help="tasks completed on the fleet queue"
+                       ).set(counts["done"])
+        _metrics.gauge("fleet_workers_alive",
+                       help="fleet worker processes currently alive"
+                       ).set(sum(1 for w in self.workers
+                                 if w.alive()))
+        _metrics.gauge(
+            "fleet_pod_epochs_done",
+            help="epochs completed across the pod (heartbeat view)"
+        ).set(sum(int(b.get("epochs", 0)) for b in beats.values()))
+        for w in self.workers:
+            if w.alive() or w.worker_id in self._dead:
+                continue
+            beat = beats.get(w.worker_id) or {}
+            if w.returncode() == 0 and beat.get("phase") == "done":
+                continue                 # clean exit, not a death
+            self._dead.add(w.worker_id)
+            _metrics.counter("fleet_workers_dead_total",
+                             help="workers that died mid-run").inc()
+            slog.log_failure(
+                "fleet.worker_dead", stage="monitor",
+                error=f"exit code {w.returncode()}",
+                epoch=w.worker_id,
+                last_phase=beat.get("phase"),
+                heartbeat_age_s=round(_hb.heartbeat_age_s(beat), 3)
+                if beat else None)
+        if not any(w.alive() for w in self.workers) \
+                and not self._queue.drained():
+            if self._recoveries >= self.max_recoveries:
+                raise RuntimeError(
+                    "fleet stalled: all workers dead, queue not "
+                    f"drained after {self._recoveries} recovery "
+                    "workers")
+            self._recoveries += 1
+            wid = f"r{self._recoveries}"
+            slog.log_event("fleet.recovery_spawn", worker=wid,
+                           pending=counts["pending"],
+                           claimed=counts["claimed"])
+            self.workers.append(self._spawn(wid))
+        return counts
+
+    def wait(self, timeout=600.0):
+        """Monitor until the queue drains and every worker exits,
+        then merge and report. Raises :class:`TimeoutError` when the
+        run exceeds ``timeout`` (workers are killed first so the
+        caller does not leak processes)."""
+        deadline = time.monotonic() + float(timeout)
+        try:
+            while True:
+                counts = self.poll()
+                if counts["pending"] == 0 and counts["claimed"] == 0 \
+                        and not any(w.alive() for w in self.workers):
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet run exceeded {timeout}s "
+                        f"(queue counts {counts})")
+                time.sleep(self.monitor_s)
+        finally:
+            for w in self.workers:
+                w.kill() if time.monotonic() > deadline else w.close()
+        return self._finish()
+
+    # ---- merge + report ---------------------------------------------
+    def worker_journals(self):
+        root = os.path.join(self.out_root, "workers")
+        out = []
+        try:
+            ids = sorted(os.listdir(root))
+        except FileNotFoundError:
+            return out
+        for wid in ids:
+            p = os.path.join(root, wid, "journal.jsonl")
+            if os.path.exists(p):
+                out.append(p)
+        return out
+
+    def _finish(self):
+        wall_s = time.perf_counter() - self._t0
+        t0 = time.perf_counter()
+        merged_path = os.path.join(self.workdir, self.journal_name)
+        merge_stats = merge_journals(self.worker_journals(),
+                                     merged_path, order=self.order)
+        merge_s = time.perf_counter() - t0
+        from ..parallel.checkpoint import EpochJournal
+
+        records = EpochJournal(merged_path).records()
+        summary, outcomes, results = _pod_tally(self.order, records)
+        beats = self.heartbeats()
+        fleet = {
+            "n_workers": self.n_workers,
+            "n_tasks": len(self.tasks()),
+            "batch_size": self.batch_size,
+            "mode": self.mode,
+            "steals": sum(int(b.get("stolen", 0))
+                          for b in beats.values()),
+            "lease_lost": sum(int(b.get("lease_lost", 0))
+                              for b in beats.values()),
+            "dead_workers": sorted(self._dead),
+            "recoveries": self._recoveries,
+            "merge": {**merge_stats, "merge_s": round(merge_s, 4)},
+            "workers": {w: {k: b.get(k) for k in
+                            ("tasks", "stolen", "epochs", "n_ok",
+                             "n_quarantined", "lease_lost",
+                             "queue_op_s", "idle_wait_s", "busy_s",
+                             "phase")}
+                        for w, b in beats.items()},
+        }
+        worker_metrics = _metrics.aggregate_snapshots(
+            [b.get("metrics") for b in beats.values()])
+        report = _report.build_run_report(
+            summary, outcomes, wall_s=wall_s, runner="run_pod",
+            extra={"fleet": fleet, "worker_metrics": worker_metrics})
+        _report.validate_run_report(report)
+        _report.write_run_report(self.workdir, report)
+        slog.log_event("fleet.pod_summary",
+                       n_epochs=summary["n_epochs"],
+                       n_ok=summary["n_ok"],
+                       n_quarantined=summary["n_quarantined"],
+                       steals=fleet["steals"],
+                       dead_workers=fleet["dead_workers"],
+                       wall_s=round(wall_s, 3))
+        return {"results": results, "summary": summary,
+                "report": report, "fleet": fleet,
+                "journal": merged_path, "wall_s": wall_s}
+
+
+def _pod_tally(order, records):
+    """Rebuild the runner-shaped summary/outcomes/results views from
+    the MERGED journal (the pod's ground truth — heartbeat counters
+    are progress hints, the journal is the record)."""
+    summary = {"n_epochs": len(order), "n_ok": 0, "n_quarantined": 0,
+               "n_resumed": 0, "retries": 0, "tier_counts": {}}
+    outcomes, results = [], {}
+    for key in order:
+        rec = records.get(key)
+        if rec is None:
+            continue                    # incomplete run: not counted
+        status = rec.get("status", "ok")
+        out = EpochOutcome(
+            epoch=key, status=status, tier=rec.get("tier", ""),
+            retries=int(rec.get("retries", 0) or 0),
+            error=rec.get("error", ""),
+            error_class=rec.get("error_class", ""),
+            result=rec.get("result") or {})
+        summary["retries"] += out.retries
+        if status == "ok":
+            summary["n_ok"] += 1
+            summary["tier_counts"][out.tier] = \
+                summary["tier_counts"].get(out.tier, 0) + 1
+            results[key] = out.result
+        else:
+            summary["n_quarantined"] += 1
+        outcomes.append(out)
+    return summary, outcomes, results
+
+
+def run_pod(workdir, workload, timeout=600.0, **kw):
+    """One-call fleet survey: seed, spawn, monitor, merge, report.
+    Returns ``{"results", "summary", "report", "fleet", "journal",
+    "wall_s"}`` — ``summary``/``results`` are runner-shaped (rebuilt
+    from the merged journal), ``fleet`` carries the pod-level
+    worker/steal/merge tallies that also ride in the RunReport."""
+    return Pod(workdir, workload, **kw).start().wait(timeout=timeout)

@@ -1,0 +1,288 @@
+"""Fleet worker: the queue-driven loop around the survey engine.
+
+One worker process = the UNCHANGED per-epoch survey engine
+(``robust/runner.py:run_survey_batched`` — ladder fallback, lane
+quarantine, CRC journal, resume) fed by the shared work queue
+(fleet/queue.py) instead of an up-front epoch list. The worker
+
+- claims one task (an epoch batch sized to the batched device
+  programs) at a time, steals expired leases when the queue is empty,
+  and exits when the queue is drained;
+- journals every epoch to its OWN per-worker journal
+  (``<out>/workers/<id>/journal.jsonl``) with the worker-attribution
+  columns (``worker``, ``t_commit``) appended via the runner's
+  ``journal_extra`` hook — the merge (fleet/merge.py) strips them to
+  recover canonical line bytes;
+- heartbeats on two channels while it computes: the task's LEASE
+  (queue-visible — a stopped heartbeat makes the task stealable) and
+  its heartbeat FILE (``<out>/heartbeats/<id>.json``, pod-visible —
+  carries progress counters and a metrics snapshot the coordinator
+  aggregates). Both piggyback on the runner's per-epoch heartbeat
+  callback, time-gated so the cost is a comparison per epoch.
+
+The **workload** is what makes a worker process self-contained: a
+JSON-able spec ``{"target": "module:callable", "params": {...}}``
+resolved in the worker's own process by :func:`resolve_workload` —
+the callable returns ``{"epochs": [(id, payload), ...],
+"process_batch": fn, "process": fn, ...}`` (the scenario survey's
+factory is ``scintools_tpu.sim.scenario:scenario_workload``;
+:func:`demo_workload` here is the dependency-free toy used by the
+fleet plumbing tests). The pod coordinator (fleet/pod.py) resolves
+the same spec once to learn the epoch list and seeds the queue; each
+worker resolves it again to get its process functions.
+
+Runnable directly (the pod's spawn line)::
+
+    python -m scintools_tpu.fleet.worker \
+        --queue Q --out OUT --worker-id w0 --spec SPEC.json
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import time
+
+from ..obs import heartbeat as _hb
+from ..obs import metrics as _metrics
+from ..utils import slog
+from .queue import WorkQueue
+
+
+def resolve_workload(workload):
+    """Normalise a workload argument: an already-resolved dict (has
+    ``process_batch``) passes through; a spec dict
+    ``{"target": "module:callable", "params": {...}}`` is imported
+    and called. Raises :class:`ValueError` on anything else — a
+    worker with no workload must die loudly, not idle."""
+    if not isinstance(workload, dict):
+        raise ValueError(f"workload must be a dict, got "
+                         f"{type(workload).__name__}")
+    if "process_batch" in workload:
+        return workload
+    target = workload.get("target")
+    if not target or ":" not in target:
+        raise ValueError(
+            "workload spec needs target='module:callable' "
+            f"(got {target!r})")
+    mod_name, _, fn_name = target.partition(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    resolved = fn(**(workload.get("params") or {}))
+    if "process_batch" not in resolved:
+        raise ValueError(
+            f"workload target {target} returned no process_batch")
+    return resolved
+
+
+def demo_workload(n_epochs=32, scale=1.0, fail_every=0, slow_s=0.0):
+    """Dependency-free deterministic toy workload (fleet plumbing
+    tests, multi-process smoke): each epoch's result is a pure
+    function of its payload seed, so any worker — or a re-run after a
+    steal — produces bit-identical records. ``fail_every`` makes
+    every k-th epoch raise (quarantine-path coverage), ``slow_s``
+    models per-epoch compute so tests can hold a task mid-lease."""
+    import numpy as np
+
+    def _one(payload):
+        seed = int(payload["seed"])
+        if fail_every and seed % fail_every == fail_every - 1:
+            from ..io import MalformedInputError
+
+            raise MalformedInputError(f"<epoch seed={seed}>",
+                                      "demo poisoned epoch")
+        rng = np.random.default_rng(seed)
+        return {"v": round(float(rng.normal()) * scale, 12),
+                "s": round(float(np.sin(seed * 1.7)), 12)}
+
+    def process_batch(payloads, tier=None):
+        if slow_s:
+            time.sleep(slow_s * len(payloads))
+        return [_one(p) for p in payloads]
+
+    def process(payload, tier=None):
+        if slow_s:
+            time.sleep(slow_s)
+        return _one(payload)
+
+    epochs = [(f"e{i:05d}", {"seed": i}) for i in range(int(n_epochs))]
+    return {"epochs": epochs, "process_batch": process_batch,
+            "process": process}
+
+
+class _LeaseBeat(_hb.Heartbeat):
+    """The runner's per-epoch heartbeat hook, repurposed as the
+    worker's liveness channel: every beat (cheap, time-gated) renews
+    the current task's lease and rewrites the worker heartbeat file.
+    Emits NO slog events — fleet liveness is file/lease-borne, the
+    slog stream stays the runner's."""
+
+    def __init__(self, worker, every_s):
+        super().__init__(streaming=True)
+        self._worker = worker
+        self._every_s = float(every_s)
+        self._last = 0.0
+
+    def beat(self, done, force=False, **stats):
+        now = time.monotonic()
+        if not force and now - self._last < self._every_s:
+            return None
+        self._last = now
+        self._worker._heartbeat(done=done, **stats)
+        return None
+
+
+class FleetWorker:
+    """One worker's whole life: claim → run → journal → complete,
+    until the queue drains. See the module docstring; construct and
+    :meth:`run`, or use :func:`run_worker`."""
+
+    def __init__(self, queue_root, out_root, workload, worker_id="w0",
+                 lease_s=15.0, skew_s=2.0, poll_s=0.25,
+                 heartbeat_s=None, retries=1, max_wall_s=None):
+        self.worker_id = str(worker_id)
+        self.out_root = os.fspath(out_root)
+        self.queue = WorkQueue(queue_root, worker=self.worker_id,
+                               lease_s=lease_s, skew_s=skew_s)
+        self.workload = resolve_workload(workload)
+        self.poll_s = float(poll_s)
+        self.retries = int(retries)
+        self.max_wall_s = max_wall_s
+        self.heartbeat_s = (float(heartbeat_s) if heartbeat_s
+                            else max(0.2, lease_s / 3.0))
+        self.workdir = os.path.join(self.out_root, "workers",
+                                    self.worker_id)
+        self.hb_path = os.path.join(self.out_root, "heartbeats",
+                                    self.worker_id + ".json")
+        os.makedirs(self.workdir, exist_ok=True)
+        os.makedirs(os.path.dirname(self.hb_path), exist_ok=True)
+        self.stats = {"worker": self.worker_id, "tasks": 0,
+                      "stolen": 0, "epochs": 0, "n_ok": 0,
+                      "n_quarantined": 0, "lease_lost": 0,
+                      "queue_op_s": 0.0, "idle_wait_s": 0.0,
+                      "busy_s": 0.0}
+        self._task = None
+        self._beat = _LeaseBeat(self, self.heartbeat_s)
+
+    # the journal attribution stamp (see fleet/merge.py): constant
+    # worker id + per-record commit instant, appended at line end
+    def _journal_extra(self):
+        return {"worker": self.worker_id,
+                "t_commit": round(time.time(), 3)}
+
+    def _heartbeat(self, done=None, final=False, **stats):
+        if self._task is not None:
+            t0 = time.perf_counter()
+            if not self.queue.renew(self._task):
+                self.stats["lease_lost"] += 1
+            self.stats["queue_op_s"] += time.perf_counter() - t0
+        rec = dict(self.stats)
+        rec["phase"] = "done" if final else (
+            "task" if self._task is not None else "idle")
+        if done is not None:
+            rec["task_done"] = int(done)
+        rec.update(stats)
+        rec["metrics"] = _metrics.REGISTRY.snapshot() \
+            if _metrics.REGISTRY.enabled else None
+        _hb.write_heartbeat_file(self.hb_path, **rec)
+
+    def _run_task(self, task):
+        from ..robust.runner import _DEFAULT_TIERS, run_survey_batched
+
+        self._task = task
+        self.stats["tasks"] += 1
+        if task.stolen:
+            self.stats["stolen"] += 1
+        t0 = time.perf_counter()
+        try:
+            out = run_survey_batched(
+                task.epochs, self.workload["process_batch"],
+                self.workdir, process=self.workload.get("process"),
+                batch_size=max(1, len(task.epochs)),
+                tiers=self.workload.get("tiers") or _DEFAULT_TIERS,
+                retries=self.retries,
+                validate=self.workload.get("validate"),
+                heartbeat=self._beat, report=False,
+                journal_extra=self._journal_extra)
+        finally:
+            self.stats["busy_s"] += time.perf_counter() - t0
+            self._task = None
+        s = out["summary"]
+        self.stats["epochs"] += s["n_epochs"]
+        self.stats["n_ok"] += s["n_ok"] + sum(
+            1 for o in out["outcomes"]
+            if o.status == "resumed" and not o.error_class)
+        self.stats["n_quarantined"] += s["n_quarantined"]
+        _metrics.counter("fleet_epochs_done_total",
+                         help="epochs completed by fleet workers"
+                         ).inc(s["n_epochs"])
+        t0 = time.perf_counter()
+        self.queue.complete(task)
+        self.stats["queue_op_s"] += time.perf_counter() - t0
+        self._heartbeat()
+
+    def run(self):
+        """The worker loop; returns the stats dict (also written as
+        the final heartbeat record)."""
+        slog.log_event("fleet.worker_start", worker=self.worker_id,
+                       queue=self.queue.root)
+        t_start = time.monotonic()
+        self._heartbeat()
+        while True:
+            if self.max_wall_s is not None \
+                    and time.monotonic() - t_start > self.max_wall_s:
+                slog.log_event("fleet.worker_exit",
+                               worker=self.worker_id,
+                               reason="max_wall_s")
+                break
+            t0 = time.perf_counter()
+            task = self.queue.claim()
+            self.stats["queue_op_s"] += time.perf_counter() - t0
+            if task is not None:
+                self._run_task(task)
+                continue
+            if self.queue.drained():
+                slog.log_event("fleet.worker_exit",
+                               worker=self.worker_id,
+                               reason="drained")
+                break
+            # the queue is not drained but nothing is claimable: some
+            # other worker holds a live lease — poll until it
+            # completes or its lease expires and becomes stealable
+            self.stats["idle_wait_s"] += self.poll_s
+            self._heartbeat()
+            time.sleep(self.poll_s)
+        self._heartbeat(final=True)
+        return dict(self.stats)
+
+
+def run_worker(queue_root, out_root, workload, worker_id="w0", **kw):
+    """Run one fleet worker to queue exhaustion (module docstring);
+    returns its stats dict."""
+    return FleetWorker(queue_root, out_root, workload,
+                       worker_id=worker_id, **kw).run()
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="scintools_tpu fleet worker process")
+    ap.add_argument("--queue", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--spec", required=True,
+                    help="JSON file: {'workload': spec, 'options': {}}")
+    args = ap.parse_args(argv)
+    with open(args.spec) as fh:
+        spec = json.load(fh)
+    worker_id = args.worker_id or f"w{os.getpid()}"
+    stats = run_worker(args.queue, args.out, spec["workload"],
+                       worker_id=worker_id,
+                       **(spec.get("options") or {}))
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,7 @@ the cadence check itself is two comparisons per epoch, but the
 
 from __future__ import annotations
 
+import os
 import time
 
 from ..utils import slog
@@ -84,6 +85,51 @@ class Heartbeat:
         self._last_t = now
         self._last_n = done
         return rec
+
+
+# ---------------------------------------------------------------------
+# file heartbeats — the fleet tier's cross-PROCESS liveness channel
+# ---------------------------------------------------------------------
+# A worker process can't slog into its coordinator's ring buffer; what
+# it CAN do is atomically rewrite one small JSON file that the pod
+# coordinator polls. Same guarantees as the queue's lease files: the
+# write is temp+rename (a reader never sees a torn heartbeat) and
+# staleness is judged against the reader's clock with the caller's
+# skew allowance.
+
+def write_heartbeat_file(path, **fields):
+    """Atomically (re)write a heartbeat file: ``fields`` plus a ``t``
+    wall-clock stamp and the writing ``pid``. Returns the record."""
+    from ..parallel.checkpoint import atomic_write_json
+
+    rec = {"t": round(time.time(), 3), "pid": os.getpid(), **fields}
+    atomic_write_json(os.fspath(path), rec)
+    return rec
+
+
+def read_heartbeat_file(path):
+    """The last complete heartbeat record at ``path``, or None when
+    missing/torn (a torn read is indistinguishable from a dead
+    writer, and is treated the same way)."""
+    import json
+
+    try:
+        with open(os.fspath(path)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def heartbeat_age_s(rec, now=None):
+    """Seconds since the heartbeat was stamped (``inf`` for a missing
+    record) — the staleness input for dead-worker detection."""
+    if rec is None:
+        return float("inf")
+    now = time.time() if now is None else now
+    try:
+        return now - float(rec.get("t", 0.0))
+    except (TypeError, ValueError):
+        return float("inf")
 
 
 def as_heartbeat(spec, total=None):

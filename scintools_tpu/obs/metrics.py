@@ -338,6 +338,38 @@ class MetricsRegistry:
         return json.dumps(self.snapshot(), **kw)
 
 
+def aggregate_snapshots(snapshots):
+    """Fold N :meth:`MetricsRegistry.snapshot` dicts (e.g. one per
+    fleet worker process, shipped through their heartbeat files) into
+    one pod-level view with the same schema: counters and histogram
+    counts/sums/buckets SUM across workers; gauges sum too — the
+    per-worker gauges this is used on (backlog, queue depth) are
+    additive, and a pod-level "last writer wins" would be
+    meaningless across processes. Malformed entries are skipped (a
+    heartbeat from an older worker build must not kill the pod
+    aggregation)."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        for kind in ("counters", "gauges"):
+            for name, val in dict(snap.get(kind) or {}).items():
+                if not isinstance(val, (int, float)):
+                    continue
+                out[kind][name] = out[kind].get(name, 0) + val
+        for name, st in dict(snap.get("histograms") or {}).items():
+            if not isinstance(st, dict):
+                continue
+            agg = out["histograms"].setdefault(
+                name, {"count": 0, "sum": 0.0, "buckets": {}})
+            agg["count"] += int(st.get("count", 0))
+            agg["sum"] += float(st.get("sum", 0.0))
+            for le, n in dict(st.get("buckets") or {}).items():
+                agg["buckets"][le] = agg["buckets"].get(le, 0) \
+                    + int(n)
+    return out
+
+
 #: the process-wide default registry every library call site uses.
 REGISTRY = MetricsRegistry()
 

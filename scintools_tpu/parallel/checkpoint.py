@@ -132,6 +132,13 @@ class EpochJournal:
         (see :meth:`_scan` for the corrupt-line tolerance)."""
         return {rec["epoch"]: rec for _, rec in self._scan()}
 
+    def iter_records(self):
+        """Every intact record (crc verified and stripped) in append
+        order — unlike :meth:`records` duplicates are preserved, which
+        is what the fleet journal merge (fleet/merge.py) needs to
+        resolve duplicate-claim records first-committed-wins."""
+        return [rec for _, rec in self._scan()]
+
     def valid_lines(self):
         """The intact raw journal lines (sans newline) in append
         order — the ATOMIC read view of the journal-as-results-store

@@ -122,10 +122,12 @@ class _Recorder:
     outcomes, results, journal appends (direct or via the async
     writer), per-epoch metrics, and the heartbeat cadence."""
 
-    def __init__(self, journal, writer, tiers, heartbeat=None):
+    def __init__(self, journal, writer, tiers, heartbeat=None,
+                 journal_extra=None):
         self.journal = journal
         self.writer = writer
         self.heartbeat = heartbeat
+        self.journal_extra = journal_extra
         self.outcomes = []
         self.results = {}
         self.tally = {"n_epochs": 0, "n_ok": 0, "n_quarantined": 0,
@@ -133,6 +135,15 @@ class _Recorder:
                       "tier_counts": {t: 0 for t in tiers}}
 
     def _append(self, key, **fields):
+        # worker-attribution columns (fleet/): constant fields — or a
+        # callable producing them per record (commit stamps) — ride at
+        # the END of every journal line, so stripping them restores
+        # the exact single-process line bytes (fleet/merge.py relies
+        # on this ordering)
+        extra = self.journal_extra() if callable(self.journal_extra) \
+            else self.journal_extra
+        if extra:
+            fields.update(extra)
         if self.writer is not None:
             self.writer.append(key, **fields)
         else:
@@ -195,7 +206,8 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
                retries=1, validate=None, journal_name="journal.jsonl",
                resume=True, pipeline=True, prefetch=4, inflight=2,
                loader_workers=2, load_fn=None, defer_validate=False,
-               timeline=None, heartbeat=None, report=True):
+               timeline=None, heartbeat=None, report=True,
+               journal_extra=None):
     """Process ``epochs`` — an iterable of ``(epoch_id, payload)`` —
     fault-tolerantly, journaling each completion to
     ``workdir/journal_name``.
@@ -239,6 +251,13 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
     ``run_report.json`` + ``run_report.md`` artifact into
     ``workdir``.
 
+    ``journal_extra`` (a dict, or a zero-arg callable returning one)
+    appends constant attribution fields to the END of every journal
+    line — the fleet tier (fleet/) stamps ``worker``/``t_commit``
+    there so per-worker journals merge deterministically
+    (fleet/merge.py strips them to recover the single-process line
+    bytes).
+
     Returns ``{"results": {epoch_id: result_dict},
     "outcomes": [EpochOutcome...], "summary": {...}}`` where summary
     counts ok/quarantined/resumed epochs, per-tier completions, and
@@ -259,11 +278,11 @@ def run_survey(epochs, process, workdir, tiers=_DEFAULT_TIERS,
             rec = _run_pipelined(
                 epochs, process, journal, done, tiers, retries,
                 validate, prefetch, inflight, loader_workers, load_fn,
-                defer_validate, timeline, heartbeat)
+                defer_validate, timeline, heartbeat, journal_extra)
         else:
             rec = _run_sequential(epochs, process, journal, done,
                                   tiers, retries, validate, load_fn,
-                                  timeline, heartbeat)
+                                  timeline, heartbeat, journal_extra)
         slog.log_event("survey.robust_summary", **{
             k: v for k, v in rec.tally.items() if k != "tier_counts"},
             tier_counts=dict(rec.tally["tier_counts"]))
@@ -302,11 +321,13 @@ def _trace_id(index, epoch_id):
 
 
 def _run_sequential(epochs, process, journal, done, tiers, retries,
-                    validate, load_fn, timeline, heartbeat=None):
+                    validate, load_fn, timeline, heartbeat=None,
+                    journal_extra=None):
     """The strictly sequential oracle: load, process, fsync — one
     epoch at a time on the calling thread (the pre-pipeline PR-2
     loop; kept as the parity/throughput baseline)."""
-    rec = _Recorder(journal, None, tiers, heartbeat=heartbeat)
+    rec = _Recorder(journal, None, tiers, heartbeat=heartbeat,
+                    journal_extra=journal_extra)
     for epoch_id, payload in epochs:
         rec.tally["n_epochs"] += 1
         if timeline is not None:
@@ -333,7 +354,8 @@ def _run_sequential(epochs, process, journal, done, tiers, retries,
 
 def _run_pipelined(epochs, process, journal, done, tiers, retries,
                    validate, prefetch, inflight, loader_workers,
-                   load_fn, defer_validate, timeline, heartbeat=None):
+                   load_fn, defer_validate, timeline, heartbeat=None,
+                   journal_extra=None):
     """The pipelined engine: bounded prefetch loader feeding a
     dispatch-ahead window of un-fenced epochs, results consumed (and
     journaled via the threaded writer) in strict epoch order.
@@ -351,7 +373,8 @@ def _run_pipelined(epochs, process, journal, done, tiers, retries,
     if validate is not None and not defer_validate:
         inflight = 0
     writer = AsyncJournalWriter(journal, timeline=timeline)
-    rec = _Recorder(journal, writer, tiers, heartbeat=heartbeat)
+    rec = _Recorder(journal, writer, tiers, heartbeat=heartbeat,
+                    journal_extra=journal_extra)
     window = collections.deque()   # (epoch_id, payload, value, report)
 
     def consume_one():
@@ -475,7 +498,8 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
                        validate=None, journal_name="journal.jsonl",
                        resume=True, pipeline=True, prefetch=4,
                        loader_workers=2, load_fn=None, timeline=None,
-                       heartbeat=None, report=True):
+                       heartbeat=None, report=True,
+                       journal_extra=None):
     """Batched counterpart of :func:`run_survey` for device programs
     that fit a whole epoch stack at once (e.g.
     ``fit/acf2d.py:fit_acf2d_batch`` — one compile, one H2D, one
@@ -504,10 +528,12 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
     unchanged. ``pipeline=False`` is the sequential oracle.
 
     Journal format, resume semantics, observability wiring
-    (``heartbeat``/``report``/metrics — see :func:`run_survey`), and
-    the return structure are shared with :func:`run_survey` (same
-    ``workdir`` journal resumes either entry); the summary
-    additionally counts ``n_batches``.
+    (``heartbeat``/``report``/metrics — see :func:`run_survey`), the
+    ``journal_extra`` attribution hook (the fleet tier's
+    worker/commit columns, see :func:`run_survey`), and the return
+    structure are shared with :func:`run_survey` (same ``workdir``
+    journal resumes either entry); the summary additionally counts
+    ``n_batches``.
     """
     from ..parallel.pipeline import AsyncJournalWriter, PrefetchLoader
 
@@ -521,7 +547,8 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
 
     writer = AsyncJournalWriter(journal, timeline=timeline) \
         if pipeline else None
-    rec = _Recorder(journal, writer, tiers, heartbeat=None)
+    rec = _Recorder(journal, writer, tiers, heartbeat=None,
+                    journal_extra=journal_extra)
     rec.tally["n_batches"] = 0
     outcomes_by_key = {}
 

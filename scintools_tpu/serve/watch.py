@@ -117,19 +117,49 @@ class SpoolWatcher:
     restarted daemon re-admits everything and relies on the results
     store to skip what was already published (resume) or already seen
     under another name (content dedupe).
+
+    **Claim mode** (``claim=True`` — the shared-spool fleet shape,
+    ROADMAP item 2): N daemons watching ONE spool directory must
+    never fit the same epoch twice. Before admitting a stable file,
+    the watcher claims it with the fleet queue's rename primitive
+    (``fleet/queue.py:claim_by_rename``): the file atomically moves
+    into this watcher's own claim directory
+    (``<spool>/.claims/<owner>/``) — exactly one of N racing watchers
+    wins the rename, the losers see the file vanish and drop it
+    (counted in ``serve_spool_claims_lost_total``). The admitted
+    payload is the file's CLAIMED path, and a restarted daemon
+    re-admits whatever is already in its own claim directory (its
+    results store then resumes/dedupes as usual), so a crash between
+    claim and publish loses nothing.
     """
 
     def __init__(self, spool_dir, pattern="*.dynspec", poll_s=0.2,
-                 settle_polls=1, start=True):
+                 settle_polls=1, start=True, claim=False,
+                 owner=None):
         self.spool_dir = os.fspath(spool_dir)
         self.pattern = pattern
         self.poll_s = max(0.01, float(poll_s))
         self.settle_polls = max(1, int(settle_polls))
+        self.claim = bool(claim)
+        self.owner = str(owner) if owner else f"d{os.getpid()}"
+        self.claim_dir = os.path.join(self.spool_dir, ".claims",
+                                      self.owner)
         self._q = queue.Queue()
         self._seen = {}          # name -> (size, stable_polls)
         self._admitted = set()
         self._closed = threading.Event()
         self._last_poll = time.time()
+        if self.claim:
+            # crash recovery: files claimed by a previous incarnation
+            # of this owner but never published — re-admit them (the
+            # results store skips what was already published)
+            try:
+                for name in sorted(os.listdir(self.claim_dir)):
+                    if fnmatch.fnmatch(name, self.pattern):
+                        self._admit(name, os.path.join(self.claim_dir,
+                                                       name))
+            except FileNotFoundError:
+                pass
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="spool-watcher")
         if start:
@@ -152,7 +182,8 @@ class SpoolWatcher:
         try:
             names = sorted(
                 n for n in os.listdir(self.spool_dir)
-                if fnmatch.fnmatch(n, self.pattern))
+                if not n.startswith(".")
+                and fnmatch.fnmatch(n, self.pattern))
         except FileNotFoundError:
             return                       # spool not created yet
         for name in names:
@@ -174,6 +205,26 @@ class SpoolWatcher:
             self._admit(name, path)
 
     def _admit(self, name, path):
+        if self.claim and os.path.dirname(path) != self.claim_dir:
+            from ..fleet.queue import claim_by_rename
+            from ..obs import metrics as _metrics
+
+            won = claim_by_rename(path, self.claim_dir)
+            if won is None:
+                # another daemon renamed it away first — theirs now;
+                # remember the name so we stop re-sizing it
+                _metrics.counter(
+                    "serve_spool_claims_lost_total",
+                    help="stable spool files lost to another "
+                         "daemon's claim").inc()
+                self._admitted.add(name)
+                self._seen.pop(name, None)
+                return
+            _metrics.counter(
+                "serve_spool_claims_won_total",
+                help="stable spool files claimed by this daemon"
+            ).inc()
+            path = won
         try:
             with open(path, "rb") as fh:
                 sha = content_hash(fh.read())

@@ -163,30 +163,26 @@ def _lane_table(regimes, epochs_per_regime, seed):
     return epochs
 
 
-def run_scenario_survey(workdir, regimes=DEFAULT_REGIMES,
-                        epochs_per_regime=128, ns=128, nf=64,
-                        dlam=0.05, rf=1.0, ds=0.02, dt=30.0,
-                        freq=1400.0, inner=0.001, batch_size=64,
-                        seed=0, numsteps=1500, n_iter=60,
-                        eta_window=(0.2, 5.0), resume=True,
-                        heartbeat=None, report=True, retries=1):
-    """The closed generate → search → fit loop as a journaled survey
-    (module docstring). Returns the :func:`run_survey_batched` result
-    extended with ``"recovery"``: per-regime median relative errors
-    of η / τ_d / Δν_d against the closed-form truths, over healthy
-    lanes.
-
-    Every per-epoch result dict carries the recovered AND true
-    parameter values plus the lane health code, so the journal (and
-    therefore resume, the RunReport, and any downstream reader) is a
-    self-contained record of the recovery experiment."""
+def scenario_workload(regimes=DEFAULT_REGIMES, epochs_per_regime=128,
+                      ns=128, nf=64, dlam=0.05, rf=1.0, ds=0.02,
+                      dt=30.0, freq=1400.0, inner=0.001, seed=0,
+                      numsteps=1500, n_iter=60,
+                      eta_window=(0.2, 5.0)):
+    """The closed-loop scenario survey as a WORKLOAD: the epoch table
+    plus the batched/per-epoch process functions, without a runner
+    attached. :func:`run_scenario_survey` feeds it to the batched
+    runner in-process; the fleet tier resolves it by spec
+    (``{"target": "scintools_tpu.sim.scenario:scenario_workload",
+    "params": {...}}`` — every parameter here is JSON-able) in each
+    worker process, so N workers compile the same geometry-keyed
+    programs against the same deterministic per-epoch lanes. Returns
+    ``{"epochs", "process_batch", "process"}``."""
     jax = get_jax()
     import jax.numpy as jnp
 
     from ..fit.batch import scint_params_batch
     from ..ops.fitarc import fit_arc, fit_arc_batch
     from ..ops.sspec import sspec_axes
-    from ..robust import run_survey_batched
     from ..robust.ladder import TIER_NUMPY
     from .factory import lane_keys_from_seeds, simulate_scenarios
     from .simulation import Simulation
@@ -310,16 +306,74 @@ def run_scenario_survey(workdir, regimes=DEFAULT_REGIMES,
                        getattr(arcs[0], "etaerr", np.nan), fits, 0,
                        lane)
 
+    return {"epochs": epochs, "process_batch": process_batch,
+            "process": process}
+
+
+def run_scenario_survey(workdir, regimes=DEFAULT_REGIMES,
+                        epochs_per_regime=128, ns=128, nf=64,
+                        dlam=0.05, rf=1.0, ds=0.02, dt=30.0,
+                        freq=1400.0, inner=0.001, batch_size=64,
+                        seed=0, numsteps=1500, n_iter=60,
+                        eta_window=(0.2, 5.0), resume=True,
+                        heartbeat=None, report=True, retries=1):
+    """The closed generate → search → fit loop as a journaled survey
+    (module docstring). Returns the :func:`run_survey_batched` result
+    extended with ``"recovery"``: per-regime median relative errors
+    of η / τ_d / Δν_d against the closed-form truths, over healthy
+    lanes.
+
+    Every per-epoch result dict carries the recovered AND true
+    parameter values plus the lane health code, so the journal (and
+    therefore resume, the RunReport, and any downstream reader) is a
+    self-contained record of the recovery experiment."""
+    from ..robust import run_survey_batched
+
+    wl = scenario_workload(
+        regimes=regimes, epochs_per_regime=epochs_per_regime, ns=ns,
+        nf=nf, dlam=dlam, rf=rf, ds=ds, dt=dt, freq=freq,
+        inner=inner, seed=seed, numsteps=numsteps, n_iter=n_iter,
+        eta_window=eta_window)
+    epochs = wl["epochs"]
     with slog.span("sim.scenario_survey", n_epochs=len(epochs),
                    n_regimes=len(regimes), ns=ns, nf=nf,
                    batch_size=batch_size):
         out = run_survey_batched(
-            epochs, process_batch, workdir, process=process,
-            batch_size=batch_size, retries=retries, resume=resume,
-            heartbeat=heartbeat, report=report)
+            epochs, wl["process_batch"], workdir,
+            process=wl["process"], batch_size=batch_size,
+            retries=retries, resume=resume, heartbeat=heartbeat,
+            report=report)
     out["recovery"] = recovery_summary(out["results"])
     slog.log_event("sim.scenario_summary",
                    n_epochs=len(epochs),
+                   recovery={r: {k: round(v, 4) for k, v in d.items()}
+                             for r, d in out["recovery"].items()})
+    return out
+
+
+def run_scenario_fleet(workdir, n_workers=3, batch_size=48,
+                       timeout=900.0, pod_options=None,
+                       **workload_params):
+    """The scenario survey DISTRIBUTED: the same closed
+    generate → search → fit loop, run by ``n_workers`` independent
+    worker processes coordinating through the fleet work queue
+    (fleet/pod.py) — epoch-batch tasks, lease-based work-stealing,
+    per-worker journals merged deterministically into one canonical
+    survey journal + merged RunReport. ``workload_params`` are
+    :func:`scenario_workload` parameters (JSON-able — they travel to
+    the worker processes by spec file). Returns the pod result
+    extended with the per-regime ``"recovery"`` summary, exactly like
+    :func:`run_scenario_survey`."""
+    from ..fleet.pod import run_pod
+
+    spec = {"target": "scintools_tpu.sim.scenario:scenario_workload",
+            "params": dict(workload_params)}
+    out = run_pod(workdir, spec, n_workers=n_workers,
+                  batch_size=batch_size, timeout=timeout,
+                  **(pod_options or {}))
+    out["recovery"] = recovery_summary(out["results"])
+    slog.log_event("sim.scenario_summary",
+                   n_epochs=out["summary"]["n_epochs"],
                    recovery={r: {k: round(v, 4) for k, v in d.items()}
                              for r, d in out["recovery"].items()})
     return out

@@ -322,7 +322,7 @@ class TestBudgetFallback:
         # every config is present and explicitly marked skipped
         # ISSUE 10: +sim_factory +scenario_loop (sim_batch kept as the
         # legacy-entry continuity measurement)
-        assert len(d["configs"]) == 16
+        assert len(d["configs"]) == 17
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9

@@ -1,0 +1,529 @@
+"""Fleet scheduler tests (ISSUE 11): queue claim/lease/steal
+semantics, deterministic journal merge, pod orchestration with real
+worker processes and a real SIGKILL, and the closed-loop scenario
+survey through the fleet path.
+
+The load-bearing contracts pinned here:
+
+- claim-by-rename atomicity: N racers, exactly one winner;
+- lease expiry is clock-skew tolerant, and a SIGKILLed worker's
+  claims are stolen and completed;
+- the merged journal is byte-identical to an uninterrupted
+  single-process run's journal (modulo the stripped attribution
+  columns) regardless of worker count, scheduling, death, or steals.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from scintools_tpu.fleet import (Pod, WorkQueue, claim_by_rename,
+                                 demo_workload, merge_journals,
+                                 merge_records, run_pod, run_worker)
+from scintools_tpu.fleet.worker import resolve_workload
+from scintools_tpu.obs.report import validate_run_report
+from scintools_tpu.parallel.checkpoint import EpochJournal
+from scintools_tpu.robust import run_survey_batched
+from scintools_tpu.utils import slog
+
+DEMO_SPEC = {"target": "scintools_tpu.fleet.worker:demo_workload"}
+
+
+def _spec(**params):
+    return {**DEMO_SPEC, "params": params}
+
+
+def _oracle_journal(tmp_path, name="oracle", **params):
+    """Single-process runner journal for the same demo workload —
+    the byte-identity reference."""
+    wl = demo_workload(**params)
+    run_survey_batched(wl["epochs"], wl["process_batch"],
+                       tmp_path / name, process=wl["process"],
+                       batch_size=5, report=False)
+    return EpochJournal(tmp_path / name / "journal.jsonl"
+                        ).valid_lines()
+
+
+class TestClaimPrimitive:
+    def test_exactly_one_winner(self, tmp_path):
+        """The whole protocol rests on this: N concurrent renames of
+        one source, exactly one succeeds."""
+        src = tmp_path / "tasks" / "t0.json"
+        src.parent.mkdir()
+        src.write_text("{}")
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def racer(i):
+            barrier.wait()
+            won = claim_by_rename(src, tmp_path / f"claims{i}")
+            if won is not None:
+                wins.append((i, won))
+
+        threads = [threading.Thread(target=racer, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        assert os.path.exists(wins[0][1])
+        assert not src.exists()
+
+    def test_two_queues_race_one_task(self, tmp_path):
+        """Two WorkQueue clients (two 'workers') racing claim() on a
+        single-task queue: one gets the task, the other gets None."""
+        qa = WorkQueue(tmp_path / "q", worker="a")
+        qb = WorkQueue(tmp_path / "q", worker="b")
+        qa.seed([("t0", [("e0", {"seed": 0})])])
+        got = {}
+        barrier = threading.Barrier(2)
+
+        def racer(name, q):
+            barrier.wait()
+            got[name] = q.claim()
+
+        ta = threading.Thread(target=racer, args=("a", qa))
+        tb = threading.Thread(target=racer, args=("b", qb))
+        ta.start(), tb.start()
+        ta.join(), tb.join()
+        winners = [n for n, t in got.items() if t is not None]
+        assert len(winners) == 1
+        task = got[winners[0]]
+        assert task.task_id == "t0"
+        assert task.epochs == [("e0", {"seed": 0})]
+
+
+class TestWorkQueue:
+    def _q(self, tmp_path, worker="w0", **kw):
+        return WorkQueue(tmp_path / "q", worker=worker, **kw)
+
+    def test_seed_is_idempotent(self, tmp_path):
+        q = self._q(tmp_path)
+        tasks = [("t0", [("e0", 0)]), ("t1", [("e1", 1)])]
+        assert q.seed(tasks) == 2
+        assert q.seed(tasks) == 0            # pending → skipped
+        t = q.claim()
+        assert q.seed(tasks) == 0            # claimed → skipped
+        q.complete(t)
+        assert q.seed(tasks) == 0            # done → skipped
+        assert q.counts() == {"pending": 1, "claimed": 0, "done": 1}
+
+    def test_complete_and_drain(self, tmp_path):
+        q = self._q(tmp_path)
+        q.seed([(f"t{i}", [(f"e{i}", i)]) for i in range(3)])
+        assert not q.drained()
+        while (task := q.claim()) is not None:
+            assert q.complete(task)
+        assert q.drained()
+        assert q.done_ids() == {"t0", "t1", "t2"}
+
+    def test_release_returns_task(self, tmp_path):
+        q = self._q(tmp_path)
+        q.seed([("t0", [("e0", 0)])])
+        task = q.claim()
+        assert q.counts()["claimed"] == 1
+        q.release(task)
+        assert q.counts() == {"pending": 1, "claimed": 0, "done": 0}
+        assert q.claim() is not None
+
+    def test_expired_lease_is_stolen(self, tmp_path):
+        holder = self._q(tmp_path, worker="dead", lease_s=0.05,
+                         skew_s=0.0)
+        thief = self._q(tmp_path, worker="thief", lease_s=5.0,
+                        skew_s=0.0)
+        holder.seed([("t0", [("e0", 0)])])
+        assert holder.claim() is not None     # dead worker holds it
+        assert thief.claim() is None          # lease still live
+        time.sleep(0.08)                      # … expire
+        stolen = thief.claim()
+        assert stolen is not None and stolen.stolen
+        assert stolen.stolen_from == "dead"
+        assert thief.complete(stolen)
+        assert thief.drained()
+        assert slog.recent(event="fleet.steal")
+
+    def test_clock_skew_tolerance(self, tmp_path):
+        """A lease expired by LESS than skew_s is NOT stealable (the
+        holder's clock may simply be behind); past skew_s it is."""
+        holder = self._q(tmp_path, worker="h", lease_s=0.05)
+        patient = self._q(tmp_path, worker="p", skew_s=30.0)
+        eager = self._q(tmp_path, worker="e", skew_s=0.0)
+        holder.seed([("t0", [("e0", 0)])])
+        assert holder.claim() is not None
+        time.sleep(0.08)                      # expired on the stamp…
+        assert patient.claim() is None        # …but within skew
+        stolen = eager.claim()
+        assert stolen is not None and stolen.stolen
+
+    def test_heartbeat_keeps_lease_alive(self, tmp_path):
+        holder = self._q(tmp_path, worker="h", lease_s=0.1,
+                         skew_s=0.0)
+        thief = self._q(tmp_path, worker="t", skew_s=0.0)
+        holder.seed([("t0", [("e0", 0)])])
+        task = holder.claim()
+        for _ in range(4):
+            time.sleep(0.05)
+            assert holder.renew(task)         # heartbeat mid-compute
+            assert thief.claim() is None      # never stealable
+        assert holder.complete(task)
+
+    def test_lost_lease_detected_at_heartbeat_and_complete(
+            self, tmp_path):
+        slow = self._q(tmp_path, worker="slow", lease_s=0.05,
+                       skew_s=0.0)
+        thief = self._q(tmp_path, worker="thief", skew_s=0.0)
+        slow.seed([("t0", [("e0", 0)])])
+        task = slow.claim()
+        time.sleep(0.08)
+        stolen = thief.claim()                # expired → stolen
+        assert stolen is not None
+        assert not slow.renew(task)           # heartbeat says: lost
+        assert not slow.complete(task)        # completion too
+        assert thief.complete(stolen)         # exactly one completes
+        assert thief.drained()
+
+    def test_reclaim_own_after_restart(self, tmp_path):
+        """A restarted worker (same id) reclaims what its previous
+        incarnation held when it died."""
+        first = self._q(tmp_path, worker="w0", lease_s=0.05,
+                        skew_s=0.0)
+        first.seed([("t0", [("e0", 0)])])
+        assert first.claim() is not None      # dies holding it
+        time.sleep(0.08)
+        restarted = self._q(tmp_path, worker="w0", lease_s=5.0,
+                            skew_s=0.0)
+        task = restarted.claim()
+        assert task is not None and task.task_id == "t0"
+        assert restarted.complete(task)
+
+
+class TestMerge:
+    def _journal(self, path, rows):
+        j = EpochJournal(path)
+        for epoch, fields in rows:
+            j.append(epoch, **fields)
+        return os.fspath(path)
+
+    def test_first_committed_wins_and_conflicts_counted(
+            self, tmp_path):
+        a = self._journal(tmp_path / "a.jsonl", [
+            ("e0", dict(status="ok", result={"v": 1}, worker="a",
+                        t_commit=10.0)),
+            ("e1", dict(status="ok", result={"v": 2}, worker="a",
+                        t_commit=11.0)),
+        ])
+        b = self._journal(tmp_path / "b.jsonl", [
+            # duplicate of e0, committed LATER, same payload
+            ("e0", dict(status="ok", result={"v": 1}, worker="b",
+                        t_commit=20.0)),
+            # duplicate of e1, committed EARLIER, DIFFERENT payload
+            ("e1", dict(status="ok", result={"v": 99}, worker="b",
+                        t_commit=5.0)),
+        ])
+        lines, stats = merge_records([a, b], order=["e0", "e1"])
+        assert stats["duplicates"] == 2
+        assert stats["conflicts"] == 1        # e1 payloads differ
+        recs = [json.loads(ln) for ln in lines]
+        assert recs[0]["result"] == {"v": 1}
+        assert recs[1]["result"] == {"v": 99}   # b committed first
+        assert all("worker" not in r and "t_commit" not in r
+                   for r in recs)
+        assert slog.recent(event="fleet.merge_conflict")
+
+    def test_merge_is_deterministic_and_order_canonical(
+            self, tmp_path):
+        a = self._journal(tmp_path / "a.jsonl", [
+            ("e2", dict(status="ok", result={}, worker="a",
+                        t_commit=1.0)),
+            ("e0", dict(status="ok", result={}, worker="a",
+                        t_commit=2.0))])
+        b = self._journal(tmp_path / "b.jsonl", [
+            ("e1", dict(status="ok", result={}, worker="b",
+                        t_commit=3.0))])
+        order = ["e0", "e1", "e2"]
+        l1, _ = merge_records([a, b], order=order)
+        l2, _ = merge_records([b, a], order=order)   # path order flip
+        assert l1 == l2
+        assert [json.loads(x)["epoch"] for x in l1] == order
+        # ids the caller didn't list sort at the end
+        l3, _ = merge_records([a, b], order=["e1"])
+        assert [json.loads(x)["epoch"] for x in l3] \
+            == ["e1", "e0", "e2"]
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        a = self._journal(tmp_path / "a.jsonl", [
+            ("e0", dict(status="ok", result={"v": 1}, worker="a",
+                        t_commit=1.0))])
+        with open(a, "a") as fh:
+            fh.write('{"epoch": "e1", "status": "ok", "cr')  # torn
+        with pytest.warns(UserWarning, match="corrupt line"):
+            lines, stats = merge_records([a])
+        assert stats["epochs"] == 1
+
+    def test_merged_file_reverifies(self, tmp_path):
+        a = self._journal(tmp_path / "a.jsonl", [
+            ("e0", dict(status="ok", result={"v": 1}, worker="a",
+                        t_commit=1.0))])
+        out = tmp_path / "merged.jsonl"
+        stats = merge_journals([a], out, order=["e0"])
+        assert stats["epochs"] == 1
+        j = EpochJournal(out)
+        assert len(j.valid_lines()) == 1
+        assert j.records()["e0"]["result"] == {"v": 1}
+
+    def test_strip_restores_single_process_bytes(self, tmp_path):
+        """journal_extra appends attribution at line END; stripping
+        it through the merge recovers the exact single-process
+        bytes."""
+        wl = demo_workload(n_epochs=7, fail_every=3)
+        run_survey_batched(
+            wl["epochs"], wl["process_batch"], tmp_path / "w",
+            process=wl["process"], batch_size=3, report=False,
+            journal_extra=lambda: {"worker": "wX",
+                                   "t_commit": round(time.time(), 3)})
+        worker_lines = EpochJournal(tmp_path / "w" / "journal.jsonl"
+                                    ).valid_lines()
+        assert all('"worker": "wX"' in ln for ln in worker_lines)
+        lines, _ = merge_records(
+            [os.fspath(tmp_path / "w" / "journal.jsonl")],
+            order=[e for e, _ in wl["epochs"]])
+        assert lines == _oracle_journal(tmp_path, n_epochs=7,
+                                        fail_every=3)
+
+
+class TestWorkerLoop:
+    def test_worker_drains_queue(self, tmp_path):
+        q = WorkQueue(tmp_path / "q", worker="seeder")
+        wl = demo_workload(n_epochs=12)
+        q.seed([(f"t{i}", wl["epochs"][i * 3:(i + 1) * 3])
+                for i in range(4)])
+        stats = run_worker(tmp_path / "q", tmp_path / "out",
+                           _spec(n_epochs=12), worker_id="w0",
+                           lease_s=5.0)
+        assert stats["tasks"] == 4 and stats["epochs"] == 12
+        assert q.drained()
+        # per-worker journal carries the attribution columns
+        recs = EpochJournal(
+            tmp_path / "out" / "workers" / "w0" / "journal.jsonl"
+        ).iter_records()
+        assert len(recs) == 12
+        assert all(r["worker"] == "w0" and "t_commit" in r
+                   for r in recs)
+        # heartbeat file ends in the done phase with a metrics snap
+        from scintools_tpu.obs.heartbeat import read_heartbeat_file
+
+        hb = read_heartbeat_file(
+            tmp_path / "out" / "heartbeats" / "w0.json")
+        assert hb["phase"] == "done" and hb["epochs"] == 12
+        assert isinstance(hb["metrics"], dict)
+
+    def test_resolve_workload_contract(self):
+        wl = resolve_workload(_spec(n_epochs=3))
+        assert len(wl["epochs"]) == 3
+        assert resolve_workload(wl) is wl      # resolved passes through
+        with pytest.raises(ValueError, match="target"):
+            resolve_workload({"params": {}})
+        with pytest.raises(ValueError, match="dict"):
+            resolve_workload("nope")
+
+
+class TestPodThreadMode:
+    def test_complete_run_and_report(self, tmp_path):
+        out = run_pod(tmp_path / "pod", _spec(n_epochs=23,
+                                              fail_every=7),
+                      n_workers=2, batch_size=5, mode="thread",
+                      lease_s=5.0, timeout=120.0)
+        s = out["summary"]
+        assert s["n_epochs"] == 23
+        assert s["n_ok"] + s["n_quarantined"] == 23
+        assert s["n_quarantined"] == 3          # seeds 6, 13, 20
+        rep = validate_run_report(out["report"])
+        assert rep["runner"] == "run_pod"
+        fleet = rep["fleet"]
+        assert fleet["n_workers"] == 2
+        assert fleet["merge"]["epochs"] == 23
+        assert set(fleet["workers"]) == {"w0", "w1"}
+        # pod-level aggregation of the per-worker metric snapshots
+        # (thread-mode workers SHARE one process registry, so the sum
+        # over-counts — process mode gives exact per-worker sums; this
+        # pins only that the aggregation surfaced the counter)
+        assert rep["worker_metrics"]["counters"][
+            "fleet_epochs_done_total"] >= 23
+        # report artifact on disk, schema-valid
+        with open(tmp_path / "pod" / "run_report.json") as fh:
+            validate_run_report(json.load(fh))
+
+    def test_merged_journal_matches_single_process(self, tmp_path):
+        out = run_pod(tmp_path / "pod", _spec(n_epochs=19,
+                                              fail_every=5),
+                      n_workers=3, batch_size=4, mode="thread",
+                      lease_s=5.0, timeout=120.0)
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=19,
+                                         fail_every=5)
+
+
+class TestPodProcessMode:
+    """Real worker subprocesses (what the pod ships): completion,
+    SIGKILL mid-claim with steal, and whole-pod crash + resume — the
+    merged journal byte-identical to the single-process oracle in
+    every case."""
+
+    def test_sigkill_worker_steal_and_identical_merge(self, tmp_path):
+        pod = Pod(tmp_path / "pod",
+                  _spec(n_epochs=30, slow_s=0.12),
+                  n_workers=3, batch_size=5, lease_s=2.0, skew_s=0.5,
+                  poll_s=0.1, monitor_s=0.1).start()
+        victim = pod.workers[0]
+        claims = os.path.join(pod.queue_root, "claims",
+                              victim.worker_id)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if os.path.isdir(claims) and any(
+                    f.endswith(".json") for f in os.listdir(claims)):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("victim never claimed a task")
+        os.kill(victim.pid, signal.SIGKILL)   # real SIGKILL mid-claim
+        # a dead process can't rename its claim away — if the claim
+        # file is still there after the kill, the victim died HOLDING
+        # it and a steal is mandatory. (The kill can land in the
+        # instant between task-complete and next-claim under heavy
+        # host contention; the byte-identity contract below holds
+        # either way.)
+        victim_held = any(f.endswith(".json")
+                          for f in os.listdir(claims))
+        out = pod.wait(timeout=180.0)
+        assert out["summary"]["n_ok"] == 30
+        assert victim.worker_id in out["fleet"]["dead_workers"]
+        if victim_held:
+            # its claimed task was stolen and every epoch still
+            # completed exactly once
+            assert out["fleet"]["steals"] >= 1
+        assert out["fleet"]["merge"]["conflicts"] == 0
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=30)
+        assert slog.recent(event="fleet.worker_dead")
+
+    def test_whole_pod_crash_resumes_byte_identical(self, tmp_path):
+        """Kill EVERY worker mid-run; a fresh pod on the same workdir
+        finishes the survey and the merged journal is still
+        byte-identical to an uninterrupted run's."""
+        wd = tmp_path / "pod"
+        pod = Pod(wd, _spec(n_epochs=24, slow_s=0.1), n_workers=2,
+                  batch_size=4, lease_s=1.0, skew_s=0.2, poll_s=0.1,
+                  monitor_s=0.1).start()
+        deadline = time.monotonic() + 90
+        done_dir = os.path.join(pod.queue_root, "done")
+        while time.monotonic() < deadline:
+            if len(os.listdir(done_dir)) >= 1:
+                break                      # some progress journaled
+            time.sleep(0.05)
+        for w in pod.workers:
+            os.kill(w.pid, signal.SIGKILL)
+            w.close()
+        # fresh pod, same workdir: seeds are idempotent, stale claims
+        # are reclaimed (same worker ids) or stolen via expired leases
+        out = run_pod(wd, _spec(n_epochs=24, slow_s=0.0), n_workers=2,
+                      batch_size=4, lease_s=2.0, skew_s=0.2,
+                      timeout=180.0)
+        assert out["summary"]["n_ok"] == 24
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=24)
+
+
+class TestScenarioFleet:
+    """The closed generate → search → fit loop through the fleet
+    path. Thread mode keeps this tier-1-sized (workers share the
+    process's compiled factory programs); the slow test below is the
+    ≥10³-epoch ≥3-process acceptance run with a real SIGKILL."""
+
+    KW = dict(epochs_per_regime=8, seed=2, numsteps=800, n_iter=30)
+
+    def test_closed_loop_matches_single_process(self, tmp_path):
+        from scintools_tpu.sim.scenario import (run_scenario_fleet,
+                                                run_scenario_survey)
+
+        out = run_scenario_fleet(
+            tmp_path / "fleet", n_workers=2, batch_size=6,
+            timeout=600.0,
+            pod_options={"mode": "thread", "lease_s": 30.0},
+            **self.KW)
+        s = out["summary"]
+        assert s["n_epochs"] == 24
+        assert s["n_ok"] + s["n_quarantined"] == 24
+        assert set(out["recovery"]) == {"weak", "strong", "aniso"}
+        validate_run_report(out["report"])
+        # the fleet merged journal is byte-identical to the plain
+        # in-process scenario survey's journal (same lanes, same
+        # grouping-independent factory results)
+        ref = run_scenario_survey(tmp_path / "ref", batch_size=6,
+                                  report=False, **self.KW)
+        assert ref["summary"]["n_epochs"] == 24
+        merged = EpochJournal(out["journal"]).valid_lines()
+        oracle = EpochJournal(
+            tmp_path / "ref" / "journal.jsonl").valid_lines()
+        assert merged == oracle
+
+
+@pytest.mark.slow
+class TestScenarioFleetAcceptance:
+    """ISSUE 11 acceptance: a ≥1000-epoch closed-loop scenario survey
+    across ≥3 worker PROCESSES with a real mid-run SIGKILL — stolen
+    epochs complete, and the merged journal is byte-identical to an
+    uninterrupted single-worker fleet run's (same subprocess
+    environment on both sides)."""
+
+    KW = dict(epochs_per_regime=336, seed=5, numsteps=1000, n_iter=40)
+    POD = dict(batch_size=48, lease_s=20.0, skew_s=2.0)
+
+    def test_1008_epochs_3_workers_sigkill(self, tmp_path):
+        from scintools_tpu.sim.scenario import run_scenario_fleet
+
+        spec_params = dict(self.KW)
+        pod = Pod(tmp_path / "fleet",
+                  {"target":
+                   "scintools_tpu.sim.scenario:scenario_workload",
+                   "params": spec_params},
+                  n_workers=3, poll_s=0.2, monitor_s=0.25,
+                  **self.POD).start()
+        victim = pod.workers[1]
+        claims = os.path.join(pod.queue_root, "claims",
+                              victim.worker_id)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if os.path.isdir(claims) and any(
+                    f.endswith(".json") for f in os.listdir(claims)):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("victim never claimed a task")
+        time.sleep(2.0)                    # mid-task, programs warm
+        os.kill(victim.pid, signal.SIGKILL)
+        victim_held = any(f.endswith(".json")
+                          for f in os.listdir(claims))
+        out = pod.wait(timeout=1800.0)
+        s = out["summary"]
+        assert s["n_epochs"] == 1008
+        assert s["n_ok"] + s["n_quarantined"] == 1008
+        assert victim.worker_id in out["fleet"]["dead_workers"]
+        if victim_held:                    # died holding a claim →
+            assert out["fleet"]["steals"] >= 1   # steal is mandatory
+        rep = validate_run_report(out["report"])
+        assert rep["fleet"]["merge"]["conflicts"] == 0
+        # uninterrupted single-worker fleet run = the oracle (same
+        # worker-process environment)
+        ref = run_scenario_fleet(
+            tmp_path / "ref", n_workers=1, timeout=1800.0,
+            pod_options={k: v for k, v in self.POD.items()
+                         if k != "batch_size"},
+            batch_size=self.POD["batch_size"], **self.KW)
+        merged = EpochJournal(out["journal"]).valid_lines()
+        oracle = EpochJournal(ref["journal"]).valid_lines()
+        assert merged == oracle

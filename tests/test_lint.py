@@ -43,8 +43,8 @@ PKG = os.path.join(REPO, "scintools_tpu")
 
 # every subpackage the self-check requires nonzero scanned files in
 # ("." is the package root: dynspec.py, backend.py, ...)
-EXPECTED_PACKAGES = {"fit", "io", "obs", "ops", "parallel", "robust",
-                     "serve", "sim", "thth", "utils", "."}
+EXPECTED_PACKAGES = {"fit", "fleet", "io", "obs", "ops", "parallel",
+                     "robust", "serve", "sim", "thth", "utils", "."}
 
 # the legacy scan targets of the old four-pass scheme, per script
 LEGACY_SYNC_DIRS = ("ops", "fit", "thth", "parallel", "serve",
@@ -180,11 +180,13 @@ class TestLegacyShims:
     def test_obs_events_shim_contracts(self):
         lint = _tool("lint_obs_events")
         doc = os.path.join(REPO, "docs", "observability.md")
-        docs = (doc, os.path.join(REPO, "docs", "serving.md"))
+        docs = (doc, os.path.join(REPO, "docs", "serving.md"),
+                os.path.join(REPO, "docs", "fleet.md"))
         multi = lint.catalog_names(docs)
         assert lint.catalog_names(doc) <= multi
         assert {"robust.quarantine", "robust.fallback",
-                "survey.heartbeat", "serve.ingest"} <= multi
+                "survey.heartbeat", "serve.ingest",
+                "fleet.steal"} <= multi
         events, violations = lint.scan_source(
             "from scintools_tpu.utils import slog\n"
             "def f(event='my.default'):\n"

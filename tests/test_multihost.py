@@ -1,21 +1,93 @@
-"""Two-process multi-host bring-up test: the DCN-analog path.
+"""Multi-PROCESS survey coverage (ISSUE 11 satellite).
 
-Spawns two local processes, each with 4 virtual CPU devices, joined by
-``initialize_distributed`` (parallel/checkpoint.py). The global mesh
-spans 8 devices across both processes; a jitted global reduction over
-a mesh-sharded array forces a real cross-process collective — the
-same single-controller-per-host pattern a TPU pod uses over DCN
-(SURVEY §2.6 distributed-backend plan)."""
+The fleet path is how this repo actually runs a survey across
+processes: N worker subprocesses coordinating through the shared
+queue directory (fleet/) — no jax collectives required, so these
+tests RUN on the CPU image instead of probing-and-skipping. The
+jax-collectives bring-up test (the DCN-analog path a TPU pod uses) is
+kept below as one slow-marked case, still capability-probed: some
+images ship a jax whose CPU backend has no multiprocess collectives,
+which is a platform gap, not a repo regression."""
 
 import os
 import socket
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+class TestFleetMultiProcess:
+    """Real multi-process survey runs on the CPU image: three worker
+    PROCESSES drain one queue; the merged journal carries every
+    epoch exactly once."""
+
+    def test_three_process_fleet_drains_one_queue(self, tmp_path):
+        from scintools_tpu.fleet import run_pod
+        from scintools_tpu.parallel.checkpoint import EpochJournal
+
+        out = run_pod(
+            tmp_path / "pod",
+            {"target": "scintools_tpu.fleet.worker:demo_workload",
+             "params": {"n_epochs": 36, "slow_s": 0.02}},
+            n_workers=3, batch_size=4, lease_s=10.0, timeout=240.0)
+        s = out["summary"]
+        assert s["n_epochs"] == 36 and s["n_ok"] == 36
+        assert out["fleet"]["merge"]["conflicts"] == 0
+        keys = [r["epoch"] for r in
+                EpochJournal(out["journal"]).iter_records()]
+        assert len(keys) == len(set(keys)) == 36
+        # three distinct PROCESSES heartbeated (pid recorded by the
+        # atomic heartbeat writer), distinct from this test process
+        from scintools_tpu.obs.heartbeat import read_heartbeat_file
+
+        pids = set()
+        hb_dir = tmp_path / "pod" / "heartbeats"
+        for name in os.listdir(hb_dir):
+            rec = read_heartbeat_file(hb_dir / name)
+            pids.add(rec["pid"])
+        assert len(pids) == 3 and os.getpid() not in pids
+
+    def test_worker_cli_entry_runs_standalone(self, tmp_path):
+        """The pod's spawn line works as a bare subprocess too — the
+        multi-HOST shape: any host sharing the queue directory can
+        join by running exactly this command."""
+        import json
+
+        from scintools_tpu.fleet import WorkQueue, demo_workload
+        from scintools_tpu.parallel.checkpoint import (
+            EpochJournal, atomic_write_json)
+
+        q = WorkQueue(tmp_path / "q", worker="seeder")
+        wl = demo_workload(n_epochs=6)
+        q.seed([("t0", wl["epochs"][:3]), ("t1", wl["epochs"][3:])])
+        spec = tmp_path / "spec.json"
+        atomic_write_json(spec, {
+            "workload": {
+                "target":
+                    "scintools_tpu.fleet.worker:demo_workload",
+                "params": {"n_epochs": 6}},
+            "options": {"lease_s": 10.0}})
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        r = subprocess.run(
+            [sys.executable, "-m", "scintools_tpu.fleet.worker",
+             "--queue", str(tmp_path / "q"), "--out",
+             str(tmp_path / "out"), "--worker-id", "solo",
+             "--spec", str(spec)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        stats = json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["worker"] == "solo" and stats["epochs"] == 6
+        assert q.drained()
+        assert len(EpochJournal(
+            tmp_path / "out" / "workers" / "solo" / "journal.jsonl"
+        )) == 6
 
 WORKER = textwrap.dedent("""
     import sys
@@ -161,11 +233,15 @@ def _cpu_multiprocess_collectives_supported():
     return result
 
 
+@pytest.mark.slow
 def test_two_process_global_mesh_collective(tmp_path):
+    """The jax-collectives bring-up (DCN-analog) path — slow-marked:
+    the fleet tests above are the tier-1 multi-process coverage; this
+    one needs the platform's multiprocess collectives and skips (with
+    the probe's reason) where the CPU backend lacks them."""
     ok, reason = _cpu_multiprocess_collectives_supported()
     if not ok:
         pytest.skip(reason)
-    import time
 
     addr = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)

@@ -503,6 +503,55 @@ class TestRunReportBuilder:
         assert (tmp_path / "run_report.md").exists()
 
 
+class TestFileHeartbeat:
+    """The cross-process liveness channel the fleet tier uses
+    (ISSUE 11): atomic rewrite, torn-read = dead-writer, staleness
+    against the reader's clock."""
+
+    def test_round_trip_and_age(self, tmp_path):
+        p = tmp_path / "hb.json"
+        rec = hb.write_heartbeat_file(p, epochs=7, phase="task")
+        got = hb.read_heartbeat_file(p)
+        assert got["epochs"] == 7 and got["phase"] == "task"
+        assert got["pid"] == os.getpid()
+        assert 0 <= hb.heartbeat_age_s(got) < 5.0
+        # rewrite replaces atomically (no append, one record)
+        hb.write_heartbeat_file(p, epochs=9)
+        assert hb.read_heartbeat_file(p)["epochs"] == 9
+        assert rec["t"] <= hb.read_heartbeat_file(p)["t"]
+
+    def test_missing_and_torn_read_as_dead(self, tmp_path):
+        assert hb.read_heartbeat_file(tmp_path / "nope.json") is None
+        assert hb.heartbeat_age_s(None) == float("inf")
+        torn = tmp_path / "torn.json"
+        torn.write_text('{"t": 12')
+        assert hb.read_heartbeat_file(torn) is None
+        assert hb.heartbeat_age_s({"t": "garbage"}) == float("inf")
+
+
+class TestAggregateSnapshots:
+    def test_sums_counters_gauges_histograms(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("c").inc(3)
+        reg.gauge("g").set(2.0)
+        reg.histogram("h", buckets=(1.0,)).observe(0.5)
+        a = reg.snapshot()
+        agg = metrics.aggregate_snapshots([a, a, None, "junk"])
+        assert agg["counters"]["c"] == 6
+        assert agg["gauges"]["g"] == 4.0
+        assert agg["histograms"]["h"]["count"] == 2
+        assert agg["histograms"]["h"]["sum"] == 1.0
+        assert agg["histograms"]["h"]["buckets"]["1.0"] == 2
+
+    def test_empty_and_malformed_tolerated(self):
+        assert metrics.aggregate_snapshots([]) == {
+            "counters": {}, "gauges": {}, "histograms": {}}
+        agg = metrics.aggregate_snapshots(
+            [{"counters": {"c": "NaN-string"}},
+             {"histograms": {"h": "not-a-dict"}}])
+        assert agg["counters"] == {} and agg["histograms"] == {}
+
+
 def test_obs_namespace_exports():
     import scintools_tpu.obs as obs
 

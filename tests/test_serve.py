@@ -371,6 +371,78 @@ class TestStreamFaults:
         assert len(svc2.store.valid_lines()) == 1
 
 
+class TestSharedSpoolClaims:
+    """N daemons, ONE spool (ISSUE 11 satellite / ROADMAP item 2):
+    the claim-file mode built on the fleet queue's rename-claim
+    primitive guarantees no epoch is fitted twice."""
+
+    @staticmethod
+    def _drop(spool, name, arr):
+        tmp = spool / (name + ".tmp")
+        with open(tmp, "wb") as fh:
+            np.save(fh, arr)
+        os.replace(tmp, spool / name)
+
+    def _daemon(self, tmp_path, spool, owner):
+        src = SpoolWatcher(spool, pattern="*.npy", poll_s=0.02,
+                           claim=True, owner=owner)
+        svc = SurveyService(src, _numeric_process,
+                            tmp_path / f"run-{owner}",
+                            load_fn=lambda p: np.load(p),
+                            http=False, heartbeat=False)
+        return svc
+
+    def test_two_daemons_never_fit_the_same_epoch(self, tmp_path):
+        spool = tmp_path / "spool"
+        spool.mkdir()
+        a = self._daemon(tmp_path, spool, "a")
+        b = self._daemon(tmp_path, spool, "b")
+        with a, b:
+            for i in range(24):
+                self._drop(spool, f"e{i:03d}.npy",
+                           np.full((3, 3), float(i)))
+                time.sleep(0.005)      # interleaved arrivals: both
+                #                        daemons see most files race
+            assert _wait(lambda: _done_count(a) + _done_count(b)
+                         >= 24, timeout=30)
+            ra, rb = a.results(), b.results()
+        # complete coverage, zero overlap — the claim guarantee
+        assert set(ra) | set(rb) == {f"e{i:03d}.npy"
+                                     for i in range(24)}
+        assert not set(ra) & set(rb)
+        # every spool file ended up in exactly one claim dir
+        assert sorted(os.listdir(spool)) == [".claims"]
+        claimed = {owner: sorted(os.listdir(
+            spool / ".claims" / owner)) for owner in ("a", "b")}
+        assert sorted(claimed["a"] + claimed["b"]) \
+            == [f"e{i:03d}.npy" for i in range(24)]
+        assert set(ra) == {n for n in claimed["a"]}
+        # claim win/loss accounting surfaced as metrics
+        snap = obs_metrics.snapshot()
+        assert snap["counters"].get(
+            "serve_spool_claims_won_total", 0) == 24
+
+    def test_restart_readmits_own_claims(self, tmp_path):
+        """Crash between claim and publish: the file is in the
+        daemon's own claim dir; a restarted watcher re-admits it and
+        the results store publishes it exactly once."""
+        spool = tmp_path / "spool"
+        spool.mkdir()
+        self._drop(spool, "e0.npy", np.full((3, 3), 5.0))
+        # claim without ever publishing (simulated crash): take the
+        # file the way the watcher would
+        from scintools_tpu.fleet.queue import claim_by_rename
+
+        assert claim_by_rename(spool / "e0.npy",
+                               spool / ".claims" / "a") is not None
+        svc = self._daemon(tmp_path, spool, "a")
+        with svc:
+            assert _wait(lambda: _done_count(svc) >= 1, timeout=20)
+            results = svc.results()
+        assert set(results) == {"e0.npy"}
+        assert results["e0.npy"]["result"]["v"] == 5.0
+
+
 class TestServePsrfluxSurvey:
     def test_spooled_psrflux_end_to_end(self, tmp_path):
         from scintools_tpu.dynspec import serve_psrflux_survey

@@ -346,7 +346,8 @@ class Config:
             docs = os.path.join(self.repo_root, "docs")
             self._obs_docs = [
                 p for p in (os.path.join(docs, "observability.md"),
-                            os.path.join(docs, "serving.md"))
+                            os.path.join(docs, "serving.md"),
+                            os.path.join(docs, "fleet.md"))
                 if os.path.exists(p)]
         return self._obs_docs
 

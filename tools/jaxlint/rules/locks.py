@@ -8,7 +8,7 @@ objects. A write to shared state outside the owning lock is a race
 that no test reliably catches — this rule makes the discipline
 structural.
 
-Flagged, in the **threaded modules only** (``serve/``,
+Flagged, in the **threaded modules only** (``serve/``, ``fleet/``,
 ``parallel/pipeline.py``, ``parallel/checkpoint.py``, ``obs/``,
 ``utils/slog.py``, ``utils/profiling.py``):
 
@@ -157,7 +157,7 @@ class LockDisciplineRule(Rule):
              "in threaded modules")
     # the threaded tier only — flagging single-threaded code would be
     # all noise
-    scope = ("serve/", "parallel/pipeline.py",
+    scope = ("serve/", "fleet/", "parallel/pipeline.py",
              "parallel/checkpoint.py", "obs/", "utils/slog.py",
              "utils/profiling.py")
 

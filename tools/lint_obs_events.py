@@ -67,7 +67,8 @@ def main(argv):
                                                       "scintools_tpu")
     docs = argv[2:] if len(argv) > 2 else [
         os.path.join(repo, "docs", "observability.md"),
-        os.path.join(repo, "docs", "serving.md")]
+        os.path.join(repo, "docs", "serving.md"),
+        os.path.join(repo, "docs", "fleet.md")]
     violations = scan_tree(root, docs)
     for path, ln, msg in violations:
         print(f"{path}:{ln}: {msg}")
