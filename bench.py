@@ -1976,6 +1976,70 @@ def bench_survey_service(jax, jnp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_fft_layer(jax, jnp):
+    """Config #18 (ISSUE 12): the structure-aware transform layer
+    (ops/xfft.py) — dense vs declared formulations for the two newly
+    converted hot paths, ``autocovariance`` (real-input
+    Wiener–Khinchin, ``'xfft.acf'``) and ``secondary_spectrum_power``
+    (halved spectrum, ``'xfft.sspec'``), at survey shapes.
+
+    Per formulation: compile_s (first call, program build + compile +
+    run) and steady_s (best over fresh input buffers, full-output
+    fetch forces execution) through the SAME cached jitted program
+    entry (``xfft.acf_program`` / ``xfft.sspec_power_program``). The
+    steady calls re-plan per call and run under ``retrace_guard`` —
+    zero rebuilds is part of the measurement, not an assumption. The
+    active formulation table rides in the record so a bench-to-bench
+    diff shows which lowering was timed (the PR-7 incident class)."""
+    from scintools_tpu.backend import formulation
+    from scintools_tpu.obs import retrace
+    from scintools_tpu.ops import xfft
+
+    full = jax.default_backend() != "cpu"
+    reps = 3
+    rng = np.random.default_rng(29)
+    # acf: power-of-two survey epoch stack (the fit/acf2d
+    # preprocessing shape class); sspec: non-pow2 epoch padded to the
+    # next-pow2 frame (exercises the pruned zero-pad structure)
+    geoms = {
+        "acf": ((16, 512, 256) if full else (4, 512, 256),
+                xfft.acf_program, ("real", "dense"), "xfft.acf"),
+        "sspec": ((8, 600, 360) if full else (4, 300, 180),
+                  xfft.sspec_power_program, ("half", "dense"),
+                  "xfft.sspec"),
+    }
+    out = {}
+    for name, (shape, make, variants, op) in geoms.items():
+        B, nf, nt = shape
+        stacks = [rng.standard_normal(shape).astype(np.float32)
+                  for _ in range(reps + 1)]
+        dev = [jnp.asarray(s) for s in stacks]
+        rec = {"shape": f"{B}x{nf}x{nt}",
+               "formulation_active": formulation(op)}
+        for v in variants:
+            fn = make(nf, nt, variant=v)
+            t0 = time.perf_counter()
+            np.asarray(fn(dev[0]))          # build + compile + run
+            compile_s = time.perf_counter() - t0
+
+            def run(d, _v=v):
+                # per-call re-plan: the keyed cache must serve the
+                # compiled program (JL101 trap pinned live)
+                return np.asarray(make(nf, nt, variant=_v)(d))
+
+            with retrace.retrace_guard():
+                steady = _time_variants(run, [(d,) for d in dev[1:]],
+                                        repeats=reps)
+            rec[v] = {"compile_s": round(compile_s, 3),
+                      "steady_s": round(steady, 4)}
+        declared, dense = variants
+        rec["speedup_declared_vs_dense"] = round(
+            rec[dense]["steady_s"] / rec[declared]["steady_s"], 2)
+        rec["steady_retraces"] = 0          # retrace_guard would have
+        out[name] = rec                     # raised otherwise
+    return out
+
+
 def bench_scattered_image(jax, jnp):
     """Config #7: the scattered-image interpolation — the reference
     evaluates a host FITPACK bicubic spline at every (tdel_est, fdop)
@@ -2094,6 +2158,7 @@ _EST_S = {
     "acf2d_batch":   {"acc": 150, "cpu": 200},
     "retrieval_batch": {"acc": 60, "cpu": 60},
     "scatim":        {"acc": 60,  "cpu": 60},
+    "fft_layer":     {"acc": 60,  "cpu": 60},
 }
 
 
@@ -2230,6 +2295,7 @@ def main():
         ("acf_fit", bench_acf_fit),
         ("acf2d", bench_acf2d_fit),
         ("scatim", bench_scattered_image),
+        ("fft_layer", bench_fft_layer),
     ]
     # The tunneled TPU can WEDGE mid-run (observed live: after a
     # healthy 4096² headline run, the next config's first device call

@@ -195,7 +195,10 @@ def eager_backend(backend=None):
 # Several hot kernels have more than one mathematically-equivalent
 # *formulation* whose winner depends on the platform: the conjugate
 # spectrum as rfft2+Hermitian-gather vs complex fft2 (ops/sspec.py),
-# the scattered-image / arc-profile interpolation as coalesced gathers
+# the structure-aware transform lowerings of ops/xfft.py (real-input
+# Wiener–Khinchin ACF, halved secondary-spectrum power, real sspec→
+# ACF forward — each vs its dense complex oracle), the
+# scattered-image / arc-profile interpolation as coalesced gathers
 # vs MXU tent/Keys matmuls (ops/scatim.py, ops/normsspec.py), the θ-θ
 # eigensolver as a VMEM Pallas squaring kernel vs the XLA warm-start
 # η-scan vs a cold power iteration (thth/batch.py, thth/retrieval.py),

@@ -74,6 +74,7 @@ PROBE_MODULES = (
     "scintools_tpu.ops.normsspec",
     "scintools_tpu.ops.fitarc_device",
     "scintools_tpu.ops.scale",
+    "scintools_tpu.ops.xfft",
     "scintools_tpu.fit.acf2d",
     "scintools_tpu.fit.batch",
     "scintools_tpu.thth.core",

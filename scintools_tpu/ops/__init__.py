@@ -1,6 +1,9 @@
 """Array-transform kernels: secondary spectra, ACFs, windows,
-rescaling, normalised sspec, arc fitting, inpainting."""
+rescaling, normalised sspec, arc fitting, inpainting — FFT-shaped
+kernels declare their structure to the transform layer
+(:mod:`~scintools_tpu.ops.xfft`)."""
 
+from . import xfft
 from .sspec import secondary_spectrum, secondary_spectrum_power
 from .acf import autocovariance, acf_from_sspec, autocorr_direct
 from .windows import get_window
@@ -10,4 +13,4 @@ from .inpaint import inpaint_biharmonic
 
 __all__ = ["secondary_spectrum", "secondary_spectrum_power",
            "autocovariance", "acf_from_sspec", "autocorr_direct", "get_window", "fit_arc", "ArcFit",
-           "normalise_sspec", "inpaint_biharmonic"]
+           "normalise_sspec", "inpaint_biharmonic", "xfft"]

@@ -5,6 +5,13 @@ Functional re-design of ``Dynspec.calc_acf`` (direct method,
 ``fft2 → |·|² → ifft2 → fftshift``, normalised to peak. The slow
 O(N^4) direct autocorrelation (scint_utils.py:67-84) is kept in
 tests as the oracle.
+
+The transform core routes through the structure-aware layer
+(ops/xfft.py): the input is declared REAL, so the default
+``'xfft.acf'`` formulation computes the Wiener–Khinchin round trip
+as ``rfft2 → |·|² → irfft2`` — the discarded Hermitian half is never
+computed and the inverse is real — with the complex ``fft2/ifft2``
+path kept as the dense parity oracle.
 """
 
 from __future__ import annotations
@@ -12,13 +19,18 @@ from __future__ import annotations
 import numpy as np
 
 from ..backend import get_xp, resolve_backend
+from . import xfft
 
 
-def autocovariance(dyn, normalise=True, mean_sub=True, backend=None):
+def autocovariance(dyn, normalise=True, mean_sub=True, backend=None,
+                   variant=None):
     """2-D ACF of ``dyn[..., nf, nt]`` → shape (..., 2*nf, 2*nt).
 
     Batch dimensions vmap/broadcast transparently (the FFTs act on the
-    last two axes).
+    last two axes). ``variant=None`` resolves the ``'xfft.acf'``
+    formulation (backend.py registry): ``'real'`` is the declared
+    real-input Wiener–Khinchin lowering, ``'dense'`` the complex
+    oracle (bit-identical to the pre-layer formulation).
     """
     backend = resolve_backend(backend)
     xp = get_xp(backend)
@@ -32,25 +44,36 @@ def autocovariance(dyn, normalise=True, mean_sub=True, backend=None):
         nvalid = xp.sum(finite, axis=(-2, -1), keepdims=True)
         mean = xp.sum(dyn0, axis=(-2, -1), keepdims=True) / nvalid
         dyn = xp.where(finite, dyn - mean, 0.0)
-    arr = xp.fft.fft2(dyn, s=(2 * nf, 2 * nt))
-    arr = xp.abs(arr) ** 2
-    arr = xp.fft.ifft2(arr)
-    arr = xp.fft.fftshift(arr, axes=(-2, -1))
-    arr = arr.real
+    p = xfft.plan((nf, nt), (2 * nf, 2 * nt), real_input=True,
+                  layout="shifted", op="xfft.acf")
+    arr = p.acf(dyn, xp=xp, variant=variant)
     if normalise:
         arr = arr / xp.max(arr, axis=(-2, -1), keepdims=True)
     return arr
 
 
-def acf_from_sspec(sspec_db, normalise=True, backend=None):
+def acf_from_sspec(sspec_db, normalise=True, backend=None,
+                   variant=None):
     """ACF via the secondary spectrum ('sspec' method,
     dynspec.py:3798-3807). ``sspec_db`` must be the full-frame (not
-    halved) spectrum in dB."""
+    halved) spectrum in dB.
+
+    The linear-power frame is REAL, so ``variant=None`` (the
+    ``'xfft.acf_sspec'`` formulation) lowers the forward transform to
+    a half-spectrum ``rfft2`` + Hermitian completion (ops/xfft.py);
+    ``'dense'`` keeps the complex ``fft2`` as the parity oracle."""
+    from ..backend import formulation
+
     backend = resolve_backend(backend)
     xp = get_xp(backend)
     s = xp.fft.fftshift(xp.asarray(sspec_db))
-    arr = xp.fft.fft2(10 ** (s / 10))
-    arr = xp.fft.fftshift(arr).real
+    lin = 10 ** (s / 10)
+    if variant is None:
+        variant = formulation("xfft.acf_sspec")
+    p = xfft.plan(lin.shape, real_input=True, layout="shifted")
+    arr = p.forward(lin, xp=xp,
+                    variant="rfft" if variant == "real" else "fft2")
+    arr = arr.real
     if normalise:
         arr = arr / xp.max(arr)
     return arr

@@ -16,6 +16,7 @@ import numpy as np
 
 from ..backend import get_xp, register_formulation, resolve_backend
 from ..backend import formulation as _formulation
+from . import xfft
 from .windows import get_window, apply_window
 
 # formulation table (backend.py registry): the chunk conjugate
@@ -55,13 +56,20 @@ def _prewhite_diff(dyn):
 
 
 def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
-                             halve=True, backend=None):
+                             halve=True, backend=None, variant=None):
     """Linear-power secondary spectrum of ``dyn[nf, nt]``.
 
     window_arrays: optional (chan_window[nt], subint_window[nf]) from
     :func:`get_window`; None to skip windowing.
 
     Returns power (not dB) with shape (nrfft//2 if halve else nrfft, ncfft).
+
+    ``variant=None`` resolves the ``'xfft.sspec'`` formulation
+    (backend.py registry): ``'half'`` declares the real input and the
+    ``halve`` row crop to the transform layer so only the kept half
+    of the spectrum is ever computed (ops/xfft.py); ``'dense'`` is
+    the full complex-fft2 oracle (parity rtol-pinned in
+    tests/test_xfft.py).
     """
     backend = resolve_backend(backend)
     xp = get_xp(backend)
@@ -79,11 +87,16 @@ def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
             raise RuntimeError("Cannot apply prewhite to full frame")
         dyn = _prewhite_diff(dyn)
 
-    simf = xp.fft.fft2(dyn, s=(nrfft, ncfft))
-    simf = (simf * xp.conj(simf)).real
-    sec = xp.fft.fftshift(simf)
-    if halve:
-        sec = sec[nrfft // 2:]
+    # declared structure (ops/xfft.py): real input, zero-pad to the
+    # FFT frame, and — when halving — the row crop nrfft//2 folded
+    # INTO the transform, so on the 'half' formulation the discarded
+    # half of the spectrum is never computed. 'dense' (the
+    # pre-layer fft2 → |·|² → fftshift → crop) stays the oracle; the
+    # full-frame (halve=False) output always takes it.
+    p = xfft.plan((nf, nt), (nrfft, ncfft), real_input=True,
+                  crop=(nrfft // 2, None) if halve else None,
+                  layout="shifted", op="xfft.sspec")
+    sec = p.power(dyn, xp=xp, variant=variant)
 
     if prewhite:  # post-darken
         fd = np.arange(-ncfft // 2, ncfft // 2)
@@ -115,18 +128,10 @@ def pad_chunk_batch(dspecs, npad, xp=np):
                   ((0, 0), (0, npad * nf), (0, npad * nt))) + mu
 
 
-def _full_from_rfft2(H, n2, xp=np):
-    """Reconstruct the FULL 2-D spectrum of a real input from its
-    ``rfft2`` half ``H[..., n1, n2//2+1]`` via Hermitian symmetry:
-    ``F[k1, k2] = conj(F[(-k1) % n1, n2 - k2])`` for the missing
-    columns ``k2 = n2//2+1 .. n2-1``. Pure gather + conj — jits,
-    vmaps, and works for odd and even ``n2``."""
-    n1 = H.shape[-2]
-    m = H.shape[-1]                       # n2 // 2 + 1
-    # columns still needed: k2 = m .. n2-1  →  n2-k2 = n2-m .. 1
-    idx1 = (-np.arange(n1)) % n1          # negate the k1 axis
-    tail = xp.conj(H[..., idx1, 1:n2 - m + 1][..., ::-1])
-    return xp.concatenate([H, tail], axis=-1)
+# the Hermitian completion moved into the transform layer
+# (ops/xfft.py — the shared real-input lowering); this alias keeps
+# the historical name importable for its pre-layer call sites
+_full_from_rfft2 = xfft.hermitian_full_from_half
 
 
 def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
@@ -148,7 +153,8 @@ def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
     formulation registry (``backend.formulation('ops.cs')`` — 'rfft'
     everywhere unless overridden). ``method="rfft"`` exploits the
     chunks being REAL: a half-spectrum ``rfft2`` plus a
-    Hermitian-symmetry gather (:func:`_full_from_rfft2`) replaces the
+    Hermitian-symmetry gather (ops/xfft.py
+    :func:`~scintools_tpu.ops.xfft.hermitian_full_from_half`) replaces the
     full complex ``fft2`` — roughly half the FFT flops of the
     dominant kernel in the staged sspec_thth path, with
     bit-level-close output (parity rtol-pinned in tests/test_ops.py).
@@ -169,18 +175,16 @@ def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
                          "when shift=False")
     if method is None:
         method = _formulation("ops.cs")
-    padded = pad_chunk_batch(dspecs, npad, xp=xp)
-    real_input = not np.issubdtype(
-        np.dtype(getattr(padded, "dtype", np.float64)),
-        np.complexfloating)
-    if method == "rfft" and real_input:
-        n2 = padded.shape[-1]
-        CS = _full_from_rfft2(xp.fft.rfft2(padded), n2, xp=xp)
-    elif method in ("rfft", "fft2"):
-        CS = xp.fft.fft2(padded)
-    else:
+    if method not in ("rfft", "fft2"):
         raise ValueError(f"unknown conjugate-spectrum method "
                          f"{method!r} (want 'rfft' or 'fft2')")
+    padded = pad_chunk_batch(dspecs, npad, xp=xp)
+    # declared structure (ops/xfft.py): the padded chunks are REAL
+    # (complex wavefield chunks auto-fall-back to the dense oracle
+    # inside the layer), so 'rfft' lowers to the half-spectrum rfft2
+    # + Hermitian completion — bit-identical to the pre-layer
+    # formulation (pinned in tests/test_xfft.py)
+    CS = xfft.fft2_full(padded, variant=method, xp=xp)
     if not shift:
         return CS
     CS = xp.fft.fftshift(CS, axes=(-2, -1))
@@ -192,11 +196,12 @@ def chunk_conjugate_spectrum_batch(dspecs, npad=3, tau_keep=None,
 
 def secondary_spectrum(dyn, dt, df, window="hanning", window_frac=0.1,
                        prewhite=False, halve=True, dlam=None, db=True,
-                       backend=None):
+                       backend=None, variant=None):
     """Full sspec pipeline → (fdop [mHz], yaxis, sec[dB]).
 
     yaxis is beta [m^-1] when ``dlam`` is given (wavelength-rescaled
-    input), else tdel [us].
+    input), else tdel [us]. ``variant`` routes the transform-layer
+    formulation (see :func:`secondary_spectrum_power`).
     """
     backend = resolve_backend(backend)
     xp = get_xp(backend)
@@ -206,7 +211,7 @@ def secondary_spectrum(dyn, dt, df, window="hanning", window_frac=0.1,
         wins = get_window(nt, nf, window=window, frac=window_frac)
     sec = secondary_spectrum_power(dyn, window_arrays=wins,
                                    prewhite=prewhite, halve=halve,
-                                   backend=backend)
+                                   backend=backend, variant=variant)
     if db:
         with np.errstate(divide="ignore"):
             sec = 10 * xp.log10(sec)

@@ -72,6 +72,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..backend import get_jax, register_formulation, formulation
+from ..ops import xfft
 from ..robust.guards import BAD_INPUT
 from .simulation import hermitian_fill
 
@@ -251,10 +252,10 @@ def build_scenario_fn(ns=128, nf=128, dlam=0.25, rf=1.0, ds=0.01,
     q2y = jnp.asarray(
         ffcony * np.minimum(np.arange(ny), ny - np.arange(ny))
         .astype(float) ** 2, dtype=fdt)
-    # column-extraction phase: g = fft(fy * GPH)/ny projects the
-    # filtered axis-1 inverse transform onto the sampled column
-    GPH = jnp.asarray(
-        np.exp(2j * np.pi * np.arange(ny) * column / ny), dtype=cdt)
+    # column-extraction phase (ops/xfft.py separable-kernel
+    # property): g = fft(fy * GPH)/ny projects the filtered axis-1
+    # inverse transform onto the sampled column
+    GPH = jnp.asarray(xfft.column_phase(ny, column), dtype=cdt)
     SCALES = jnp.asarray(scales_np, dtype=fdt)
     if nf > 1:
         diffs = np.diff(scales_np)
@@ -345,15 +346,15 @@ def build_scenario_fn(ns=128, nf=128, dlam=0.25, rf=1.0, ds=0.01,
         return phi.astype(fdt)
 
     def project_column(E, s):
-        """ifft2(fft2(E) * exp(-i q2 s))[:, :, col] via the rank-1
-        separability of the Fresnel filter: one (nx, ny) matvec and
-        two length-nx transforms — no 2-D FFT (module docstring)."""
+        """ifft2(fft2(E) * exp(-i q2 s))[:, :, col] via the declared
+        rank-1 separability of the Fresnel filter (ops/xfft.py
+        ``separable_kernel`` lowering): one (nx, ny) matvec and two
+        length-nx transforms — no 2-D FFT (module docstring).
+        Bit-identical to the pre-layer inline formulation (pinned in
+        tests/test_xfft.py)."""
         fy = jnp.exp(-1j * (q2y * s).astype(fdt)).astype(cdt)
-        g = jnp.fft.fft(fy * GPH) / ny
-        v = E @ g                                     # (G, nx)
         fx = jnp.exp(-1j * (q2x * s).astype(fdt)).astype(cdt)
-        return jnp.fft.ifft(fx[None] * jnp.fft.fft(v, axis=-1),
-                            axis=-1)
+        return xfft.separable_filter_column(E, fx, fy, GPH, xp=jnp)
 
     def propagate_group(xyp):
         """Phase screens (G, nx, ny) → complex field column

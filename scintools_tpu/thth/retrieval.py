@@ -25,6 +25,7 @@ from .search import chunk_conjugate_spectrum
 from ..backend import get_jax, register_formulation
 # imported at module level so the 'ops.cs' formulation table is
 # registered before any retrieval entry resolves it
+from ..ops import xfft
 from ..ops.sspec import chunk_conjugate_spectrum_batch
 from ..utils import slog
 
@@ -469,23 +470,15 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
         rr = jnp.asarray(shift_tau)[ti]
         cc = jnp.asarray(shift_fd)[fd_inv % nfd]
         if cs_method == "rfft":
-            # pruned padded rfft2: mean-padding is zeropad(x-µ)+µ and
-            # the FFT of the constant µ-canvas is a pure DC term, so
-            # (a) the axis-1 rfft runs on the nf data rows only (the
-            # zero rows transform to zero — appended, not computed),
-            # (b) µ re-enters as one scalar at H[0,0]. Exact up to
-            # f32 rounding; ~(1+npad)× less axis-1 FFT work.
-            mu = jnp.mean(chunk)
-            r1 = jnp.fft.rfft(chunk - mu, n=nfd, axis=1)
-            r1 = jnp.pad(r1, ((0, npad * nf_chunk), (0, 0)))
-            H = jnp.fft.fft(r1, axis=0)
-            H = H.at[0, 0].add(mu * ntau * nfd)
-            m = nfd // 2 + 1
-            tail = cc >= m
-            # full[r, c] = conj(H[(-r) % ntau, nfd - c]) for c >= m
-            v = H[jnp.where(tail, (ntau - rr) % ntau, rr),
-                  jnp.where(tail, nfd - cc, cc)]
-            vals = jnp.where(tail, jnp.conj(v), v)
+            # declared structure (ops/xfft.py): real input + mean-pad
+            # lowers to the pruned padded half spectrum — the axis-1
+            # rfft runs on the nf data rows only and µ re-enters as
+            # one DC scalar — and the Hermitian tail is folded into
+            # the gather's index map (the full complex CS never
+            # materialises). Bit-identical to the pre-layer inline
+            # formulation (pinned in tests/test_xfft.py).
+            H = xfft.pruned_meanpad_half(chunk, (ntau, nfd), xp=jnp)
+            vals = xfft.hermitian_half_gather(H, nfd, rr, cc, xp=jnp)
             cs_ok = jnp.all(jnp.isfinite(jnp.real(H))
                             & jnp.isfinite(jnp.imag(H)))
         else:
@@ -520,12 +513,12 @@ def make_chunk_retrieval_fn(nf_chunk, nt_chunk, dt, df, n_edges,
             ththE[None], cents, eta, valid, tau, fd, dtau, dfd,
             ntau, nfd, jnp, row_map=jnp.asarray(unshift_tau),
             col_map=jnp.asarray(unshift_fd))[0]
-        # ifft2 split per axis with the row crop in between: only
-        # nf_chunk of the (1+npad)·nf output rows survive, so the
-        # second transform runs on 1/(1+npad) of the rows — exact,
-        # the crop commutes with the remaining per-row transform
-        E = jnp.fft.ifft(recov, axis=0)[:nf_chunk]
-        E = jnp.fft.ifft(E, axis=1)[:, :nt_chunk]
+        # declared cropped output (ops/xfft.py): the ifft2 splits per
+        # axis with the row crop folded in between — only nf_chunk of
+        # the (1+npad)·nf output rows survive, so the second
+        # transform runs on 1/(1+npad) of the rows (exact, the crop
+        # commutes with the remaining per-row transform)
+        E = xfft.ifft2_cropped(recov, (nf_chunk, nt_chunk), xp=jnp)
         E = E * (nf_chunk * nt_chunk / 4)
         return jnp.nan_to_num(E)
 

@@ -95,6 +95,28 @@ class TestUnifiedGate:
             assert rep.packages.get(pkg, 0) > 0, (
                 f"no files scanned in package {pkg!r}: "
                 f"{rep.packages}")
+        # ISSUE 12: ops/ grew the transform layer (xfft.py) — pin the
+        # package floor so a scan that silently dropped new modules
+        # cannot stay green
+        assert rep.packages.get("ops", 0) >= 13, rep.packages
+
+    def test_xfft_module_scanned_clean_and_program_audited(self):
+        """ISSUE 12 satellite: the transform layer is inside every
+        scan scope (syncpoints / import-jit / obs-events / retrace-
+        hazard all walk it) with zero unexplained findings, and its
+        two cached program sites are discovered statically and pass
+        the JP2xx audit against the committed baseline."""
+        rep = jaxlint_run([os.path.join(PKG, "ops", "xfft.py")],
+                          config=Config(repo_root=REPO))
+        assert rep.files_scanned == 1
+        assert rep.packages.get("ops") == 1
+        assert rep.findings == [], [
+            f"{f.rel}:{f.line}: [{f.rule}] {f.message}"
+            for f in rep.findings]
+        from scintools_tpu.obs import programs
+
+        sites = set(programs.probes())
+        assert {"xfft.acf", "xfft.sspec"} <= sites
 
     def test_each_file_parsed_exactly_once(self):
         """The framework's whole point: one ast.parse per file per

@@ -1,0 +1,315 @@
+"""Structure-aware transform layer (ops/xfft.py, ISSUE 12).
+
+Two families:
+
+- **bit-identity** — the lowerings the migrated call sites now declare
+  (sspec conjugate spectrum, retrieval pruned mean-pad forward + split
+  cropped inverse, factory separable column projection) reproduce
+  their pre-layer inline op sequences EXACTLY (assert_array_equal:
+  the layer re-orders nothing, so the acceptance bit-identity is
+  structural, not approximate);
+- **formulation parity** — each declared-structure lowering vs its
+  dense complex oracle across odd shapes, f32/f64, batched and
+  jitted (the ops.cs rfft-vs-fft2 tests in test_ops.py are the
+  template), plus a retrace pin that a same-shape re-plan never
+  rebuilds (the JL101 per-call jit-wrapper trap).
+"""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.backend import set_formulation
+from scintools_tpu.ops import xfft
+from scintools_tpu.ops.acf import acf_from_sspec, autocovariance
+from scintools_tpu.ops.sspec import (chunk_conjugate_spectrum_batch,
+                                     fft_shapes, pad_chunk_batch,
+                                     secondary_spectrum_power)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12)
+
+
+def _rel_close(a, b, rtol, xp=np):
+    scale = np.max(np.abs(np.asarray(b)))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=rtol, atol=rtol * scale)
+
+
+class TestBitIdentity:
+    """The three migrated bespoke sites: layer lowering ==
+    pre-layer inline formulation, bitwise."""
+
+    def test_cs_full_spectrum_bit_identical(self, rng):
+        """sspec CS: fft2_full('rfft') == the pre-layer
+        rfft2 + Hermitian-gather sequence, and the dense oracle ==
+        plain fft2 — on odd AND even trailing sizes."""
+        for shape in [(2, 16, 12), (3, 15, 13)]:
+            d = rng.standard_normal(shape)
+            padded = pad_chunk_batch(d, 1)
+            n2 = padded.shape[-1]
+            # pre-layer inline formulation (ops/sspec.py as of PR 11)
+            H = np.fft.rfft2(padded)
+            n1, m = H.shape[-2], H.shape[-1]
+            idx1 = (-np.arange(n1)) % n1
+            tail = np.conj(H[..., idx1, 1:n2 - m + 1][..., ::-1])
+            want = np.concatenate([H, tail], axis=-1)
+            got = xfft.fft2_full(padded, variant="rfft")
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_array_equal(
+                xfft.fft2_full(padded, variant="fft2"),
+                np.fft.fft2(padded))
+
+    def test_pruned_meanpad_half_bit_identical(self, rng):
+        """Retrieval forward: pruned_meanpad_half == the pre-layer
+        inline mu/rfft/pad/fft/DC sequence."""
+        nf, nt, npad = 12, 10, 3
+        ntau, nfd = (1 + npad) * nf, (1 + npad) * nt
+        chunk = rng.standard_normal((nf, nt))
+        mu = np.mean(chunk)
+        r1 = np.fft.rfft(chunk - mu, n=nfd, axis=1)
+        r1 = np.pad(r1, ((0, npad * nf), (0, 0)))
+        want = np.fft.fft(r1, axis=0)
+        want[0, 0] += mu * ntau * nfd
+        got = xfft.pruned_meanpad_half(chunk, (ntau, nfd))
+        np.testing.assert_array_equal(got, want)
+
+    def test_pruned_meanpad_half_matches_dense_meanpad(self, rng):
+        """...and equals the half columns of fft2(mean-padded) to
+        rounding (the mean-pad = zeropad(x−µ) + DC identity)."""
+        chunk = rng.standard_normal((9, 11))
+        full = np.fft.fft2(pad_chunk_batch(chunk[None], 2)[0])
+        got = xfft.pruned_meanpad_half(chunk, full.shape)
+        m = full.shape[1] // 2 + 1
+        _rel_close(got, full[:, :m], 1e-12)
+
+    def test_hermitian_half_gather_reads_full_spectrum(self, rng):
+        x = rng.standard_normal((14, 9))
+        H = np.fft.rfft2(x)
+        full = np.fft.fft2(x)
+        rows = np.repeat(np.arange(14), 9)
+        cols = np.tile(np.arange(9), 14)
+        got = xfft.hermitian_half_gather(H, 9, rows, cols)
+        _rel_close(got, full[rows, cols], 1e-12)
+
+    def test_ifft2_cropped_split_bit_identical(self, rng):
+        """Retrieval inverse: split-with-crop == the pre-layer inline
+        per-axis sequence, and ≈ the dense ifft2-then-crop oracle."""
+        X = (rng.standard_normal((24, 20))
+             + 1j * rng.standard_normal((24, 20)))
+        want = np.fft.ifft(X, axis=0)[:6]
+        want = np.fft.ifft(want, axis=1)[:, :5]
+        got = xfft.ifft2_cropped(X, (6, 5))
+        np.testing.assert_array_equal(got, want)
+        dense = xfft.ifft2_cropped(X, (6, 5), variant="dense")
+        _rel_close(got, dense, 1e-12)
+
+    def test_separable_filter_column_bit_identical(self, rng):
+        """Factory propagation: separable_filter_column == the
+        pre-layer inline g/matvec/round-trip sequence, and ≈ the
+        dense ifft2(fft2·filter) column oracle."""
+        G, nx, ny, col = 3, 16, 16, 8
+        E = (rng.standard_normal((G, nx, ny))
+             + 1j * rng.standard_normal((G, nx, ny))).astype(complex)
+        fx = np.exp(-1j * rng.uniform(0, 2, nx))
+        fy = np.exp(-1j * rng.uniform(0, 2, ny))
+        gph = xfft.column_phase(ny, col)
+        # pre-layer inline formulation (sim/factory.py as of PR 11)
+        g = np.fft.fft(fy * gph) / ny
+        v = E @ g
+        want = np.fft.ifft(fx[None] * np.fft.fft(v, axis=-1),
+                           axis=-1)
+        got = xfft.separable_filter_column(E, fx, fy, gph)
+        np.testing.assert_array_equal(got, want)
+        dense = np.fft.ifft2(
+            np.fft.fft2(E) * (fx[:, None] * fy[None, :])[None]
+        )[:, :, col]
+        _rel_close(got, dense, 1e-10)
+
+    def test_column_phase_matches_inline(self):
+        ny, col = 32, 16
+        np.testing.assert_array_equal(
+            xfft.column_phase(ny, col),
+            np.exp(2j * np.pi * np.arange(ny) * col / ny))
+
+
+class TestFormulationParity:
+    """Declared-structure lowering vs dense complex oracle: odd
+    shapes, f32/f64, batched and jitted."""
+
+    @pytest.mark.parametrize("shape", [(16, 12), (17, 13), (9, 21)])
+    @pytest.mark.parametrize("dtype,rtol", [(np.float64, 1e-10),
+                                            (np.float32, 2e-5)])
+    def test_wiener_khinchin_real_vs_dense(self, rng, shape, dtype,
+                                           rtol):
+        x = rng.standard_normal(shape).astype(dtype)
+        pad = (2 * shape[0], 2 * shape[1])
+        real = xfft.wiener_khinchin(x, pad, variant="real")
+        dense = xfft.wiener_khinchin(x, pad, variant="dense")
+        _rel_close(real, dense, rtol)
+
+    @pytest.mark.parametrize("shape", [(16, 12), (15, 13)])
+    def test_autocovariance_variants_batched_jax_jit(self, rng,
+                                                     shape):
+        import jax
+        import jax.numpy as jnp
+
+        d = rng.standard_normal((3,) + shape).astype(np.float32)
+
+        def acf(v):
+            return jax.jit(lambda a: autocovariance(
+                a, backend="jax", variant=v))(jnp.asarray(d))
+
+        _rel_close(acf("real"), acf("dense"), 2e-5)
+        # and numpy == jax to f32 tolerance on the declared path
+        _rel_close(acf("real"),
+                   autocovariance(d, backend="numpy",
+                                  variant="real"), 2e-5)
+
+    def test_autocovariance_masked_input_parity(self, rng):
+        """Non-finite pixels are mean-masked BEFORE the layer; both
+        formulations must agree on the masked frame."""
+        d = rng.standard_normal((12, 14))
+        d[3, 4] = np.nan
+        _rel_close(autocovariance(d, backend="numpy", variant="real"),
+                   autocovariance(d, backend="numpy",
+                                  variant="dense"), 1e-10)
+
+    @pytest.mark.parametrize("shape", [(32, 48), (31, 47), (9, 21)])
+    def test_sspec_half_vs_dense_linear_power(self, rng, shape):
+        dyn = rng.standard_normal(shape)
+        half = secondary_spectrum_power(dyn, backend="numpy",
+                                        variant="half")
+        dense = secondary_spectrum_power(dyn, backend="numpy",
+                                         variant="dense")
+        assert half.shape == dense.shape \
+            == (fft_shapes(*shape)[0] // 2, fft_shapes(*shape)[1])
+        _rel_close(half, dense, 1e-10)
+
+    def test_sspec_half_vs_dense_jax_jit_prewhite(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        dyn = rng.standard_normal((16, 24)).astype(np.float32)
+
+        def sec(v):
+            return jax.jit(lambda d: secondary_spectrum_power(
+                d, backend="jax", prewhite=True, variant=v))(
+                    jnp.asarray(dyn))
+
+        _rel_close(sec("half"), sec("dense"), 2e-4)
+
+    def test_sspec_full_frame_ignores_half_variant(self, rng):
+        """halve=False has no declared crop — both variants take the
+        dense full-frame path, bitwise equal."""
+        dyn = rng.standard_normal((16, 12))
+        np.testing.assert_array_equal(
+            secondary_spectrum_power(dyn, halve=False,
+                                     backend="numpy",
+                                     variant="half"),
+            secondary_spectrum_power(dyn, halve=False,
+                                     backend="numpy",
+                                     variant="dense"))
+
+    @pytest.mark.parametrize("shape", [(32, 32), (17, 23)])
+    def test_acf_from_sspec_real_vs_dense(self, rng, shape):
+        sec_db = 10 * np.log10(np.abs(rng.standard_normal(shape))
+                               + 0.1)
+        _rel_close(acf_from_sspec(sec_db, backend="numpy",
+                                  variant="real"),
+                   acf_from_sspec(sec_db, backend="numpy",
+                                  variant="dense"), 1e-10)
+
+    def test_complex_input_falls_back_to_dense(self, rng):
+        xc = (rng.standard_normal((8, 8))
+              + 1j * rng.standard_normal((8, 8)))
+        np.testing.assert_array_equal(
+            xfft.fft2_full(xc, variant="rfft"), np.fft.fft2(xc))
+        np.testing.assert_array_equal(
+            xfft.wiener_khinchin(xc, (16, 16), variant="real"),
+            xfft.wiener_khinchin(xc, (16, 16), variant="dense"))
+
+    def test_cs_batch_still_matches_oracle(self, rng):
+        """The migrated chunk CS keeps its historical parity contract
+        (the template test family in test_ops.py)."""
+        d = rng.standard_normal((2, 15, 13))
+        _rel_close(chunk_conjugate_spectrum_batch(d, npad=1,
+                                                  method="rfft"),
+                   chunk_conjugate_spectrum_batch(d, npad=1,
+                                                  method="fft2"),
+                   1e-10)
+
+
+class TestPlanRouting:
+    def test_plan_describe_and_registry_routing(self):
+        p = xfft.plan((16, 12), (32, 24), real_input=True,
+                      layout="shifted", op="xfft.acf")
+        try:
+            set_formulation("xfft.acf", "dense")
+            assert p.variant() == "dense" and not p.structured()
+            assert p.describe()["variant"] == "dense"
+        finally:
+            set_formulation("xfft.acf", None)
+        assert p.variant() == "real" and p.structured()
+        # explicit pin wins over the registry
+        assert p.variant("dense") == "dense"
+        d = p.describe()
+        assert d["real_input"] and d["pad_to"] == [32, 24]
+
+    def test_plan_rejects_unknown_layout(self):
+        with pytest.raises(ValueError, match="layout"):
+            xfft.plan((8, 8), layout="weird")
+
+    def test_variant_override_flips_autocovariance_path(self, rng):
+        """set_formulation('xfft.acf', 'dense') must route the
+        default call onto the oracle (one inspectable table — the
+        PR-7 registry contract)."""
+        d = rng.standard_normal((8, 10))
+        try:
+            set_formulation("xfft.acf", "dense")
+            dense_routed = autocovariance(d, backend="numpy")
+        finally:
+            set_formulation("xfft.acf", None)
+        np.testing.assert_array_equal(
+            dense_routed,
+            autocovariance(d, backend="numpy", variant="dense"))
+
+
+class TestProgramsRetrace:
+    """The cached jitted xfft programs: one build per
+    (shape, variant), zero rebuilds on re-plan (JL101 trap pin)."""
+
+    def test_acf_program_keyed_cache_no_per_call_rebuild(self, rng):
+        from scintools_tpu.obs import retrace
+
+        import jax.numpy as jnp
+
+        d = jnp.asarray(rng.standard_normal((2, 8, 6))
+                        .astype(np.float32))
+        fn = xfft.acf_program(8, 6)
+        np.asarray(fn(d))                       # warm (compile)
+        with retrace.retrace_guard():
+            fn2 = xfft.acf_program(8, 6)        # same-shape re-plan
+            np.asarray(fn2(d))
+        assert fn2 is fn
+        before = retrace.compile_counts().get("xfft.acf", 0)
+        xfft.acf_program(9, 6)                  # new geometry: one
+        after = retrace.compile_counts().get("xfft.acf", 0)
+        assert after == before + 1              # recorded build
+
+    def test_sspec_program_matches_eager_numpy(self, rng):
+        import jax.numpy as jnp
+
+        d = rng.standard_normal((2, 12, 10)).astype(np.float32)
+        fn = xfft.sspec_power_program(12, 10)
+        got = np.asarray(fn(jnp.asarray(d)))
+        want = np.stack([secondary_spectrum_power(
+            x, backend="numpy") for x in d])
+        _rel_close(got, want, 2e-4)
+
+    def test_programs_pin_variant_in_cache_key(self):
+        assert xfft.acf_program(8, 6, variant="real") \
+            is not xfft.acf_program(8, 6, variant="dense")
+        assert xfft.sspec_power_program(12, 10, variant="half") \
+            is not xfft.sspec_power_program(12, 10, variant="dense")
