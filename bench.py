@@ -1585,6 +1585,123 @@ def bench_fleet_survey(jax, jnp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_fleet_plane(jax, jnp):
+    """Config (ISSUE 13): the fleet observability plane under load —
+    the SAME 3-worker scenario pod as `fleet_survey`, run once
+    unscraped and once with the plane serving merged
+    /metrics + /state + /report + /workers to a 1 Hz scraper for the
+    whole run. Records per-endpoint scrape latency (p50/p95), the
+    plane overhead fraction (scraped vs unscraped wall, gate <5%),
+    the scheduler-overhead fraction on the scraped run (the PR-11
+    <10% gate must not regress with the plane on), and the merged
+    Chrome-trace event count (validated). Workers on CPU for the
+    same reason as `fleet_survey`: the plane is host-side machinery;
+    N processes must not share one tunneled accelerator."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from scintools_tpu.obs.trace import validate_chrome_trace
+    from scintools_tpu.sim.scenario import run_scenario_fleet
+
+    kw = dict(epochs_per_regime=48, seed=7, numsteps=1000, n_iter=40)
+    n_epochs = 3 * kw["epochs_per_regime"]
+    batch = 18                              # 8 tasks for 3 workers
+    pod_options = {"lease_s": 30.0,
+                   "worker_env": {"JAX_PLATFORMS": "cpu"}}
+    root = tempfile.mkdtemp(prefix="bench_plane_")
+    record = {"epochs": n_epochs, "batch_size": batch,
+              "scrape_hz": 1.0, "worker_platform": "cpu", "runs": {}}
+    try:
+        walls = {}
+        for label in ("unscraped", "scraped"):
+            wd = os.path.join(root, label)
+            scraped = label == "scraped"
+            lat, errors = [], [0]
+            stop = threading.Event()
+
+            def scrape_loop(wd=wd, lat=lat, errors=errors,
+                            stop=stop):
+                url = None
+                while not stop.wait(1.0):
+                    try:
+                        if url is None:
+                            with open(os.path.join(
+                                    wd, "plane.json")) as fh:
+                                url = json.load(fh)["url"]
+                        for path in ("/metrics", "/state",
+                                     "/report", "/workers"):
+                            t0 = time.perf_counter()
+                            with urllib.request.urlopen(
+                                    url + path, timeout=10) as r:
+                                r.read()
+                            lat.append(time.perf_counter() - t0)
+                    except Exception:  # noqa: BLE001 — the pod may
+                        # not have started (or already finished);
+                        # the scraper just keeps trying
+                        errors[0] += 1
+
+            scraper = threading.Thread(target=scrape_loop,
+                                       daemon=True)
+            if scraped:
+                scraper.start()
+            t0 = time.perf_counter()
+            try:
+                out = run_scenario_fleet(
+                    wd, n_workers=3, batch_size=batch,
+                    timeout=900.0, pod_options=dict(pod_options),
+                    plane_port=0 if scraped else None, **kw)
+            finally:
+                stop.set()
+            if scraped:
+                scraper.join(timeout=15)
+            walls[label] = wall = time.perf_counter() - t0
+            fleet = out["fleet"]
+            busy = sum(float(st.get("busy_s") or 0.0)
+                       for st in fleet["workers"].values())
+            qops = sum(float(st.get("queue_op_s") or 0.0)
+                       for st in fleet["workers"].values())
+            run_rec = {
+                "wall_s": round(wall, 2),
+                "epochs_per_sec": round(n_epochs / wall, 2),
+                "ok": out["summary"]["n_ok"],
+                "steals": fleet["steals"],
+                "sched_overhead_frac": round(
+                    (qops + fleet["merge"]["merge_s"]) / busy, 4)
+                if busy else None,
+            }
+            if scraped:
+                lat_s = sorted(lat)
+                run_rec["scrapes"] = len(lat)
+                run_rec["scrape_errors"] = errors[0]
+                if lat_s:
+                    run_rec["scrape_p50_ms"] = round(
+                        lat_s[len(lat_s) // 2] * 1e3, 2)
+                    run_rec["scrape_p95_ms"] = round(
+                        lat_s[int(len(lat_s) * 0.95)
+                              - 1] * 1e3, 2)
+                trace = fleet.get("trace") or {}
+                run_rec["merged_trace_events"] = trace.get("events")
+                with open(os.path.join(
+                        wd, "trace.merged.json")) as fh:
+                    validate_chrome_trace(json.load(fh))
+                run_rec["merged_trace_valid"] = True
+            record["runs"][label] = run_rec
+        overhead = (walls["scraped"] - walls["unscraped"]) \
+            / walls["unscraped"]
+        record["plane_overhead_frac"] = round(overhead, 4)
+        # gates: plane cost <5% of wall; the PR-11 scheduler gate
+        # (<10%) unregressed with the plane on
+        record["plane_overhead_ok"] = bool(overhead < 0.05)
+        sched = record["runs"]["scraped"]["sched_overhead_frac"]
+        record["sched_overhead_ok"] = bool(sched is not None
+                                           and sched < 0.10)
+        return record
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_survey(jax, jnp):
     """Config #5: survey epochs/sec — sspec + full acf1d LM fit per
     epoch, sharded/batched (ref survey loop dynspec.py:4357 + per-epoch
@@ -2152,6 +2269,7 @@ _EST_S = {
     # fleet workers always run on CPU (scheduler overhead is a
     # host-side quantity; N processes must not share one tunnel)
     "fleet_survey":  {"acc": 240, "cpu": 240},
+    "fleet_plane":   {"acc": 200, "cpu": 200},
     "robust":        {"acc": 60,  "cpu": 60},
     "acf_fit":       {"acc": 60,  "cpu": 60},
     "acf2d":         {"acc": 150, "cpu": 60},
@@ -2291,6 +2409,7 @@ def main():
         ("sim_factory", bench_sim_factory),
         ("scenario_loop", bench_scenario_loop),
         ("fleet_survey", bench_fleet_survey),
+        ("fleet_plane", bench_fleet_plane),
         ("robust", bench_robust_survey),
         ("acf_fit", bench_acf_fit),
         ("acf2d", bench_acf2d_fit),

@@ -21,7 +21,12 @@ survivable and any host sharing the queue directory can join.
   regardless of which worker ran which epoch);
 - :mod:`.pod` — the coordinator: seeds the queue, launches/monitors
   local worker processes, aggregates heartbeats + metrics into
-  pod-level gauges, merges, and emits one merged RunReport.
+  pod-level gauges, merges, and emits one merged RunReport;
+- :mod:`.telemetry` — the pod's live observability-plane view
+  (ISSUE 13): incremental journal tails, the cross-worker /state
+  union with live conflict detection, and the one-port merged
+  ``/metrics``/``/state``/``/report``/``/workers`` surface
+  (obs/plane.py) started via ``Pod(plane_port=...)``.
 
 The proving workload is the closed-loop scenario survey
 (``sim/scenario.py:run_scenario_fleet``). Operator docs:
@@ -31,6 +36,8 @@ docs/fleet.md.
 from .merge import ATTRIBUTION_FIELDS, merge_journals, merge_records
 from .pod import Pod, run_pod
 from .queue import Task, WorkQueue, claim_by_rename
+from .telemetry import (FleetStateTracker, JournalTail,
+                        PodTelemetry)
 from .worker import (FleetWorker, demo_workload, resolve_workload,
                      run_worker)
 
@@ -38,5 +45,6 @@ __all__ = [
     "ATTRIBUTION_FIELDS", "merge_journals", "merge_records",
     "Pod", "run_pod",
     "Task", "WorkQueue", "claim_by_rename",
+    "FleetStateTracker", "JournalTail", "PodTelemetry",
     "FleetWorker", "demo_workload", "resolve_workload", "run_worker",
 ]

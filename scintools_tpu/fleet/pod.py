@@ -42,6 +42,7 @@ import time
 from ..obs import heartbeat as _hb
 from ..obs import metrics as _metrics
 from ..obs import report as _report
+from ..obs import trace as _trace
 from ..parallel.checkpoint import atomic_write_json
 from ..robust.runner import EpochOutcome
 from ..utils import slog
@@ -132,7 +133,8 @@ class Pod:
                  batch_size=32, lease_s=15.0, skew_s=2.0,
                  poll_s=0.25, monitor_s=0.2, mode="process",
                  worker_env=None, worker_options=None,
-                 max_recoveries=2, journal_name="journal.merged.jsonl"):
+                 max_recoveries=2, journal_name="journal.merged.jsonl",
+                 plane_port=None, plane_host="127.0.0.1"):
         self.workdir = os.fspath(workdir)
         self.workload_spec = workload
         self.n_workers = int(n_workers)
@@ -167,6 +169,14 @@ class Pod:
         self._queue = WorkQueue(self.queue_root, worker="pod",
                                 lease_s=self.lease_s,
                                 skew_s=self.skew_s)
+        # incremental heartbeat reads (ISSUE 13): one mtime-gated
+        # scanner shared by the monitor loop and the telemetry-plane
+        # handler threads — a tick over unchanged files is stat-only
+        self.heartbeat_scanner = _hb.HeartbeatScanner(
+            os.path.join(self.out_root, "heartbeats"))
+        self.plane_port = plane_port
+        self.plane_host = plane_host
+        self.telemetry = None
 
     # ---- lifecycle --------------------------------------------------
     def tasks(self):
@@ -194,6 +204,21 @@ class Pod:
         atomic_write_json(self._spec_path, spec)
         for i in range(self.n_workers):
             self.workers.append(self._spawn(f"w{i}"))
+        if self.plane_port is not None:
+            from .telemetry import PodTelemetry
+
+            self.telemetry = PodTelemetry(self).start(
+                host=self.plane_host, port=int(self.plane_port))
+            # discovery file: an ephemeral port (plane_port=0) must
+            # be findable by scrapers that only know the workdir
+            atomic_write_json(
+                os.path.join(self.workdir, "plane.json"),
+                {"url": self.telemetry.url,
+                 "host": self.plane_host,
+                 "port": self.telemetry.port})
+            slog.log_event("fleet.plane_start",
+                           url=self.telemetry.url,
+                           workdir=self.workdir)
         return self
 
     def _spawn(self, worker_id):
@@ -224,20 +249,20 @@ class Pod:
     # ---- monitoring -------------------------------------------------
     def heartbeats(self):
         """``{worker_id: record}`` of the last complete heartbeat of
-        every worker that ever wrote one."""
-        hb_dir = os.path.join(self.out_root, "heartbeats")
-        out = {}
-        try:
-            names = sorted(os.listdir(hb_dir))
-        except FileNotFoundError:
-            return out
-        for name in names:
-            if not name.endswith(".json"):
-                continue
-            rec = _hb.read_heartbeat_file(os.path.join(hb_dir, name))
-            if rec is not None:
-                out[name[:-5]] = rec
-        return out
+        every worker that ever wrote one — via the shared
+        mtime-gated scanner, so a monitor tick (or a plane scrape)
+        over unchanged heartbeat files re-reads nothing."""
+        return self.heartbeat_scanner.scan()
+
+    def queue_counts(self):
+        """Live queue counts (pending/claimed/done) — the /state
+        view's queue block."""
+        return self._queue.counts()
+
+    def elapsed_s(self):
+        """Wall seconds since ``start()`` (0.0 before it)."""
+        return 0.0 if self._t0 is None \
+            else time.perf_counter() - self._t0
 
     def poll(self):
         """One monitor pass: pod-level gauges from the queue and the
@@ -301,20 +326,27 @@ class Pod:
         caller does not leak processes)."""
         deadline = time.monotonic() + float(timeout)
         try:
-            while True:
-                counts = self.poll()
-                if counts["pending"] == 0 and counts["claimed"] == 0 \
-                        and not any(w.alive() for w in self.workers):
-                    break
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"fleet run exceeded {timeout}s "
-                        f"(queue counts {counts})")
-                time.sleep(self.monitor_s)
+            try:
+                while True:
+                    counts = self.poll()
+                    if counts["pending"] == 0 \
+                            and counts["claimed"] == 0 \
+                            and not any(w.alive()
+                                        for w in self.workers):
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"fleet run exceeded {timeout}s "
+                            f"(queue counts {counts})")
+                    time.sleep(self.monitor_s)
+            finally:
+                for w in self.workers:
+                    w.kill() if time.monotonic() > deadline \
+                        else w.close()
+            return self._finish()
         finally:
-            for w in self.workers:
-                w.kill() if time.monotonic() > deadline else w.close()
-        return self._finish()
+            if self.telemetry is not None:
+                self.telemetry.close()
 
     # ---- merge + report ---------------------------------------------
     def worker_journals(self):
@@ -330,6 +362,41 @@ class Pod:
                 out.append(p)
         return out
 
+    def worker_trace_spools(self):
+        """``{worker_id: trace.jsonl path}`` of every worker that
+        spooled trace fragments (fleet/worker.py)."""
+        root = os.path.join(self.out_root, "workers")
+        out = {}
+        try:
+            ids = sorted(os.listdir(root))
+        except FileNotFoundError:
+            return out
+        for wid in ids:
+            p = os.path.join(root, wid, "trace.jsonl")
+            if os.path.exists(p):
+                out[wid] = p
+        return out
+
+    def _merge_traces(self):
+        """Merge the per-worker trace fragments into ONE validated
+        Chrome trace next to the merged journal. Trace data is
+        diagnostics: a merge failure is logged, never raised into
+        the survey result."""
+        frags = _trace.load_trace_fragments(self.worker_trace_spools())
+        if not frags:
+            return None
+        path = os.path.join(self.workdir, "trace.merged.json")
+        try:
+            _, stats = _trace.write_merged_trace(
+                path, frags, run_name="scintools_tpu pod")
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            slog.log_failure("fleet.trace_error", stage="trace_merge",
+                             error=e)
+            return {"error": repr(e)[:200]}
+        stats["path"] = path
+        slog.log_event("fleet.trace_merge", **stats)
+        return stats
+
     def _finish(self):
         wall_s = time.perf_counter() - self._t0
         t0 = time.perf_counter()
@@ -342,6 +409,7 @@ class Pod:
         records = EpochJournal(merged_path).records()
         summary, outcomes, results = _pod_tally(self.order, records)
         beats = self.heartbeats()
+        trace_stats = self._merge_traces()
         fleet = {
             "n_workers": self.n_workers,
             "n_tasks": len(self.tasks()),
@@ -354,6 +422,7 @@ class Pod:
             "dead_workers": sorted(self._dead),
             "recoveries": self._recoveries,
             "merge": {**merge_stats, "merge_s": round(merge_s, 4)},
+            "trace": trace_stats,
             "workers": {w: {k: b.get(k) for k in
                             ("tasks", "stolen", "epochs", "n_ok",
                              "n_quarantined", "lease_lost",

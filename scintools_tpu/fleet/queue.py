@@ -124,6 +124,9 @@ class WorkQueue:
         for d in (self.tasks_dir, self.my_claims, self.leases_dir,
                   self.done_dir):
             os.makedirs(d, exist_ok=True)
+        # (holder, task_id) -> first time observed claimed with NO
+        # lease (the _steal_leaseless persistence gate)
+        self._leaseless_seen = {}
 
     # ---- seeding ----------------------------------------------------
     def seed(self, tasks):
@@ -286,6 +289,58 @@ class WorkQueue:
                                now - float(lease.get("expires_t",
                                                      now)), 3))
             return task
+        return self._steal_leaseless()
+
+    def _steal_leaseless(self):
+        """Backstop for claims with NO lease at all: a holder killed
+        in the claim→first-renew window (or whose lease a racing
+        completer dropped) leaves a claim the expiry scan above can
+        never see — wedging the drain forever. A missing lease reads
+        as "immediately reclaimable" (:meth:`read_lease`), but a
+        LIVE fresh claimer is lease-less for the instant between its
+        claim-rename and first renew — so a claim must be observed
+        lease-less across ~a heartbeat period before it is stolen.
+        A mistaken steal in that window still only re-runs work the
+        merge dedupes (the documented err direction)."""
+        now = time.monotonic()
+        grace = max(0.5, self.lease_s / 3.0)
+        live = set()
+        for holder in self._workers():
+            if holder == self.worker:
+                continue               # covered by _reclaim_own
+            for name in self._listing(os.path.join(self.claims_dir,
+                                                   holder)):
+                tid = name[:-5]
+                key = (holder, tid)
+                live.add(key)
+                if self.read_lease(tid) is not None:
+                    self._leaseless_seen.pop(key, None)
+                    continue           # live (or expiry-scannable)
+                first = self._leaseless_seen.setdefault(key, now)
+                if now - first < grace:
+                    continue           # maybe mid-first-renew
+                won = claim_by_rename(
+                    os.path.join(self.claims_dir, holder, name),
+                    self.my_claims)
+                if won is None:
+                    continue           # racer got it first
+                self._leaseless_seen.pop(key, None)
+                task = self._load_task(won, stolen=True,
+                                       stolen_from=holder)
+                if task is None:
+                    continue
+                self.renew(task)
+                _metrics.counter(
+                    "fleet_tasks_stolen_total",
+                    help="expired-lease tasks stolen from other "
+                         "workers").inc()
+                slog.log_event("fleet.steal", worker=self.worker,
+                               task=task.task_id,
+                               stolen_from=holder, lease_age_s=None)
+                return task
+        for key in [k for k in self._leaseless_seen
+                    if k not in live]:
+            del self._leaseless_seen[key]
         return None
 
     def _listing(self, d):
@@ -348,9 +403,21 @@ class WorkQueue:
         """Mark a task done: move its claim file into ``done/`` and
         drop the lease. Returns False when the claim file is gone —
         the lease expired and someone stole the task; this worker's
-        results are still journaled and the merge dedupes."""
+        results are still journaled and the merge dedupes.
+
+        The lease is dropped only when it still names THIS worker
+        (or on actual completion): unconditionally unlinking it on
+        the lost path deleted the NEW holder's live lease — and a
+        claim whose lease vanishes while its holder is mid-crash is
+        unstealable by the expiry scan (the ISSUE-13 wedge; the
+        lease-less steal path below is the backstop)."""
         won = claim_by_rename(task.path, self.done_dir)
-        self._drop_lease(task.task_id)
+        if won is not None:
+            self._drop_lease(task.task_id)
+        else:
+            lease = self.read_lease(task.task_id)
+            if lease is None or lease.get("worker") == self.worker:
+                self._drop_lease(task.task_id)
         if won is None:
             _metrics.counter(
                 "fleet_leases_lost_total",
@@ -370,7 +437,9 @@ class WorkQueue:
         """Put a claimed task back on the queue untouched (graceful
         shutdown mid-claim)."""
         claim_by_rename(task.path, self.tasks_dir)
-        self._drop_lease(task.task_id)
+        lease = self.read_lease(task.task_id)
+        if lease is None or lease.get("worker") == self.worker:
+            self._drop_lease(task.task_id)
 
     def _drop_lease(self, task_id):
         try:

@@ -75,13 +75,18 @@ def resolve_workload(workload):
     return resolved
 
 
-def demo_workload(n_epochs=32, scale=1.0, fail_every=0, slow_s=0.0):
+def demo_workload(n_epochs=32, scale=1.0, fail_every=0, slow_s=0.0,
+                  batch_size=None):
     """Dependency-free deterministic toy workload (fleet plumbing
     tests, multi-process smoke): each epoch's result is a pure
     function of its payload seed, so any worker — or a re-run after a
     steal — produces bit-identical records. ``fail_every`` makes
     every k-th epoch raise (quarantine-path coverage), ``slow_s``
-    models per-epoch compute so tests can hold a task mid-lease."""
+    models per-epoch compute so tests can hold a task mid-lease.
+    ``batch_size`` caps the runner's internal batch WITHIN one task
+    (default: one batch per task) — smaller batches journal, beat,
+    and trace-flush mid-task, which is what makes a SIGKILLed
+    holder's partial progress observable."""
     import numpy as np
 
     def _one(payload):
@@ -106,8 +111,11 @@ def demo_workload(n_epochs=32, scale=1.0, fail_every=0, slow_s=0.0):
         return _one(payload)
 
     epochs = [(f"e{i:05d}", {"seed": i}) for i in range(int(n_epochs))]
-    return {"epochs": epochs, "process_batch": process_batch,
-            "process": process}
+    out = {"epochs": epochs, "process_batch": process_batch,
+           "process": process}
+    if batch_size:
+        out["batch_size"] = int(batch_size)
+    return out
 
 
 class _LeaseBeat(_hb.Heartbeat):
@@ -139,7 +147,8 @@ class FleetWorker:
 
     def __init__(self, queue_root, out_root, workload, worker_id="w0",
                  lease_s=15.0, skew_s=2.0, poll_s=0.25,
-                 heartbeat_s=None, retries=1, max_wall_s=None):
+                 heartbeat_s=None, retries=1, max_wall_s=None,
+                 trace_spool=True):
         self.worker_id = str(worker_id)
         self.out_root = os.fspath(out_root)
         self.queue = WorkQueue(queue_root, worker=self.worker_id,
@@ -163,6 +172,22 @@ class FleetWorker:
                       "busy_s": 0.0}
         self._task = None
         self._beat = _LeaseBeat(self, self.heartbeat_s)
+        # per-worker trace fragment spool (ISSUE 13): every stage
+        # span the runner records is flushed journal-adjacently (on
+        # the heartbeat cadence, so spans survive a SIGKILL up to the
+        # last beat) for the pod's cross-process trace merge
+        # (obs/trace.py:merge_traces). perf_counter spans are shifted
+        # onto the wall clock by a once-sampled anchor so fragments
+        # from different processes share one timeline.
+        self.timeline = None
+        self.trace_path = os.path.join(self.workdir, "trace.jsonl")
+        if trace_spool:
+            from ..utils.profiling import StageTimeline
+
+            self.timeline = StageTimeline()
+        self._trace_anchor = time.time() - time.perf_counter()
+        self._trace_flushed = 0
+        self._trace_ids_flushed = set()
 
     # the journal attribution stamp (see fleet/merge.py): constant
     # worker id + per-record commit instant, appended at line end
@@ -185,6 +210,41 @@ class FleetWorker:
         rec["metrics"] = _metrics.REGISTRY.snapshot() \
             if _metrics.REGISTRY.enabled else None
         _hb.write_heartbeat_file(self.hb_path, **rec)
+        self._flush_trace()
+
+    def _flush_trace(self):
+        """Append spans (and trace-id assignments) recorded since
+        the last flush to the journal-adjacent spool. Id assignments
+        travel as their OWN lines: a loader thread can record a span
+        before the dispatch loop assigns the epoch's trace ID, so
+        binding is resolved at merge time, not flush time. Returns
+        the number of lines written."""
+        if self.timeline is None:
+            return 0
+        spans = self.timeline.spans()
+        new = spans[self._trace_flushed:]
+        ids = self.timeline.trace_ids()
+        new_ids = {e: t for e, t in ids.items()
+                   if e not in self._trace_ids_flushed}
+        if not new and not new_ids:
+            return 0
+        lines = []
+        for epoch, tid in sorted((str(e), t)
+                                 for e, t in new_ids.items()):
+            lines.append(json.dumps(
+                {"worker": self.worker_id, "epoch": epoch,
+                 "trace_id": tid}))
+        for stage, epoch, t0, t1 in new:
+            lines.append(json.dumps(
+                {"worker": self.worker_id, "stage": stage,
+                 "epoch": str(epoch),
+                 "t0": round(t0 + self._trace_anchor, 6),
+                 "t1": round(t1 + self._trace_anchor, 6)}))
+        with open(self.trace_path, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+        self._trace_flushed += len(new)
+        self._trace_ids_flushed.update(new_ids)
+        return len(lines)
 
     def _run_task(self, task):
         from ..robust.runner import _DEFAULT_TIERS, run_survey_batched
@@ -198,11 +258,15 @@ class FleetWorker:
             out = run_survey_batched(
                 task.epochs, self.workload["process_batch"],
                 self.workdir, process=self.workload.get("process"),
-                batch_size=max(1, len(task.epochs)),
+                # one batch per task unless the workload caps it —
+                # smaller batches journal/beat/flush mid-task
+                batch_size=int(self.workload.get("batch_size")
+                               or max(1, len(task.epochs))),
                 tiers=self.workload.get("tiers") or _DEFAULT_TIERS,
                 retries=self.retries,
                 validate=self.workload.get("validate"),
                 heartbeat=self._beat, report=False,
+                timeline=self.timeline,
                 journal_extra=self._journal_extra)
         finally:
             self.stats["busy_s"] += time.perf_counter() - t0

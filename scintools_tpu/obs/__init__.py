@@ -19,24 +19,35 @@ bench-only timing splits):
   summaries/FLOP estimates, and the stable fingerprints the jaxlint
   JP2xx program pass (tools/jaxlint/program.py) gates in tier-1;
 - :mod:`~scintools_tpu.obs.heartbeat` — cadence-gated live progress
-  events for long runs;
+  events for long runs, plus the cross-process file-heartbeat channel
+  with its mtime-gated incremental directory scan
+  (:class:`~scintools_tpu.obs.heartbeat.HeartbeatScanner`);
 - :mod:`~scintools_tpu.obs.report` — the end-of-run ``run_report``
-  artifact (JSON + markdown), schema-validated.
+  artifact (JSON + markdown), schema-validated;
+- :mod:`~scintools_tpu.obs.plane` — the pod-level telemetry plane
+  (ISSUE 13): the streaming per-worker snapshot merger, Prometheus
+  rendering of merged snapshots, and the one-port HTTP surface over
+  a whole fleet (``/metrics`` ``/state`` ``/report`` ``/workers``).
 
 See docs/observability.md for the event catalog, metric names, the
 trace-viewer walkthrough, and the RunReport schema.
 """
 
-from . import (heartbeat, metrics, programs, report,  # noqa: F401
-               retrace, trace)
-from .heartbeat import Heartbeat, as_heartbeat  # noqa: F401
+from . import (heartbeat, metrics, plane, programs,  # noqa: F401
+               report, retrace, trace)
+from .heartbeat import (Heartbeat, HeartbeatScanner,  # noqa: F401
+                        as_heartbeat, scan_heartbeat_dir)
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
-                      MetricsRegistry, counter, gauge, histogram,
-                      set_enabled)
+                      MetricsRegistry, aggregate_snapshots, counter,
+                      gauge, histogram, set_enabled)
+from .plane import (SnapshotMerger, TelemetryPlane,  # noqa: F401
+                    snapshot_to_prometheus)
 from .report import (RunReportBuilder, build_run_report,  # noqa: F401
                      render_markdown, validate_run_report,
                      write_run_report)
 from .retrace import (RetraceRegression, compile_counts,  # noqa: F401
                       record_build, retrace_guard)
-from .trace import (chrome_trace_events, validate_chrome_trace,  # noqa: F401
-                    write_chrome_trace)
+from .trace import (chrome_trace_events,  # noqa: F401
+                    load_trace_fragments, merge_traces,
+                    validate_chrome_trace, write_chrome_trace,
+                    write_merged_trace)

@@ -35,6 +35,7 @@ No dependencies beyond the standard library.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 
@@ -79,6 +80,83 @@ def _full_name(name, key):
         return name
     inner = ",".join(f'{k}="{v}"' for k, v in key)
     return f"{name}{{{inner}}}"
+
+
+_FULL_NAME_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_full_name(full):
+    """Split a snapshot full name (``name{a="1",b="2"}``) back into
+    ``(name, {label: value})`` — the inverse of the exporter's
+    :func:`_full_name`. An unparseable string round-trips as a bare
+    name with no labels (aggregation must not crash on a foreign
+    snapshot)."""
+    m = _FULL_NAME_RE.match(str(full))
+    if not m:
+        return str(full), {}
+    return m.group(1), dict(_LABEL_RE.findall(m.group(2) or ""))
+
+
+def canonical_full_name(full):
+    """Full name with its labels re-sorted into the registry's
+    canonical order — the label-collision normaliser: two snapshots
+    spelling ``m{a="1",b="2"}`` and ``m{b="2",a="1"}`` must fold into
+    ONE sample, not two."""
+    name, labels = parse_full_name(full)
+    return _full_name(name, _label_key(labels))
+
+
+def _le_sort_key(le):
+    """Numeric sort key of a histogram ``le`` label (``+Inf`` last;
+    an unparseable boundary sorts with ``+Inf`` rather than
+    raising)."""
+    try:
+        return float("inf") if le == "+Inf" else float(le)
+    except (TypeError, ValueError):
+        return float("inf")
+
+
+def bucket_deltas(buckets):
+    """Cumulative ``{le: count}`` → per-bucket increments keyed by
+    the same boundaries (ascending). The inverse of cumulation — the
+    representation in which histograms from workers with DIFFERENT
+    bucket sets merge exactly (each increment stays attached to its
+    own upper boundary, so the merged cumulation over the boundary
+    union is correct and monotone)."""
+    out = {}
+    prev = 0
+    for le, n in sorted(dict(buckets).items(),
+                        key=lambda kv: _le_sort_key(kv[0])):
+        n = int(n)
+        out[le] = out.get(le, 0) + n - prev
+        prev = n
+    return out
+
+
+def cumulate_deltas(deltas):
+    """Per-bucket increments → cumulative ``{le: count}`` over the
+    boundaries present, ascending (``+Inf`` last)."""
+    out = {}
+    running = 0
+    for le in sorted(deltas, key=_le_sort_key):
+        running += int(deltas[le])
+        out[le] = running
+    return out
+
+
+def merge_bucket_sets(a, b):
+    """Merge two cumulative bucket dicts BY BOUNDARY: both are
+    de-cumulated onto their own boundaries, the increments summed
+    over the boundary union, and the result re-cumulated. Positional
+    merging (the pre-ISSUE-13 behaviour) silently mis-bins when
+    worker builds disagree on bucket sets; boundary merging is exact
+    because a count ≤ b stays ≤ b in any superset of boundaries."""
+    da = bucket_deltas(a)
+    for le, n in bucket_deltas(b).items():
+        da[le] = da.get(le, 0) + n
+    return cumulate_deltas(da)
 
 
 class _Metric:
@@ -347,7 +425,19 @@ def aggregate_snapshots(snapshots):
     additive, and a pod-level "last writer wins" would be
     meaningless across processes. Malformed entries are skipped (a
     heartbeat from an older worker build must not kill the pod
-    aggregation)."""
+    aggregation).
+
+    Two cross-build hazards are normalised away (ISSUE 13):
+
+    - **label collisions** — full names are canonicalised
+      (:func:`canonical_full_name`) before summing, so two snapshots
+      spelling the same label set in a different order fold into one
+      sample;
+    - **mismatched histogram buckets** — bucket dicts merge BY
+      BOUNDARY (:func:`merge_bucket_sets`), never positionally, so
+      workers built with different bucket tables still produce a
+      monotone, exactly-binned merged histogram.
+    """
     out = {"counters": {}, "gauges": {}, "histograms": {}}
     for snap in snapshots:
         if not isinstance(snap, dict):
@@ -356,17 +446,18 @@ def aggregate_snapshots(snapshots):
             for name, val in dict(snap.get(kind) or {}).items():
                 if not isinstance(val, (int, float)):
                     continue
+                name = canonical_full_name(name)
                 out[kind][name] = out[kind].get(name, 0) + val
         for name, st in dict(snap.get("histograms") or {}).items():
             if not isinstance(st, dict):
                 continue
+            name = canonical_full_name(name)
             agg = out["histograms"].setdefault(
                 name, {"count": 0, "sum": 0.0, "buckets": {}})
             agg["count"] += int(st.get("count", 0))
             agg["sum"] += float(st.get("sum", 0.0))
-            for le, n in dict(st.get("buckets") or {}).items():
-                agg["buckets"][le] = agg["buckets"].get(le, 0) \
-                    + int(n)
+            agg["buckets"] = merge_bucket_sets(
+                agg["buckets"], dict(st.get("buckets") or {}))
     return out
 
 

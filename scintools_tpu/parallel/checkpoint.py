@@ -15,6 +15,7 @@ arrays/scalars plus a small metadata dict.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import warnings
@@ -28,14 +29,28 @@ def atomic_write_bytes(path, data):
     same directory (``os.replace`` is atomic on POSIX), fsyncing the
     temp file first — a reader (or a resume after SIGKILL) sees
     either the old file or the complete new one, never a torn
-    write."""
+    write.
+
+    The temp name is unique per process (pid + counter): some of
+    these paths are legitimately multi-writer — two fleet workers
+    renewing one lease during a steal race — and a SHARED temp name
+    let one writer's ``os.replace`` whisk away the other's temp file
+    mid-flight (observed: FileNotFoundError killing a live worker).
+    With unique temps, concurrent writers are last-write-wins, which
+    is exactly the lease semantics."""
     path = os.fspath(path)
-    tmp = path + ".tmp"
+    tmp = f"{path}.{os.getpid()}.{next(_TMP_SEQ)}.tmp"
     with open(tmp, "wb") as fh:
         fh.write(data)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
+
+
+#: per-process temp-file sequence — ``next()`` on an itertools.count
+#: is atomic under the GIL, so in-process concurrent writers of one
+#: path get distinct temps; the pid prefix separates processes
+_TMP_SEQ = itertools.count(1)
 
 
 def atomic_write_json(path, obj):

@@ -1,14 +1,24 @@
-"""Live telemetry HTTP listener for the streaming daemon.
+"""Live telemetry HTTP listener for the streaming daemon — and, since
+ISSUE 13, the shared serving machinery of the pod-level telemetry
+plane (obs/plane.py).
 
 The PR-5 observability surfaces were in-process (metrics registry)
 or write-at-exit (run_report.json, trace files). A deployable
 service is scraped and probed from OUTSIDE while it runs; this
 module is that edge — a stdlib :class:`ThreadingHTTPServer` (no new
-dependencies) serving:
+dependencies) whose request routing is a **handler table**
+(:func:`daemon_routes`) rather than an if-chain, so the daemon
+surface and the fleet plane surface share one dispatch path and
+cannot drift: both get the same ``/`` index, the same 404-with-path-
+listing, the same per-path request counter + latency histogram, and
+the same crash-to-500 containment.
+
+The daemon table:
 
 ==========  =====================================================
 path        answer
 ==========  =====================================================
+/           index: the paths this surface serves
 /metrics    Prometheus text exposition of the process registry
             (``Content-Type: text/plain; version=0.0.4`` — what a
             Prometheus scraper requires), uptime gauge refreshed
@@ -27,38 +37,87 @@ path        answer
             backlog
 ==========  =====================================================
 
-Handler threads only READ daemon state through the snapshot methods
-(every one takes the daemon's lock or tolerates racy scalar reads)
-and never touch in-flight device values — no host syncs, no stalls
-on the pipeline (the bench's scrape-under-load config pins the
-overhead).
+A route is ``path -> fn(service) -> (status, body, content_type)``;
+``content_type=None`` means "JSON-encode body". Handler threads only
+READ service state through the snapshot methods (every one takes the
+service's lock or tolerates racy scalar reads) and never touch
+in-flight device values — no host syncs, no stalls on the pipeline
+(the bench's scrape-under-load config pins the overhead).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import metrics as _metrics
 from ..utils import slog
 
 
+def metrics_route(service):
+    """``/metrics``: the process registry, uptime freshened per
+    scrape."""
+    _metrics.touch_process_metrics()
+    return (200, _metrics.REGISTRY.to_prometheus(),
+            _metrics.PROMETHEUS_CONTENT_TYPE)
+
+
+def probe_route(method_name):
+    """A liveness/readiness probe route: the service method returns a
+    detail dict whose ``ok`` decides 200 vs 503."""
+
+    def route(service):
+        detail = getattr(service, method_name)()
+        return (200 if detail.get("ok") else 503), detail, None
+
+    return route
+
+
+def snapshot_route(method_name):
+    """A JSON snapshot route bound to one service method."""
+
+    def route(service):
+        return 200, getattr(service, method_name)(), None
+
+    return route
+
+
+def daemon_routes():
+    """The streaming daemon's handler table (the docs/serving.md
+    endpoint table is this dict, rendered)."""
+    return {
+        "/metrics": metrics_route,
+        "/healthz": probe_route("healthy"),
+        "/readyz": probe_route("ready"),
+        "/report": snapshot_route("report_snapshot"),
+        "/state": snapshot_route("state_snapshot"),
+    }
+
+
 class TelemetryServer:
     """Owns the listener socket (bound at construction, so an
     ephemeral ``port=0`` is known before the daemon starts) and the
-    serving thread. ``start()``/``close()`` are idempotent."""
+    serving thread. ``start()``/``close()`` are idempotent.
 
-    def __init__(self, service, host="127.0.0.1", port=0):
+    ``routes`` defaults to the daemon table; the telemetry plane
+    passes its own table plus a distinct ``metric_prefix`` so the two
+    surfaces' request counters stay separable."""
+
+    def __init__(self, service, host="127.0.0.1", port=0, routes=None,
+                 metric_prefix="serve_http", thread_name="serve-http"):
         self.service = service
-        handler = _make_handler(service)
+        self.routes = dict(routes) if routes is not None \
+            else daemon_routes()
+        handler = _make_handler(service, self.routes, metric_prefix)
         self._httpd = ThreadingHTTPServer((host, int(port)), handler)
         self._httpd.daemon_threads = True
         self.host = self._httpd.server_address[0]
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
-            kwargs={"poll_interval": 0.1}, name="serve-http")
+            kwargs={"poll_interval": 0.1}, name=thread_name)
         self._started = False
 
     def start(self):
@@ -66,7 +125,8 @@ class TelemetryServer:
             self._started = True
             self._thread.start()
             slog.log_event("serve.http", state="started",
-                           host=self.host, port=self.port)
+                           host=self.host, port=self.port,
+                           paths=sorted(self.routes))
         return self
 
     def close(self):
@@ -83,8 +143,9 @@ class TelemetryServer:
         return f"http://{self.host}:{self.port}"
 
 
-def _make_handler(service):
-    """A request-handler class bound to one daemon instance."""
+def _make_handler(service, routes, metric_prefix):
+    """A request-handler class bound to one service instance and its
+    route table."""
 
     class Handler(BaseHTTPRequestHandler):
         # access logs belong in metrics, not stderr noise
@@ -104,32 +165,30 @@ def _make_handler(service):
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            t0 = time.perf_counter()
+            # the prefix keeps daemon vs plane request accounting
+            # separable under one handler implementation
+            # lint-ok: metric-hygiene: serve_http_requests_total plane_http_requests_total
             _metrics.counter(
-                "serve_http_requests_total",
+                f"{metric_prefix}_requests_total",
                 help="telemetry requests served",
             ).labels(path=path).inc()
             try:
-                if path == "/metrics":
-                    _metrics.touch_process_metrics()
-                    self._send(200, _metrics.REGISTRY.to_prometheus(),
-                               _metrics.PROMETHEUS_CONTENT_TYPE)
-                elif path == "/healthz":
-                    detail = service.healthy()
-                    self._send_json(200 if detail["ok"] else 503,
-                                    detail)
-                elif path == "/readyz":
-                    detail = service.ready()
-                    self._send_json(200 if detail["ok"] else 503,
-                                    detail)
-                elif path == "/report":
-                    self._send_json(200, service.report_snapshot())
-                elif path == "/state":
-                    self._send_json(200, service.state_snapshot())
-                else:
+                route = routes.get(path)
+                if path == "/":
+                    self._send_json(200, {
+                        "service": type(service).__name__,
+                        "paths": ["/"] + sorted(routes)})
+                elif route is None:
                     self._send_json(404, {
                         "error": f"unknown path {path!r}",
-                        "paths": ["/metrics", "/healthz", "/readyz",
-                                  "/report", "/state"]})
+                        "paths": ["/"] + sorted(routes)})
+                else:
+                    code, body, ctype = route(service)
+                    if ctype is None:
+                        self._send_json(code, body)
+                    else:
+                        self._send(code, body, ctype)
             except Exception as e:  # noqa: BLE001 — a handler crash
                 # must answer 500 and never take the serving thread
                 # (or the daemon) down with it
@@ -139,5 +198,12 @@ def _make_handler(service):
                     self._send_json(500, {"error": repr(e)[:300]})
                 except OSError:
                     pass  # broad-except-ok: client hung up mid-error
+            finally:
+                # lint-ok: metric-hygiene: serve_http_request_seconds plane_http_request_seconds
+                _metrics.histogram(
+                    f"{metric_prefix}_request_seconds",
+                    help="telemetry request handling wall time",
+                ).labels(path=path).observe(
+                    time.perf_counter() - t0)
 
     return Handler

@@ -353,7 +353,7 @@ def run_scenario_survey(workdir, regimes=DEFAULT_REGIMES,
 
 def run_scenario_fleet(workdir, n_workers=3, batch_size=48,
                        timeout=900.0, pod_options=None,
-                       **workload_params):
+                       plane_port=None, **workload_params):
     """The scenario survey DISTRIBUTED: the same closed
     generate → search → fit loop, run by ``n_workers`` independent
     worker processes coordinating through the fleet work queue
@@ -363,14 +363,23 @@ def run_scenario_fleet(workdir, n_workers=3, batch_size=48,
     :func:`scenario_workload` parameters (JSON-able — they travel to
     the worker processes by spec file). Returns the pod result
     extended with the per-regime ``"recovery"`` summary, exactly like
-    :func:`run_scenario_survey`."""
+    :func:`run_scenario_survey`.
+
+    ``plane_port`` (0 = ephemeral, advertised in
+    ``<workdir>/plane.json``) starts the fleet observability plane
+    alongside the pod: one port serving the merged ``/metrics`` /
+    ``/state`` / ``/report`` / ``/workers`` view of the whole run,
+    live (docs/observability.md "Fleet observability plane")."""
     from ..fleet.pod import run_pod
 
     spec = {"target": "scintools_tpu.sim.scenario:scenario_workload",
             "params": dict(workload_params)}
+    options = dict(pod_options or {})
+    if plane_port is not None:
+        options.setdefault("plane_port", plane_port)
     out = run_pod(workdir, spec, n_workers=n_workers,
                   batch_size=batch_size, timeout=timeout,
-                  **(pod_options or {}))
+                  **options)
     out["recovery"] = recovery_summary(out["results"])
     slog.log_event("sim.scenario_summary",
                    n_epochs=out["summary"]["n_epochs"],

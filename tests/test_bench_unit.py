@@ -321,8 +321,9 @@ class TestBudgetFallback:
         assert d["probe"]["attempts"][0]["ok"] is False
         # every config is present and explicitly marked skipped
         # ISSUE 10: +sim_factory +scenario_loop (sim_batch kept as the
-        # legacy-entry continuity measurement); ISSUE 12: +fft_layer
-        assert len(d["configs"]) == 18
+        # legacy-entry continuity measurement); ISSUE 12: +fft_layer;
+        # ISSUE 13: +fleet_plane
+        assert len(d["configs"]) == 19
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9

@@ -187,6 +187,84 @@ class TestWorkQueue:
         assert thief.complete(stolen)         # exactly one completes
         assert thief.drained()
 
+    def test_concurrent_lease_renew_never_crashes(self, tmp_path):
+        """ISSUE 13 regression: two workers renewing ONE lease (a
+        steal race's double-hold) collided on a shared temp-file
+        name — one `os.replace` whisked the other's temp away and
+        the FileNotFoundError killed a live worker. Unique temps
+        make concurrent renews last-write-wins."""
+        qa = self._q(tmp_path, worker="a", lease_s=0.5, skew_s=0.0)
+        qb = self._q(tmp_path, worker="b", lease_s=0.5, skew_s=0.0)
+        qa.seed([("t0", [("e0", 0)])])
+        ta = qa.claim()
+        time.sleep(0.6)
+        tb = qb.claim()                   # expired → stolen: double-
+        assert tb is not None             # hold, both renew
+        stop = threading.Event()
+        errors = []
+
+        def hammer(q, task):
+            while not stop.is_set():
+                try:
+                    q.renew(task)
+                except Exception as e:  # noqa: BLE001 — the bug
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=hammer, args=(qa, ta)),
+                   threading.Thread(target=hammer, args=(qb, tb))]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert errors == []
+
+    def test_complete_lost_claim_keeps_new_holders_lease(
+            self, tmp_path):
+        """ISSUE 13 regression: a loser completing a stolen task
+        unconditionally unlinked the lease — the NEW holder's live
+        lease — leaving its claim invisible to the expiry scan."""
+        slow = self._q(tmp_path, worker="slow", lease_s=0.05,
+                       skew_s=0.0)
+        thief = self._q(tmp_path, worker="thief", lease_s=30.0,
+                        skew_s=0.0)
+        slow.seed([("t0", [("e0", 0)])])
+        task = slow.claim()
+        time.sleep(0.08)
+        stolen = thief.claim()
+        assert stolen is not None
+        assert not slow.complete(task)    # lost — and must NOT
+        lease = thief.read_lease("t0")    # delete thief's lease
+        assert lease is not None and lease["worker"] == "thief"
+        assert thief.complete(stolen)
+
+    def test_leaseless_claim_stolen_after_grace(self, tmp_path):
+        """ISSUE 13 regression: a claim whose holder died before its
+        first lease write (or whose lease a racing completer
+        dropped) was unstealable forever — the expiry scan iterates
+        leases. The lease-less backstop steals it once it has been
+        observed lease-less for ~a heartbeat period."""
+        import shutil
+
+        holder = self._q(tmp_path, worker="dead", lease_s=0.3,
+                         skew_s=0.0)
+        holder.seed([("t0", [("e0", 0)])])
+        assert holder.claim() is not None
+        # simulate the wedge: claim present, lease GONE
+        shutil.rmtree(holder.leases_dir)
+        os.makedirs(holder.leases_dir)
+        thief = self._q(tmp_path, worker="thief", lease_s=0.3,
+                        skew_s=0.0)
+        assert thief.claim() is None      # inside the grace window
+        time.sleep(0.6)                   # > the 0.5 s grace floor
+        stolen = thief.claim()
+        assert stolen is not None and stolen.stolen
+        assert stolen.stolen_from == "dead"
+        assert thief.complete(stolen)
+        assert thief.drained()
+
     def test_reclaim_own_after_restart(self, tmp_path):
         """A restarted worker (same id) reclaims what its previous
         incarnation held when it died."""

@@ -234,6 +234,81 @@ class TestObsEvents:
 
 
 # =====================================================================
+# JL005 metric-hygiene (ISSUE 13)
+# =====================================================================
+
+class TestMetricHygiene:
+    def catalog(self, tmp_path, *names):
+        doc = tmp_path / "catalog.md"
+        doc.write_text("\n".join(f"`{n}`" for n in names))
+        return Config(obs_docs=[str(doc)])
+
+    def test_conformant_names_pass(self, tmp_path):
+        cfg = self.catalog(tmp_path, "good_total", "depth_gauge",
+                           "lat_seconds", "io_bytes")
+        src = ("from ..obs import metrics as _metrics\n"
+               "_metrics.counter('good_total').inc()\n"
+               "_metrics.gauge('depth_gauge').set(1)\n"
+               "_metrics.histogram('lat_seconds').observe(0.1)\n"
+               "reg.histogram('io_bytes').observe(4096)\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+    def test_suffix_conventions_enforced(self, tmp_path):
+        cfg = self.catalog(tmp_path, "epochs_done", "lat_ms",
+                           "depth_total", "camelName_total")
+        src = ("_metrics.counter('epochs_done').inc()\n"
+               "_metrics.histogram('lat_ms').observe(1)\n"
+               "_metrics.gauge('depth_total').set(1)\n"
+               "_metrics.counter('camelName_total').inc()\n")
+        out = scan("metric-hygiene", src, config=cfg)
+        msgs = "\n".join(f.message for f in out)
+        assert "must end '_total'" in msgs          # counter
+        assert "unit suffix" in msgs                # histogram
+        assert "must not end '_total'" in msgs      # gauge
+        assert "not snake_case" in msgs             # camelCase
+
+    def test_undocumented_name_flagged(self, tmp_path):
+        cfg = self.catalog(tmp_path, "known_total")
+        out = scan("metric-hygiene",
+                   "_metrics.counter('unknown_total').inc()\n",
+                   config=cfg)
+        assert len(out) == 1
+        assert "not in the documented catalog" in out[0].message
+
+    def test_nonliteral_needs_marker_and_named_checked(
+            self, tmp_path):
+        cfg = self.catalog(tmp_path, "pre_requests_total")
+        src = "_metrics.counter(f'{p}_requests_total').inc()\n"
+        out = scan("metric-hygiene", src, config=cfg)
+        assert len(out) == 1 and "non-literal" in out[0].message
+        marked = src.replace(
+            ".inc()\n",
+            ".inc()  # lint-ok: metric-hygiene: "
+            "pre_requests_total\n")
+        assert scan("metric-hygiene", marked, config=cfg) == []
+        # a marker naming an OFF-catalog metric is still flagged
+        bad = src.replace(
+            ".inc()\n",
+            ".inc()  # lint-ok: metric-hygiene: other_total\n")
+        out = scan("metric-hygiene", bad, config=cfg)
+        assert len(out) == 1
+        assert "not in the documented catalog" in out[0].message
+
+    def test_marker_grandfathers_literal(self, tmp_path):
+        cfg = self.catalog(tmp_path)
+        src = ("_metrics.counter('legacyName')"
+               ".inc()  # lint-ok: metric-hygiene: grandfathered\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+    def test_math_histograms_ignored(self, tmp_path):
+        cfg = self.catalog(tmp_path)
+        src = ("import numpy as np\n"
+               "h, edges = np.histogram(data, bins=10)\n"
+               "jnp.histogram(x)\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+
+# =====================================================================
 # JL101 retrace-hazard
 # =====================================================================
 

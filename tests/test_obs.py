@@ -551,6 +551,51 @@ class TestAggregateSnapshots:
              {"histograms": {"h": "not-a-dict"}}])
         assert agg["counters"] == {} and agg["histograms"] == {}
 
+    def test_mismatched_bucket_sets_merge_by_boundary(self):
+        """ISSUE 13 satellite: two workers built with DIFFERENT
+        bucket tables. A positional merge mis-bins; the boundary
+        merge de-cumulates each onto its own boundaries and
+        re-cumulates over the union — monotone, and every count
+        stays ≤ its own upper edge."""
+        ra = metrics.MetricsRegistry()
+        ha = ra.histogram("h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            ha.observe(v)
+        rb = metrics.MetricsRegistry()
+        hb_ = rb.histogram("h", buckets=(0.5, 1.0, 10.0))
+        for v in (0.3, 2.0):
+            hb_.observe(v)
+        agg = metrics.aggregate_snapshots(
+            [ra.snapshot(), rb.snapshot()])
+        buckets = agg["histograms"]["h"]["buckets"]
+        # union of boundaries, ascending, +Inf last
+        les = list(buckets)
+        assert les == ["0.1", "0.5", "1.0", "10.0", "+Inf"]
+        # a: cum {0.1:1, 1.0:2, inf:3}; b: cum {0.5:1, 1.0:1,
+        # 10.0:2, inf:2} → merged deltas 1,1,1,1,1
+        assert buckets == {"0.1": 1, "0.5": 2, "1.0": 3,
+                           "10.0": 4, "+Inf": 5}
+        # monotone (the failure mode of the old per-key sum)
+        vals = list(buckets.values())
+        assert vals == sorted(vals)
+        assert agg["histograms"]["h"]["count"] == 5
+
+    def test_label_order_collision_canonicalised(self):
+        """ISSUE 13 satellite: two snapshots spelling one label set
+        in different orders (an older worker build) must fold into
+        ONE sample, not two."""
+        agg = metrics.aggregate_snapshots([
+            {"counters": {'m_total{a="1",b="2"}': 3}},
+            {"counters": {'m_total{b="2",a="1"}': 4}},
+        ])
+        assert agg["counters"] == {'m_total{a="1",b="2"}': 7}
+        name, labels = metrics.parse_full_name(
+            'm_total{b="2",a="1"}')
+        assert name == "m_total" and labels == {"a": "1", "b": "2"}
+        # unlabelled names round-trip untouched
+        assert metrics.canonical_full_name("plain_total") \
+            == "plain_total"
+
 
 def test_obs_namespace_exports():
     import scintools_tpu.obs as obs

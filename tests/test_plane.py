@@ -1,0 +1,493 @@
+"""Fleet observability plane tests (ISSUE 13): the streaming
+snapshot merger, merged Prometheus rendering, mtime-gated heartbeat
+scans, incremental journal tails with live conflict detection, the
+cross-process trace merge, and the one-port pod surface — including
+the acceptance run: a live 3-worker PROCESS pod scraped mid-run,
+with a real SIGKILL steal visible in the merged Chrome trace as a
+cross-worker track handoff.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from scintools_tpu.fleet import FleetStateTracker, JournalTail, Pod
+from scintools_tpu.obs import heartbeat as hb
+from scintools_tpu.obs import metrics
+from scintools_tpu.obs.plane import (SnapshotMerger,
+                                     snapshot_to_prometheus)
+from scintools_tpu.obs.report import validate_run_report
+from scintools_tpu.obs.trace import (load_trace_fragments,
+                                     merge_traces,
+                                     validate_chrome_trace,
+                                     write_merged_trace)
+from scintools_tpu.parallel.checkpoint import EpochJournal
+from scintools_tpu.utils import slog
+
+DEMO_SPEC = {"target": "scintools_tpu.fleet.worker:demo_workload"}
+
+
+def _spec(**params):
+    return {**DEMO_SPEC, "params": params}
+
+
+def _get(url, path, timeout=10):
+    try:
+        r = urllib.request.urlopen(url + path, timeout=timeout)
+        code, headers, body = r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        code, headers, body = e.code, e.headers, e.read()
+    if "json" in headers.get("Content-Type", ""):
+        return code, headers, json.loads(body)
+    return code, headers, body.decode()
+
+
+def _snap(counters=None, gauges=None, histograms=None):
+    return {"counters": dict(counters or {}),
+            "gauges": dict(gauges or {}),
+            "histograms": dict(histograms or {})}
+
+
+class TestSnapshotMerger:
+    def test_counters_sum_gauges_keep_worker_label(self):
+        m = SnapshotMerger()
+        m.update("w0", _snap(counters={"c_total": 3},
+                             gauges={"g_depth": 2.0}))
+        m.update("w1", _snap(counters={"c_total": 4},
+                             gauges={"g_depth": 5.0}))
+        out = m.merged()
+        assert out["counters"] == {"c_total": 7}
+        assert out["gauges"] == {'g_depth{worker="w0"}': 2.0,
+                                 'g_depth{worker="w1"}': 5.0}
+
+    def test_update_is_incremental_and_skip_detected(self):
+        m = SnapshotMerger()
+        assert m.update("w0", _snap(counters={"c_total": 3}))
+        # identical snapshot: recognised, nothing re-folded
+        assert not m.update("w0", _snap(counters={"c_total": 3}))
+        assert m.skipped == 1 and m.updates == 1
+        # replacement: the OLD contribution is subtracted, so the
+        # merge tracks the worker's current snapshot, not its history
+        assert m.update("w0", _snap(counters={"c_total": 10}))
+        assert m.merged()["counters"] == {"c_total": 10}
+
+    def test_histograms_merge_by_boundary_incrementally(self):
+        ra = metrics.MetricsRegistry()
+        ra.histogram("h_seconds", buckets=(0.1, 1.0)).observe(0.05)
+        rb = metrics.MetricsRegistry()
+        rb.histogram("h_seconds", buckets=(0.5, 1.0)).observe(0.3)
+        m = SnapshotMerger()
+        m.update("a", ra.snapshot())
+        m.update("b", rb.snapshot())
+        h = m.merged()["histograms"]["h_seconds"]
+        assert h["count"] == 2
+        assert h["buckets"] == {"0.1": 1, "0.5": 2, "1.0": 2,
+                                "+Inf": 2}
+        # worker a's contribution withdraws cleanly on replacement
+        ra.histogram("h_seconds").observe(0.05)
+        m.update("a", ra.snapshot())
+        h = m.merged()["histograms"]["h_seconds"]
+        assert h["count"] == 3 and h["buckets"]["0.1"] == 2
+
+    def test_worker_label_collision_preserved(self):
+        m = SnapshotMerger()
+        m.update("w0", _snap(gauges={'g_depth{worker="orig"}': 1.0}))
+        out = m.merged()["gauges"]
+        assert out == {'g_depth{worker="w0",worker_src="orig"}': 1.0}
+
+    def test_malformed_snapshot_tolerated(self):
+        m = SnapshotMerger()
+        m.update("w0", "junk")
+        m.update("w1", _snap(counters={"c_total": "NaN"},
+                             histograms={"h_seconds": "nope"}))
+        out = m.merged()
+        assert out["counters"] == {} and out["histograms"] == {}
+
+
+class TestSnapshotPrometheus:
+    """The merged view must keep the conformance the per-process
+    registry export has (cf. test_obs.TestPrometheusConformance)."""
+
+    def _text(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("c_total").labels(path="/x").inc(2)
+        reg.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.5)
+        m = SnapshotMerger()
+        m.update("w0", reg.snapshot())
+        m.update("w1", _snap(gauges={"g_depth": 1.5}))
+        return snapshot_to_prometheus(m.merged())
+
+    def test_help_and_type_per_family(self):
+        lines = self._text().strip().splitlines()
+        families = {ln.split()[2]: ln.split()[3] for ln in lines
+                    if ln.startswith("# TYPE ")}
+        assert families == {"c_total": "counter",
+                            "g_depth": "gauge",
+                            "lat_seconds": "histogram"}
+        helped = {ln.split()[2] for ln in lines
+                  if ln.startswith("# HELP ")}
+        assert helped == set(families)
+
+    def test_samples_and_histogram_expansion(self):
+        text = self._text()
+        assert 'c_total{path="/x"} 2' in text
+        assert 'g_depth{worker="w1"} 1.5' in text
+        assert 'lat_seconds_bucket{le="0.1"} 0' in text
+        assert 'lat_seconds_bucket{le="1.0"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+        assert "lat_seconds_count 1" in text
+        assert text.endswith("\n")
+
+
+class TestHeartbeatScanner:
+    def test_unchanged_files_not_reread(self, tmp_path):
+        d = tmp_path / "hb"
+        d.mkdir()
+        for w in ("w0", "w1"):
+            hb.write_heartbeat_file(d / f"{w}.json", phase="task")
+        cache = {}
+        recs, stats = hb.scan_heartbeat_dir(d, cache)
+        assert set(recs) == {"w0", "w1"} and stats["read"] == 2
+        # the pinned contract: a tick over unchanged files reads 0
+        recs, stats = hb.scan_heartbeat_dir(d, cache)
+        assert set(recs) == {"w0", "w1"}
+        assert stats["read"] == 0 and stats["cached"] == 2
+
+    def test_changed_file_reread_removed_dropped(self, tmp_path):
+        d = tmp_path / "hb"
+        d.mkdir()
+        hb.write_heartbeat_file(d / "w0.json", phase="task", n=1)
+        hb.write_heartbeat_file(d / "w1.json", phase="task")
+        cache = {}
+        hb.scan_heartbeat_dir(d, cache)
+        time.sleep(0.01)                  # distinct mtime_ns
+        hb.write_heartbeat_file(d / "w0.json", phase="task", n=2)
+        os.unlink(d / "w1.json")
+        recs, stats = hb.scan_heartbeat_dir(d, cache)
+        assert stats["read"] == 1 and stats["removed"] == 1
+        assert recs["w0"]["n"] == 2 and "w1" not in recs
+
+    def test_scanner_exports_staleness_gauges(self, tmp_path):
+        d = tmp_path / "hb"
+        d.mkdir()
+        hb.write_heartbeat_file(d / "w0.json", phase="task")
+        sc = hb.HeartbeatScanner(d)
+        recs = sc.scan()
+        assert set(recs) == {"w0"}
+        assert sc.scans == 1 and sc.reads == 1
+        snap = metrics.snapshot()
+        assert "fleet_heartbeat_age_max_seconds" in snap["gauges"]
+        assert snap["counters"][
+            "fleet_heartbeat_files_read_total"] == 1
+        sc.scan()
+        assert metrics.snapshot()["counters"][
+            "fleet_heartbeat_files_read_total"] == 1  # no re-read
+        assert sc.reads == 1 and sc.scans == 2
+
+
+class TestJournalTail:
+    def test_incremental_reads_and_torn_tail(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        j = EpochJournal(path)
+        j.append("e0", status="ok", result={"v": 1})
+        tail = JournalTail(path)
+        assert [r["epoch"] for r in tail.poll()] == ["e0"]
+        assert tail.poll() == []          # nothing new: no re-read
+        j.append("e1", status="ok", result={"v": 2})
+        with open(path, "a") as fh:
+            fh.write('{"epoch": "torn", "cr')   # no newline
+        assert [r["epoch"] for r in tail.poll()] == ["e1"]
+        # the torn tail stays unconsumed until its newline arrives
+        with open(path, "a") as fh:
+            fh.write('c": "zzz"}\n')
+        recs = tail.poll()                # bad crc → skipped, counted
+        assert recs == [] and tail.corrupt == 1
+
+    def test_crc_corrupt_line_skipped(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        EpochJournal(path).append("e0", status="ok", result={})
+        with open(path, "a") as fh:
+            fh.write('{"epoch": "e1", "status": "ok", '
+                     '"crc": "00000000"}\n')
+        tail = JournalTail(path)
+        assert [r["epoch"] for r in tail.poll()] == ["e0"]
+        assert tail.corrupt == 1
+
+
+class TestFleetStateTracker:
+    def _worker_journal(self, root, wid, rows):
+        d = root / wid
+        d.mkdir(parents=True, exist_ok=True)
+        j = EpochJournal(d / "journal.jsonl")
+        for epoch, fields in rows:
+            j.append(epoch, **fields)
+
+    def test_union_duplicates_and_live_conflict(self, tmp_path):
+        root = tmp_path / "workers"
+        self._worker_journal(root, "w0", [
+            ("e0", dict(status="ok", result={"v": 1}, worker="w0",
+                        t_commit=10.0)),
+            ("e1", dict(status="ok", result={"v": 2}, worker="w0",
+                        t_commit=11.0))])
+        self._worker_journal(root, "w1", [
+            # benign duplicate (a steal's trace): same payload
+            ("e0", dict(status="ok", result={"v": 1}, worker="w1",
+                        t_commit=20.0)),
+            # DIVERGING duplicate: determinism violation, live
+            ("e1", dict(status="ok", result={"v": 99}, worker="w1",
+                        t_commit=5.0))])
+        tr = FleetStateTracker(root)
+        assert tr.refresh() == 4
+        assert tr.refresh() == 0          # incremental: nothing new
+        st = tr.snapshot()
+        assert st["duplicates"] == 2 and st["conflicts"] == 1
+        assert st["epochs"]["e0"]["workers"] == ["w0", "w1"]
+        # first-committed-wins, exactly like the end-of-run merge
+        recs = tr.records()
+        assert recs["e0"]["result"] == {"v": 1}
+        assert recs["e1"]["result"] == {"v": 99}   # w1 committed 1st
+        assert slog.recent(event="plane.state_conflict")
+        snap = metrics.snapshot()
+        assert snap["counters"]["plane_state_conflicts_total"] == 1
+        assert snap["counters"]["plane_state_duplicates_total"] == 2
+
+
+class TestTraceMergeUnit:
+    def _fragments(self):
+        # a stolen epoch (e1): spans from BOTH workers on one id
+        return {
+            "w0": {"spans": [("load", "e0", 100.0, 100.2),
+                             ("load", "e1", 100.2, 100.4),
+                             ("compute", "e0", 100.4, 100.6)],
+                   "trace_ids": {"e0": "00000/e0",
+                                 "e1": "00001/e1"}},
+            "w1": {"spans": [("load", "e1", 101.0, 101.2),
+                             ("compute", "e1", 101.2, 101.5)],
+                   "trace_ids": {"e1": "00001/e1"}},
+        }
+
+    def test_merge_validates_and_shows_handoff(self):
+        doc = merge_traces(self._fragments())
+        validate_chrome_trace(doc)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        by_id = {}
+        for e in xs:
+            tid = e["args"].get("trace_id")
+            if tid:
+                by_id.setdefault((tid, e["name"]), []).append(
+                    e["pid"])
+        # within one worker an id appears once per stage
+        assert all(len(p) == len(set(p)) for p in by_id.values())
+        # the stolen epoch: one id, spans from two worker tracks
+        assert sorted(set(by_id[("00001/e1", "load")])) == [1, 2]
+        # worker tracks are separate processes with named threads
+        names = {(e["pid"], e["args"]["name"])
+                 for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert {p for p, _ in names} == {1, 2}
+
+    def test_merge_is_deterministic_and_dedupes(self):
+        frags = self._fragments()
+        d1 = merge_traces(frags)
+        d2 = merge_traces(dict(reversed(list(frags.items()))))
+        assert d1 == d2
+        # an exactly re-exported span (crash-restart tail) is dropped
+        frags["w0"]["spans"].append(("load", "e0", 100.0, 100.2))
+        d3 = merge_traces(frags)
+        assert d3 == d1
+
+    def test_fragment_round_trip_with_torn_tail(self, tmp_path):
+        p = tmp_path / "trace.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"worker": "w0", "epoch": "e0",
+                                 "trace_id": "00000/e0"}) + "\n")
+            fh.write(json.dumps({"worker": "w0", "stage": "load",
+                                 "epoch": "e0", "t0": 1.0,
+                                 "t1": 2.0}) + "\n")
+            fh.write('{"worker": "w0", "stage": "lo')   # torn
+        frags = load_trace_fragments({"w0": p})
+        assert frags["w0"]["spans"] == [("load", "e0", 1.0, 2.0)]
+        assert frags["w0"]["trace_ids"] == {"e0": "00000/e0"}
+        out, stats = write_merged_trace(tmp_path / "merged.json",
+                                        frags)
+        assert stats == {"workers": 1, "events": 1, "stages": 1}
+        validate_chrome_trace(json.load(open(out)))
+
+
+class TestPlaneThreadMode:
+    """Fast plumbing coverage: the plane over a thread-mode pod —
+    endpoints answer mid-run, the index matches the daemon surface's
+    contract, and the pod's heartbeat monitoring is incremental."""
+
+    def test_endpoints_live_mid_run(self, tmp_path):
+        pod = Pod(tmp_path / "pod",
+                  _spec(n_epochs=24, slow_s=0.04),
+                  n_workers=2, batch_size=4, mode="thread",
+                  lease_s=5.0, monitor_s=0.05,
+                  plane_port=0).start()
+        url = pod.telemetry.url
+        try:
+            # discovery file advertises the ephemeral port
+            disc = json.load(open(tmp_path / "pod" / "plane.json"))
+            assert disc["url"] == url
+            code, _, index = _get(url, "/")
+            assert code == 200
+            assert set(index["paths"]) == {
+                "/", "/metrics", "/report", "/state", "/workers"}
+            code, _, nf = _get(url, "/nope")
+            assert code == 404 and "/workers" in nf["paths"]
+
+            deadline = time.monotonic() + 60
+            seen_partial = False
+            while time.monotonic() < deadline:
+                code, _, state = _get(url, "/state")
+                assert code == 200
+                done = len(state["epochs"])
+                if 0 < done < 24:
+                    seen_partial = True   # genuinely mid-run
+                    break
+                time.sleep(0.02)
+            assert seen_partial, "never observed a mid-run /state"
+            code, _, rep = _get(url, "/report")
+            assert code == 200
+            validate_run_report(rep)
+            assert rep["in_progress"] is True
+            assert rep["runner"] == "run_pod"
+            # a monitor pass (normally the wait() loop's) populates
+            # the pod-level queue gauges the scrape then serves
+            pod.poll()
+            code, headers, text = _get(url, "/metrics")
+            assert code == 200
+            assert headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            assert "# TYPE fleet_queue_pending gauge" in text
+            assert "process_uptime_seconds" in text
+            code, _, workers = _get(url, "/workers")
+            assert code == 200
+            assert set(workers["workers"]) >= {"w0", "w1"}
+        finally:
+            out = pod.wait(timeout=120.0)
+        assert out["summary"]["n_ok"] == 24
+        # plane closed with the pod
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(url + "/state", timeout=2)
+        # incremental heartbeat monitoring: the monitor ticked far
+        # more often than workers re-stamped, so most scans were
+        # stat-only (the pinned "no re-read of unchanged files")
+        sc = pod.heartbeat_scanner
+        assert sc.scans > 0
+        assert sc.reads < sc.scans * 2    # 2 workers, mostly cached
+        # merged trace written next to the merged journal
+        doc = json.load(open(tmp_path / "pod" / "trace.merged.json"))
+        validate_chrome_trace(doc)
+        assert out["fleet"]["trace"]["workers"] == 2
+
+
+class TestPlaneProcessAcceptance:
+    """ISSUE 13 acceptance: a live 3-worker PROCESS pod serves
+    merged /metrics, /state, /report, /workers from one port
+    mid-run; a real SIGKILL mid-claim forces a steal; and the merged
+    Chrome trace shows the stolen epoch as spans from two workers on
+    ONE trace ID (the track handoff)."""
+
+    def test_sigkill_steal_visible_in_plane_and_trace(self,
+                                                      tmp_path):
+        # workload batch_size=1: the runner journals/beats/flushes
+        # per EPOCH inside each 5-epoch task, so the victim's
+        # partial progress on its in-flight task is spooled before
+        # the SIGKILL — that is what makes the steal visible as a
+        # two-worker handoff instead of a silent re-run
+        pod = Pod(tmp_path / "pod",
+                  _spec(n_epochs=30, slow_s=0.12, batch_size=1),
+                  n_workers=3, batch_size=5, lease_s=2.0, skew_s=0.5,
+                  poll_s=0.1, monitor_s=0.1,
+                  worker_options={"heartbeat_s": 0.05},
+                  plane_port=0).start()
+        url = pod.telemetry.url
+        scrapes = {"metrics": [], "state": [], "report": [],
+                   "workers": []}
+        stop = threading.Event()
+
+        def scraper():
+            while not stop.wait(0.25):
+                try:
+                    for key in scrapes:
+                        code, _, body = _get(url, f"/{key}")
+                        if code == 200:
+                            scrapes[key].append(body)
+                except (urllib.error.URLError, OSError):
+                    pass
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+
+        victim = pod.workers[0]
+        claims = os.path.join(pod.queue_root, "claims",
+                              victim.worker_id)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if os.path.isdir(claims) and any(
+                    f.endswith(".json") for f in os.listdir(claims)):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("victim never claimed a task")
+        time.sleep(0.5)      # let it journal + flush a few epochs
+        os.kill(victim.pid, signal.SIGKILL)
+        victim_held = any(f.endswith(".json")
+                          for f in os.listdir(claims))
+        try:
+            out = pod.wait(timeout=180.0)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+        assert out["summary"]["n_ok"] == 30
+        assert victim.worker_id in out["fleet"]["dead_workers"]
+        if not victim_held:
+            pytest.skip("SIGKILL landed between tasks — no steal "
+                        "this run (claim/kill race)")
+        assert out["fleet"]["steals"] >= 1
+
+        # ---- the one-port mid-run surface answered ---------------
+        assert scrapes["state"], "no successful /state scrape"
+        assert any(0 < len(s["epochs"]) < 30
+                   for s in scrapes["state"]), "no mid-run /state"
+        assert all(s["conflicts"] == 0 for s in scrapes["state"])
+        for rep in scrapes["report"]:
+            validate_run_report(rep)
+        assert any(r["in_progress"] for r in scrapes["report"])
+        # process-mode sums are exact: merged counters visible with
+        # per-worker gauge labels intact
+        assert any("fleet_epochs_done_total" in m
+                   and 'worker="' in m for m in scrapes["metrics"])
+        assert any(w["workers"] for w in scrapes["workers"])
+
+        # ---- the steal is a track handoff in the merged trace ----
+        doc = json.load(open(tmp_path / "pod" / "trace.merged.json"))
+        validate_chrome_trace(doc)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_id_stage = {}
+        for e in xs:
+            tid = e["args"].get("trace_id")
+            if tid:
+                by_id_stage.setdefault(
+                    (tid, e["name"]), []).append(e["pid"])
+        # every epoch's trace ID appears exactly once per stage per
+        # worker track (no same-worker duplicates survive the merge)
+        for pids in by_id_stage.values():
+            assert len(pids) == len(set(pids))
+        # every epoch is covered by a per-epoch (load) span
+        load_epochs = {e["args"]["epoch"] for e in xs
+                       if e["name"] == "load"}
+        assert len(load_epochs) == 30
+        # and the stolen task's epochs show spans from TWO workers
+        # on one ID — the handoff
+        handoff = {tid for (tid, stage), pids in by_id_stage.items()
+                   if len(set(pids)) >= 2}
+        assert handoff, "steal not visible as a cross-worker handoff"

@@ -510,6 +510,14 @@ class TestIntegrationAcceptance:
             assert code == 503 and ready["warm"] is False
             code, _, notfound = _get(port, "/nope")
             assert code == 404 and "/metrics" in notfound["paths"]
+            # the `/` index lists the surface's paths (ISSUE 13: the
+            # handler table shared with the plane serves both)
+            code, _, index = _get(port, "/")
+            assert code == 200
+            assert set(index["paths"]) == {
+                "/", "/metrics", "/healthz", "/readyz", "/report",
+                "/state"}
+            assert index["paths"] == notfound["paths"]
 
             total = self.N_OK + 2
             for i in range(self.N_OK):

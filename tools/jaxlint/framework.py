@@ -339,6 +339,7 @@ class Config:
         self.repo_root = repo_root or _default_repo_root()
         self._obs_docs = obs_docs
         self._obs_catalog = None
+        self._metric_catalog = None
 
     @property
     def obs_docs(self):
@@ -363,6 +364,24 @@ class Config:
                         r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`", fh.read()))
             self._obs_catalog = names
         return self._obs_catalog
+
+    @property
+    def metric_catalog(self):
+        """Backtick-quoted snake_case identifiers (optionally with a
+        ``{label=...}`` suffix) across the same catalog docs — the
+        documented-metric set the JL005 ``metric-hygiene`` rule
+        checks registrations against. Underscore-free identifiers
+        are excluded (they are ordinary code words, not metric
+        names)."""
+        if self._metric_catalog is None:
+            names = set()
+            for path in self.obs_docs:
+                with open(path, encoding="utf-8") as fh:
+                    names |= set(re.findall(
+                        r"`([a-z][a-z0-9_]*)(?:\{[^`]*\})?`",
+                        fh.read()))
+            self._metric_catalog = {n for n in names if "_" in n}
+        return self._metric_catalog
 
 
 def _default_repo_root():

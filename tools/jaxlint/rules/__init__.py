@@ -1,7 +1,8 @@
 """Rule plugins. Importing this package registers every rule with the
 framework registry (``framework.RULES``), in catalog order: the four
-ported legacy lints first, then the three analyzers new in ISSUE 8.
+ported legacy lints first, the metric-hygiene rule (ISSUE 13), then
+the three analyzers new in ISSUE 8.
 """
 
 from . import (excepts, import_jit, syncpoints, obs_events,  # noqa: F401
-               retrace, locks, jit_boundary)
+               metrics_hygiene, retrace, locks, jit_boundary)

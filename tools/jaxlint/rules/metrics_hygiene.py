@@ -1,0 +1,144 @@
+"""JL005 ``metric-hygiene`` — metric names must be snake_case, carry
+the conventional unit suffix, and appear in the documented catalog
+(ISSUE 13).
+
+The metrics registry (obs/metrics.py) is a stable operator
+interface the same way the slog event stream is (JL004): a dashboard
+or recording rule written against today's names must not silently
+miss next month's drive-by ``fleetQueueDepth``. The rule walks every
+``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` registration
+in the package (the module helpers and any registry/module attribute
+form — ``_metrics.counter("x")``, ``reg.gauge("y")``) and enforces:
+
+- **snake_case** — ``^[a-z][a-z0-9_]*$``;
+- **unit suffixes where applicable** — counters end ``_total``
+  (the Prometheus monotonic-counter convention); histograms end in a
+  unit (``_seconds`` / ``_bytes`` — every histogram in this codebase
+  measures one or the other); gauges must NOT end ``_total`` (that
+  suffix promises a counter);
+- **documented** — the name appears backtick-quoted in the metric
+  catalog docs (the same three files the obs-events catalog spans:
+  docs/observability.md, docs/serving.md, docs/fleet.md).
+
+A **non-literal** name (the shared HTTP handler's
+``f"{prefix}_requests_total"``) must carry a marker naming the
+metric(s) it registers — ``# lint-ok: metric-hygiene: <name>
+[<name>...]`` — and each named metric is then checked like a
+literal. A marker on a LITERAL registration grandfathers it
+(triage escape hatch; the reason should say why the name cannot
+follow the convention).
+
+Receivers named for array/plotting libraries (``np.histogram``,
+``jnp.histogram``, ``plt.hist``…) are ignored — those are math, not
+metrics.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..framework import Rule, register
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+#: receiver names whose ``histogram`` attribute is a math routine
+_NOT_A_REGISTRY = {"np", "numpy", "jnp", "jax", "plt", "scipy"}
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_HIST_UNITS = ("_seconds", "_bytes")
+
+
+def _factory_kind(node):
+    """``counter``/``gauge``/``histogram`` when ``node`` is a metric
+    registration call, else None."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _FACTORIES:
+        if isinstance(f.value, ast.Name) \
+                and f.value.id in _NOT_A_REGISTRY:
+            return None
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _FACTORIES:
+        return f.id
+    return None
+
+
+def _name_arg(node):
+    """The AST node carrying the metric name (first positional or
+    the ``name=`` keyword), or None."""
+    if node.args:
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _name_problems(name, kind, catalog):
+    """The convention violations of one (name, kind) registration."""
+    out = []
+    if not _SNAKE.match(name):
+        out.append(f"metric {name!r} is not snake_case")
+        return out                    # suffix checks are meaningless
+    if kind == "counter" and not name.endswith("_total"):
+        out.append(f"counter {name!r} must end '_total'")
+    if kind == "histogram" and not name.endswith(_HIST_UNITS):
+        out.append(f"histogram {name!r} must end in a unit suffix "
+                   f"({' / '.join(_HIST_UNITS)})")
+    if kind == "gauge" and name.endswith("_total"):
+        out.append(f"gauge {name!r} must not end '_total' (that "
+                   "suffix promises a monotonic counter)")
+    if name not in catalog:
+        out.append(f"metric {name!r} not in the documented catalog "
+                   "(docs/observability.md / serving.md / fleet.md) "
+                   "— add a catalog table row or rename to a "
+                   "documented metric")
+    return out
+
+
+@register
+class MetricHygieneRule(Rule):
+    id = "JL005"
+    name = "metric-hygiene"
+    short = ("metric names: snake_case, unit suffix "
+             "(_total/_seconds/_bytes), documented catalog")
+    scope = None
+    # the registry itself builds names generically (pass-through
+    # module helpers); its own process_uptime_seconds IS checked at
+    # the call sites that touch it
+    exclude = ("obs/metrics.py",)
+    self_markers = True     # the marker NAMES the metric(s) on
+    #                         non-literal registrations; on literal
+    #                         ones it grandfathers
+
+    def check(self, ctx, config):
+        catalog = config.metric_catalog
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _factory_kind(node)
+            if kind is None:
+                continue
+            arg = _name_arg(node)
+            if arg is None:
+                continue              # not a registration form
+            payload = ctx.marked(node.lineno, self.name)
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                if payload is not None:
+                    continue          # grandfathered literal
+                names = [arg.value]
+            else:
+                names = [t.rstrip(",;") for t in (payload or "")
+                         .split() if _SNAKE.match(t.rstrip(",;"))]
+                if not names:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{kind} registration with a non-literal "
+                        "name — use a literal or a '# lint-ok: "
+                        "metric-hygiene: <name> [...]' marker "
+                        "naming the metric(s) it registers")
+                    continue
+            for name in names:
+                for problem in _name_problems(name, kind, catalog):
+                    yield self.finding(ctx, node.lineno, problem,
+                                       data={"metric": name,
+                                             "kind": kind})
