@@ -2093,6 +2093,173 @@ def bench_survey_service(jax, jnp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_arc_detect(jax, jnp):
+    """Config #20 (ISSUE 14): streaming template-bank arc detection
+    (scintools_tpu/detect, docs/detection.md) — the overlap-save
+    whole-bank correlation against the per-template looped θ-θ
+    η-scan it replaces, and the in-daemon latency cost of running
+    detection inside the serving loop.
+
+    Three measurements:
+
+    1. **whole-bank scan** — B factory epochs correlated against the
+       K-template bank as ONE batched program (xfft halved-spectrum
+       front transform + bank matmul + trigger normalisation):
+       compile_s (first call) and steady epochs/s over fresh stacks,
+       steady calls under ``retrace_guard`` (zero rebuilds is part
+       of the measurement).
+    2. **looped θ-θ η-scan baseline** — the pre-bank shape of an
+       online curvature scan: per epoch, the conjugate spectrum is
+       staged once and the SAME K curvatures are evaluated one
+       device call at a time (python loop over templates, the
+       reference's η-loop granularity). Measured on a subset,
+       reported per-epoch. Gate: whole-bank ≥5× this.
+    3. **in-daemon p95** — the ``survey_service`` stream shape
+       (QueueSource at the same arrival cadence knob), run once
+       without and once with the detection hook registered; the
+       ingest→publish p95 ratio must stay ≤2×.
+    """
+    from scintools_tpu.detect import ArcDetector
+    from scintools_tpu.obs import retrace
+    from scintools_tpu.serve import QueueSource, SurveyService
+    from scintools_tpu.sim.factory import (lane_keys_from_seeds,
+                                           simulate_scenarios)
+    from scintools_tpu.sim.scenario import scenario_truths
+    from scintools_tpu.thth.core import eval_calc_batch, fft_axis
+
+    full = jax.default_backend() != "cpu"
+    ns, nf = 128, 64
+    B = 64 if full else 32
+    K = 48
+    n_loop = 4 if full else 3
+    dt, freq, dlam = 30.0, 1400.0, 0.05
+    df = freq * dlam / (nf - 1)
+    arrival_ms = float(os.environ.get("SCINTOOLS_BENCH_ARRIVAL_MS",
+                                      15))
+
+    # factory epochs (anisotropic regime — arcs present, as in the
+    # closed-loop gates of tests/test_detect.py)
+    keys = lane_keys_from_seeds(list(range(9000, 9000 + B)))
+    dyn, _ = simulate_scenarios(
+        B, mb2=16.0, ar=8.0, psi=0.0, alpha=5 / 3, ns=ns, nf=nf,
+        dlam=dlam, rf=1.0, ds=0.02, inner=0.001, keys=keys,
+        with_ok=True, device_out=True)
+    dyns = np.asarray(jnp.transpose(dyn, (0, 2, 1)))
+    eta_true = float(scenario_truths(
+        16.0, 8.0, 0.0, 5 / 3, rf=1.0, ds=0.02, dt=dt, freq=freq,
+        dlam=dlam)["eta"])
+    det = ArcDetector(nf=nf, nt=ns, dt=dt, df=df,
+                      eta_range=(eta_true / 5, eta_true * 5),
+                      n_templates=K, confirm=False)
+
+    # ---- 1. whole-bank scan: compile + steady ------------------------
+    rng = np.random.default_rng(17)
+    stacks = [dyns + 1e-3 * rng.standard_normal(dyns.shape)
+              .astype(np.float32) for _ in range(4)]
+    t0 = time.perf_counter()
+    det.scan_batch(stacks[0])
+    compile_s = time.perf_counter() - t0
+    with retrace.retrace_guard(sites=("detect.bank",
+                                      "detect.correlate",
+                                      "detect.trigger")):
+        steady_s = _time_variants(
+            lambda s: det.scan_batch(s), [(s,) for s in stacks[1:]],
+            repeats=3)
+    eps_bank = B / steady_s
+
+    # ---- 2. per-template looped θ-θ η-scan ---------------------------
+    from scintools_tpu.thth.search import chunk_conjugate_spectrum
+    from scintools_tpu.thth.core import cs_to_ri
+
+    freqs = freq + np.arange(nf) * df
+    times = np.arange(ns) * dt
+    fd = fft_axis(times, pad=1, scale=1e3)
+    tau = fft_axis(freqs, pad=1, scale=1.0)
+    th_lim = 0.95 * min(np.sqrt(tau.max() / det.bank.etas.max()),
+                        fd.max() / 2)
+    edges = np.linspace(-th_lim, th_lim, 64)
+
+    def loop_scan(dyn_one):
+        CS, tau_l, fd_l = chunk_conjugate_spectrum(
+            dyn_one, times, freqs, npad=1)
+        curve = np.empty(K)
+        for i, eta in enumerate(det.bank.etas):
+            curve[i] = eval_calc_batch(CS, tau_l, fd_l,
+                                       np.asarray([eta]), edges,
+                                       backend="jax")[0]
+        return curve
+
+    loop_scan(dyns[0])                      # warm the eval program
+    t_loop = _time_variants(
+        loop_scan, [(dyns[1 + i],) for i in range(n_loop)],
+        repeats=min(3, n_loop))
+    eps_loop = 1.0 / t_loop
+    speedup = eps_bank / eps_loop
+
+    # ---- 3. in-daemon ingest→publish p95 -----------------------------
+    import tempfile
+
+    sspec_fit = jax.jit(lambda d: jnp.sum(jnp.abs(
+        jnp.fft.rfft2(d)) ** 2))            # a modest real per-epoch
+    sspec_fit(jnp.zeros((nf, ns), jnp.float32))  # fit stand-in, warm
+
+    def process(payload, tier=None):
+        return {"v": float(np.asarray(sspec_fit(
+            jnp.asarray(payload))))}
+
+    det.warmup()     # the /readyz contract: the per-epoch (B=1)
+    #                  detection programs compile BEFORE serving, not
+    #                  on the first streamed epoch
+
+    def stream(with_detect):
+        src = QueueSource()
+        root = tempfile.mkdtemp(prefix="bench_detect_")
+        svc = SurveyService(src, process, root, heartbeat=False,
+                            http=False, report=False)
+        if with_detect:
+            svc.add_on_published(
+                det.make_hook(extract=lambda p, out: p))
+        with svc:
+            for i in range(B):
+                src.put(f"e{i:03d}", dyns[i])
+                time.sleep(arrival_ms / 1e3)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                counts = svc.state_snapshot()["counts"]
+                if counts.get("ok", 0) >= B:
+                    break
+                time.sleep(0.01)
+            pct = svc.latency_percentiles()
+            det_counts = svc.state_snapshot().get("detect", {})
+        return pct, det_counts
+
+    pct_plain, _ = stream(False)
+    pct_detect, det_counts = stream(True)
+    ratio = (pct_detect["p95_s"] / pct_plain["p95_s"]
+             if pct_plain["p95_s"] else float("inf"))
+
+    return {
+        "epochs": B, "size": f"{nf}x{ns}", "templates": K,
+        "bank": det.bank.describe(),
+        "compile_s": round(compile_s, 3),
+        "bank_epochs_per_sec": round(eps_bank, 1),
+        "steady_scan_s": round(steady_s, 4),
+        "steady_retraces": 0,               # retrace_guard raised
+        "loop_epoch_s": round(t_loop, 3),   # otherwise
+        "loop_epochs_per_sec": round(eps_loop, 2),
+        "speedup_bank_vs_looped": round(speedup, 1),
+        "speedup_gate_5x_ok": bool(speedup >= 5.0),
+        "arrival_cadence_ms": arrival_ms,
+        "latency_p95_plain_s": pct_plain["p95_s"],
+        "latency_p95_detect_s": pct_detect["p95_s"],
+        "latency_p95_ratio": round(ratio, 2),
+        "latency_gate_2x_ok": bool(ratio <= 2.0),
+        "daemon_detect_counts": det_counts,
+        "recall_gate": "tests/test_detect.py::"
+                       "TestClosedLoopAcceptance",
+    }
+
+
 def bench_fft_layer(jax, jnp):
     """Config #18 (ISSUE 12): the structure-aware transform layer
     (ops/xfft.py) — dense vs declared formulations for the two newly
@@ -2277,6 +2444,7 @@ _EST_S = {
     "retrieval_batch": {"acc": 60, "cpu": 60},
     "scatim":        {"acc": 60,  "cpu": 60},
     "fft_layer":     {"acc": 60,  "cpu": 60},
+    "arc_detect":    {"acc": 120, "cpu": 120},
 }
 
 
@@ -2415,6 +2583,7 @@ def main():
         ("acf2d", bench_acf2d_fit),
         ("scatim", bench_scattered_image),
         ("fft_layer", bench_fft_layer),
+        ("arc_detect", bench_arc_detect),
     ]
     # The tunneled TPU can WEDGE mid-run (observed live: after a
     # healthy 4096² headline run, the next config's first device call

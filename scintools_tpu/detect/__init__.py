@@ -1,0 +1,39 @@
+"""Streaming template-bank arc detection (ISSUE 14 tentpole;
+ROADMAP item 5).
+
+The GPU Fourier-domain acceleration-search pipelines
+(arXiv:1711.10855, arXiv:1804.05335) reach real-time throughput by
+correlating incoming Fourier blocks against a precomputed template
+bank with overlap-save convolution. This package is the
+scintillation-arc analog, run ONLINE inside the serve daemon:
+
+- :mod:`~scintools_tpu.detect.bank` — the device-resident
+  curvature/η template bank: a log-spaced η grid over the
+  scenario-factory regime range, templates as normalised parabolic
+  matched filters in conjugate-spectrum space, built as one cached
+  jitted program per geometry (``detect.bank``);
+- :mod:`~scintools_tpu.detect.correlate` — the overlap-save engine:
+  each epoch (or 50 %-overlapping time block of a longer one) is
+  transformed once through the declared-structure xfft lowering
+  (real-input forward, halved-row crop folded — ``detect.correlate``
+  formulation, dense oracle kept) and matched against the WHOLE bank
+  as one batched FFT + matmul program;
+- :mod:`~scintools_tpu.detect.trigger` — peak extraction with
+  per-template noise-floor normalisation, a significance threshold,
+  the guards-pattern per-lane health mask, and the θ-θ confirmation
+  entry (the bank prunes the η space; ``fit_thetatheta``'s engine
+  runs on hits only);
+- :mod:`~scintools_tpu.detect.online` — :class:`ArcDetector`, the
+  serve-daemon ``on_published`` hook: ``detect.trigger`` /
+  ``detect.confirmed`` events, ``detect_*`` metrics, per-epoch
+  ``/state`` annotations and a ``detect`` span on the epoch trace.
+
+docs/detection.md is the operator walkthrough.
+"""
+
+from .bank import TemplateBank, build_bank, eta_grid  # noqa: F401
+from .correlate import (correlate_bank, correlate_program,  # noqa: F401
+                        extract_blocks, time_blocks)
+from .online import ArcDetector  # noqa: F401
+from .trigger import (calibrate_noise_floor, confirm_eta,  # noqa: F401
+                      extract_triggers, trigger_program)

@@ -1,0 +1,234 @@
+"""Device-resident curvature/η template bank.
+
+The GPU Fourier-domain acceleration searches (Dimoudi et al.
+arXiv:1711.10855; Adámek & Armour arXiv:1804.05335) hold a
+precomputed bank of matched-filter templates on device and correlate
+every incoming Fourier block against the WHOLE bank at once. The
+scintillation analog: an arc of curvature η is the parabolic ridge
+``τ = η·f_D²`` in conjugate-spectrum space, so a template is a
+normalised parabolic band mask over the halved secondary-spectrum
+frame (positive delays τ, fftshifted Doppler f_D — exactly the frame
+``ops/sspec.py:secondary_spectrum_power`` emits), and the bank is a
+log-spaced η grid covering the scenario-factory regime range
+(sim/scenario.py:scenario_truths η values; ROADMAP item 5).
+
+Template construction, per η:
+
+- a Gaussian band around the parabola, width
+  ``σ(f_D) = σ₀·Δτ + rel_width·η·f_D²`` — the relative term keeps
+  adjacent log-grid templates overlapping as the arc steepens, the
+  absolute term keeps the band at least one delay bin wide;
+- both Doppler arms (the band depends on ``f_D²``);
+- a **validity mask** excluding the zero-Doppler column(s) and the
+  zero-delay row(s): the DC ridge carries power in every epoch and
+  would light every template;
+- zero mean over the valid region and unit L2 norm, so a template is
+  a CONTRAST filter: flat (noise-floor) spectra score ~0, and under
+  the correlator's standardised input a score is directly a
+  significance (detect/correlate.py, detect/trigger.py).
+
+The whole bank builds as ONE cached jitted device program
+(``detect.bank`` retrace site, probed by obs/programs.py) keyed on
+the epoch geometry — the daemon pays it once per geometry, never per
+epoch — and the resulting ``T[K, R·C]`` matrix stays device-resident
+for the life of the process (the matched-filter matmul operand).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+from ..ops.sspec import fft_shapes, sspec_axes
+
+#: default η span factor around the scenario-factory regime range —
+#: the bank is a PRUNER, not a fitter: it only has to land within the
+#: θ-θ confirmation window (detect/trigger.py:confirm_eta) of truth.
+DEFAULT_N_TEMPLATES = 48
+
+
+class TemplateBank:
+    """One geometry's template bank: the η grid, the device-resident
+    template matrix, and the frame bookkeeping the correlator needs.
+
+    ``templates`` is ``f32[K, R·C]`` (flattened halved-sspec frame,
+    raw delay rows × fftshifted Doppler columns), zero-mean over the
+    valid region and unit-norm per row. ``valid`` is ``f32[R·C]``
+    (1.0 = pixel participates in scoring). Instances are cheap
+    descriptors over cached device arrays — build through
+    :func:`build_bank`, which caches per geometry."""
+
+    __slots__ = ("etas", "templates", "valid", "tdel", "fdop",
+                 "shape", "geometry", "params")
+
+    def __init__(self, etas, templates, valid, tdel, fdop, shape,
+                 geometry, params):
+        self.etas = etas                    # host f64 [K]
+        self.templates = templates          # device f32 [K, P]
+        self.valid = valid                  # device f32 [P]
+        self.tdel = tdel                    # host f64 [R] (µs)
+        self.fdop = fdop                    # host f64 [C] (mHz)
+        self.shape = shape                  # (R, C) sspec frame
+        self.geometry = geometry            # (nf, nt, dt, df)
+        self.params = params                # build knobs (JSON-able)
+
+    @property
+    def n_templates(self):
+        return len(self.etas)
+
+    @property
+    def n_pixels(self):
+        return int(self.shape[0] * self.shape[1])
+
+    def describe(self):
+        """JSON-able view for reports/telemetry/bench records."""
+        return {
+            "n_templates": int(self.n_templates),
+            "eta_range": [float(self.etas[0]), float(self.etas[-1])],
+            "frame": list(self.shape),
+            "geometry": {"nf": self.geometry[0],
+                         "nt": self.geometry[1],
+                         "dt": self.geometry[2],
+                         "df": self.geometry[3]},
+            **self.params,
+        }
+
+
+def eta_grid(eta_min, eta_max, n=DEFAULT_N_TEMPLATES):
+    """Log-spaced curvature grid [s³ ≡ µs/mHz² on the sspec axes] —
+    log spacing matches the templates' relative band width, so bank
+    resolution is a constant factor across the whole range."""
+    if not (0 < eta_min < eta_max):
+        raise ValueError(f"need 0 < eta_min < eta_max, got "
+                         f"({eta_min}, {eta_max})")
+    return np.geomspace(float(eta_min), float(eta_max), int(n))
+
+
+# keyed program cache (the JL101 per-call wrapper trap): one compiled
+# bank-builder program per sspec frame + width parameters; the η grid
+# rides as a traced argument so re-spanning the bank never retraces.
+_BANK_PROGRAM_CACHE = {}
+
+_MAX_CACHED = 8
+
+
+def _bank_program(tdel, fdop, tau_min, fd_min, sigma0, rel_width):
+    key = (tdel.tobytes(), fdop.tobytes(), float(tau_min),
+           float(fd_min), float(sigma0), float(rel_width))
+    fn = _BANK_PROGRAM_CACHE.get(key)
+    if fn is None:
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("detect.bank", key)
+        jax = get_jax()
+        import jax.numpy as jnp
+
+        tdel32 = jnp.asarray(tdel, dtype=jnp.float32)
+        fdop32 = jnp.asarray(fdop, dtype=jnp.float32)
+        dtau = float(tdel[1] - tdel[0])
+        valid2d = ((np.abs(fdop)[None, :] >= fd_min)
+                   & (tdel[:, None] >= tau_min)).astype(np.float32)
+        valid_c = jnp.asarray(valid2d)
+        n_valid = float(valid2d.sum())
+
+        def build(etas):
+            # arc band: |τ − η·f_D²| against a widening Gaussian
+            arc = etas[:, None, None] * fdop32[None, None, :] ** 2
+            sig = (sigma0 * dtau
+                   + jnp.float32(rel_width) * arc)
+            w = jnp.exp(-0.5 * ((tdel32[None, :, None] - arc)
+                                / sig) ** 2)
+            w = w * valid_c[None]
+            # contrast filter: zero mean over the valid region …
+            mu = (jnp.sum(w, axis=(1, 2), keepdims=True)
+                  / jnp.float32(n_valid))
+            t = (w - mu) * valid_c[None]
+            # … and unit L2 norm per template
+            nrm = jnp.sqrt(jnp.sum(t * t, axis=(1, 2),
+                                   keepdims=True))
+            t = t / jnp.maximum(nrm, jnp.float32(1e-20))
+            return t.reshape(t.shape[0], -1)
+
+        fn = jax.jit(build)
+        if len(_BANK_PROGRAM_CACHE) >= _MAX_CACHED:
+            _BANK_PROGRAM_CACHE.pop(next(iter(_BANK_PROGRAM_CACHE)))
+        _BANK_PROGRAM_CACHE[key] = fn
+    return fn
+
+
+_BANK_CACHE = {}
+
+
+def build_bank(nf, nt, dt, df, eta_min, eta_max,
+               n_templates=DEFAULT_N_TEMPLATES, tau_min=None,
+               fd_min=None, sigma0=1.0, rel_width=0.1):
+    """Build (or return the cached) :class:`TemplateBank` for one
+    epoch geometry.
+
+    ``nf, nt`` — dynspec shape (frequency channels × time subints);
+    ``dt`` [s] / ``df`` [MHz] — axis spacings (they set the sspec
+    τ/f_D axes via :func:`~scintools_tpu.ops.sspec.sspec_axes`);
+    ``eta_min, eta_max`` [s³] — the log η span;
+    ``tau_min`` [µs] / ``fd_min`` [mHz] — DC exclusions (defaults:
+    one delay bin, 1.5 Doppler bins); ``sigma0``/``rel_width`` — the
+    band width law (module docstring).
+
+    Banks are cached per full parameter set; templates land on device
+    once and are reused by every correlation program.
+    """
+    nrfft, ncfft = fft_shapes(nf, nt)
+    fdop, tdel, _ = sspec_axes(nf, nt, dt, df, halve=True)
+    if tau_min is None:
+        tau_min = float(tdel[1])            # exclude the τ=0 row
+    if fd_min is None:
+        fd_min = 1.5 * float(fdop[1] - fdop[0])
+    etas = eta_grid(eta_min, eta_max, n_templates)
+    key = (int(nf), int(nt), float(dt), float(df), etas.tobytes(),
+           float(tau_min), float(fd_min), float(sigma0),
+           float(rel_width))
+    bank = _BANK_CACHE.get(key)
+    if bank is not None:
+        return bank
+
+    import jax.numpy as jnp
+
+    fn = _bank_program(tdel, fdop, tau_min, fd_min, sigma0,
+                       rel_width)
+    T = fn(jnp.asarray(etas, dtype=jnp.float32))
+    valid2d = ((np.abs(fdop)[None, :] >= fd_min)
+               & (tdel[:, None] >= tau_min)).astype(np.float32)
+    bank = TemplateBank(
+        etas=etas, templates=T,
+        valid=jnp.asarray(valid2d.ravel()),
+        tdel=tdel, fdop=fdop, shape=(nrfft // 2, ncfft),
+        geometry=(int(nf), int(nt), float(dt), float(df)),
+        params={"tau_min": float(tau_min), "fd_min": float(fd_min),
+                "sigma0": float(sigma0),
+                "rel_width": float(rel_width)})
+    if len(_BANK_CACHE) >= _MAX_CACHED:
+        _BANK_CACHE.pop(next(iter(_BANK_CACHE)))
+    _BANK_CACHE[key] = bank
+    return bank
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass; a silent change to the bank construction
+# program fails JP205 with a readable primitive diff
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("detect.bank")
+def _probe_bank():
+    """The template-bank builder at a fixed 12×10 epoch geometry,
+    4 templates (η grid traced — re-spanning never retraces)."""
+    import jax
+
+    nrfft, ncfft = fft_shapes(12, 10)
+    fdop, tdel, _ = sspec_axes(12, 10, 2.0, 0.05, halve=True)
+    fn = _bank_program(tdel, fdop, float(tdel[1]),
+                       1.5 * float(fdop[1] - fdop[0]), 1.0, 0.1)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((4,), np.float32),)

@@ -1,0 +1,208 @@
+"""Overlap-save whole-bank correlation engine.
+
+The streaming pattern of the GPU acceleration searches
+(arXiv:1711.10855 §4, arXiv:1804.05335): incoming data is cut into
+overlapping Fourier blocks, each block is transformed ONCE, the
+block spectrum is correlated against the whole template bank as one
+batched device program, and the block-edge transients are discarded
+(overlap-save). Here a "block" is a dynspec frame of the bank's
+geometry:
+
+- an epoch exactly the bank frame is one block (the serve daemon's
+  per-epoch hot path — one program invocation per epoch);
+- a LONGER epoch (or a rolling observation) is cut into
+  50 %-overlapping time blocks (:func:`time_blocks`); every block
+  rides the batch axis of the SAME compiled program, each block's
+  spectrum is matched against the whole bank, and the per-block
+  scores are max-reduced by the trigger stage — an arc straddling a
+  block boundary is fully inside the neighbouring block, which is
+  exactly the transient-discard guarantee overlap-save provides.
+
+The per-block transform is built ON the declared-structure transform
+layer (ops/xfft.py, ROADMAP item 4d) from day one:
+``secondary_spectrum_power`` declares real input + the halved row
+crop, so under the ``'half'`` lowering the discarded half of the
+spectrum is never computed (real-input forward, crop folded before
+the second-axis transform). The structured-vs-dense choice routes
+through the backend.py formulation registry as the ``detect.correlate``
+op — the dense complex-fft2 oracle is kept as a choice and parity is
+pinned in tests/test_detect.py.
+
+Inside the one jitted program (``detect.correlate`` retrace site):
+
+1. per-lane health (robust/guards.py): non-finite input pixels set
+   ``BAD_INPUT`` and are zeroed (``sanitize_chunks``) so one corrupt
+   lane can never poison the batched FFT — neighbouring lanes are
+   bitwise untouched (pinned in tests);
+2. halved secondary-spectrum power per lane (xfft-lowered);
+3. per-lane dB scaling relative to the lane peak and ROBUST
+   standardisation (median/MAD over the bank's valid region) — the
+   input side of the matched filter's noise-floor normalisation;
+4. ONE matmul of the standardised spectra against the whole bank:
+   ``scores[B, K] = x̂[B, P] @ T[K, P]ᵀ``.
+
+Templates are traced arguments (not closure constants): the bank can
+be megabytes, and baking it into the program would blow the JP202
+const budget and re-hash it per compile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import formulation, get_jax, register_formulation
+
+register_formulation(
+    "detect.correlate", default="half", choices=("half", "dense"),
+    doc="template-bank correlation front transform: halved-spectrum "
+        "xfft lowering (real-input rfft, crop folded — the discarded "
+        "half never computed) vs the full complex-fft2 oracle")
+
+
+def time_blocks(nt_epoch, nt_block, hop=None):
+    """Overlap-save block starts for an ``nt_epoch``-long time axis
+    cut into ``nt_block`` frames at ``hop`` (default 50 % overlap).
+    The final block is right-aligned so the epoch tail is always
+    covered by a full frame (the saved region of the last block)."""
+    nt_epoch, nt_block = int(nt_epoch), int(nt_block)
+    if nt_epoch < nt_block:
+        raise ValueError(f"epoch shorter than the bank frame "
+                         f"({nt_epoch} < {nt_block})")
+    hop = int(hop) if hop else max(1, nt_block // 2)
+    starts = list(range(0, nt_epoch - nt_block + 1, hop))
+    if starts[-1] != nt_epoch - nt_block:
+        starts.append(nt_epoch - nt_block)
+    return starts
+
+
+def extract_blocks(dyn, nt_block, hop=None):
+    """Cut ``dyn[nf, nt]`` into the overlap-save block stack
+    ``[n_blocks, nf, nt_block]`` (host-side view assembly; the stack
+    is the single host→device transfer of the scan)."""
+    dyn = np.asarray(dyn)
+    starts = time_blocks(dyn.shape[-1], nt_block, hop)
+    return np.stack([dyn[..., s:s + int(nt_block)] for s in starts])
+
+
+# keyed program cache — one compiled correlation program per
+# (bank frame, block batch width, formulation variant, window); a
+# formulation flip builds a NEW program instead of silently reusing
+# the old one (the PR-7 incident class).
+_CORRELATE_CACHE = {}
+
+_MAX_CACHED = 16
+
+
+def correlate_program(nf, nt, n_batch, n_templates, *, variant=None,
+                      window="hanning", window_frac=0.1):
+    """Cached jitted whole-bank correlation
+    ``fn(dyns[B, nf, nt], T[K, P], valid[P]) → (scores[B, K],
+    ok[B] int32)`` — one compile per (geometry, batch, K, variant),
+    site ``detect.correlate``."""
+    if variant is None:
+        variant = formulation("detect.correlate")
+    if variant not in ("half", "dense"):
+        raise ValueError(f"unknown detect.correlate variant "
+                         f"{variant!r} (want 'half' or 'dense')")
+    key = (int(nf), int(nt), int(n_batch), int(n_templates), variant,
+           window, float(window_frac))
+    fn = _CORRELATE_CACHE.get(key)
+    if fn is None:
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("detect.correlate", key)
+        jax = get_jax()
+        import jax.numpy as jnp
+
+        from ..ops.sspec import secondary_spectrum_power
+        from ..ops.windows import get_window
+        from ..robust import guards
+
+        wins = None
+        if window is not None:
+            wins = get_window(int(nt), int(nf), window=window,
+                              frac=window_frac)
+
+        def run(dyns, T, valid):
+            in_ok = guards.chunk_finite_ok(dyns, xp=jnp)
+            d = guards.sanitize_chunks(dyns.astype(jnp.float32),
+                                       xp=jnp)
+            sec = jax.vmap(lambda x: secondary_spectrum_power(
+                x, window_arrays=wins, backend="jax",
+                variant=variant))(d)
+            cs_ok = guards.chunk_finite_ok(sec, xp=jnp)
+            # dB relative to the lane peak (scale-free), floored so a
+            # blanked lane stays finite end-to-end
+            smax = jnp.max(sec, axis=(1, 2), keepdims=True)
+            smax = jnp.where(smax > 0, smax, jnp.float32(1.0))
+            x = 10.0 * jnp.log10(sec / smax + jnp.float32(1e-12))
+            x = x.reshape(x.shape[0], -1)
+            # robust standardisation over the valid region: the input
+            # side of the per-template noise-floor normalisation
+            xv = jnp.where(valid > 0, x, jnp.nan)
+            med = jnp.nanmedian(xv, axis=1, keepdims=True)
+            mad = jnp.nanmedian(jnp.abs(xv - med), axis=1,
+                                keepdims=True)
+            xhat = (x - med) / (jnp.float32(1.4826) * mad
+                                + jnp.float32(1e-6))
+            xhat = xhat * valid[None]
+            scores = xhat @ T.T
+            ok = guards.health_code(input_ok=in_ok, cs_ok=cs_ok,
+                                    xp=jnp)
+            return scores, ok
+
+        fn = jax.jit(run)
+        if len(_CORRELATE_CACHE) >= _MAX_CACHED:
+            _CORRELATE_CACHE.pop(next(iter(_CORRELATE_CACHE)))
+        _CORRELATE_CACHE[key] = fn
+    return fn
+
+
+def correlate_bank(dyns, bank, *, variant=None, window="hanning",
+                   window_frac=0.1):
+    """Correlate a block/epoch stack ``dyns[B, nf, nt]`` against the
+    whole ``bank`` as one device program. Returns device
+    ``(scores[B, K], ok[B])`` — leave them in flight for the trigger
+    program (detect/trigger.py) or fetch for host inspection."""
+    import jax.numpy as jnp
+
+    dyns = jnp.asarray(dyns)
+    if dyns.ndim == 2:
+        dyns = dyns[None]
+    B, nf, nt = dyns.shape
+    gnf, gnt = bank.geometry[0], bank.geometry[1]
+    if (nf, nt) != (gnf, gnt):
+        raise ValueError(
+            f"stack geometry ({nf}, {nt}) does not match the bank's "
+            f"({gnf}, {gnt}) — rebuild the bank or re-block the "
+            f"epoch (detect.correlate.extract_blocks)")
+    fn = correlate_program(nf, nt, B, bank.n_templates,
+                           variant=variant, window=window,
+                           window_frac=window_frac)
+    return fn(dyns, bank.templates, bank.valid)
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — JP2xx audited; the
+# 'detect.correlate' formulation enters the fingerprint, so a silent
+# half↔dense flip fails JP205
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("detect.correlate",
+                 formulations=("detect.correlate", "xfft.sspec"))
+def _probe_correlate():
+    """The whole-bank correlation program at a fixed 12×10 epoch
+    geometry, 2 blocks × 4 templates, active formulation."""
+    import jax
+
+    from ..ops.sspec import fft_shapes
+
+    nrfft, ncfft = fft_shapes(12, 10)
+    P = (nrfft // 2) * ncfft
+    fn = correlate_program(12, 10, 2, 4)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 12, 10), np.float32), S((4, P), np.float32),
+                S((P,), np.float32))

@@ -71,6 +71,9 @@ _LOADED = False
 #: new site's module here leaves its site probe-less, which the
 #: jaxlint JP200 coverage rule turns into a tier-1 failure.
 PROBE_MODULES = (
+    "scintools_tpu.detect.bank",
+    "scintools_tpu.detect.correlate",
+    "scintools_tpu.detect.trigger",
     "scintools_tpu.ops.normsspec",
     "scintools_tpu.ops.fitarc_device",
     "scintools_tpu.ops.scale",

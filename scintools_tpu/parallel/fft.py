@@ -101,39 +101,96 @@ def make_gs_sharded(mesh):
 
 
 def make_sspec_power_sharded(mesh, nf, nt, window_arrays=None,
-                             halve=True):
+                             halve=True, variant=None):
     """Build the distributed secondary-spectrum kernel
     ``fn(dyns[B, nf, nt]) -> power``: the single-device pipeline of
-    ops/sspec.py (mean-subtract → window → pad-to-pow2 → fft2 → |·|² →
-    positive delays, Doppler fftshift) with the fft2 sharded over the
-    'seq' mesh axis and the batch over 'data'.
+    ops/sspec.py (mean-subtract → window → pad-to-pow2 → transform →
+    |·|² → positive delays, Doppler fftshift) with the transform
+    sharded over the 'seq' mesh axis and the batch over 'data'.
 
-    Row slicing for ``halve`` and the Doppler fftshift stay outside the
-    shard_map: the delay axis slice is a shard-prefix selection and the
-    Doppler axis is unsharded, so GSPMD lowers both without extra
-    collectives.
+    ``variant`` routes the ``'xfft.sspec'`` formulation (backend.py
+    registry; resolved at build when None). ``'half'`` is the
+    declared-structure lowering of ops/xfft.py ported to the mesh
+    (ROADMAP item 4b — the sharded program used to compute the
+    discarded half): the REAL padded input all_to_all-transposes
+    first (half the collective bytes of the complex transpose), the
+    delay axis transforms as an ``rfft`` (half the FFT flops), the
+    ``halve`` row crop folds BEFORE the Doppler transform (half the
+    remaining rows ever transformed) and the second all_to_all moves
+    a quarter of the dense path's bytes. ``'dense'`` keeps the
+    complex-fft2 oracle (parity rtol-pinned in tests/test_parallel.py);
+    ``halve=False`` always takes it (the full frame needs every row).
     """
     jax = get_jax()
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..backend import formulation
 
     nrfft, ncfft = fft_shapes(nf, nt)
     k = mesh.shape[SEQ_AXIS]
     if nrfft % k or ncfft % k:
         raise ValueError(f"seq axis {k} must divide FFT shape "
                          f"({nrfft}, {ncfft})")
-    fft2 = make_fft2_sharded(mesh)
+    if variant is None:
+        variant = formulation("xfft.sspec")
+    # the halved lowering needs the cropped row block divisible too;
+    # pow2 frames satisfy this for any pow2 mesh, but fall back to
+    # the exact dense program rather than fail on an odd mesh
+    use_half = (halve and variant == "half"
+                and (nrfft // 2) % k == 0)
     sharded = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
 
     if window_arrays is not None:
         cw = jnp.asarray(np.asarray(window_arrays[0]))
         sw = jnp.asarray(np.asarray(window_arrays[1]))
 
-    def fn(dyns):
+    def front(dyns):
         dyns = dyns - jnp.mean(dyns, axis=(1, 2), keepdims=True)
         if window_arrays is not None:
             dyns = dyns * cw[None, None, :] * sw[None, :, None]
             dyns = dyns - jnp.mean(dyns, axis=(1, 2), keepdims=True)
+        return dyns
+
+    if use_half:
+        def local_half(x):
+            # x: [b, nrfft/k, ncfft] REAL on this device. Transpose
+            # FIRST (real f32 — half the dense path's collective
+            # bytes) so the full delay axis is local …
+            x = jax.lax.all_to_all(x, SEQ_AXIS, split_axis=2,
+                                   concat_axis=1, tiled=True)
+            # … take the real-input half spectrum along it, with the
+            # halve crop folded BEFORE the Doppler transform (the
+            # ops/xfft.py halfrow_power structure, per shard)
+            S = jnp.fft.rfft(x, axis=1)
+            S = S[:, :nrfft // 2, :]
+            S = jax.lax.all_to_all(S, SEQ_AXIS, split_axis=1,
+                                   concat_axis=2, tiled=True)
+            S = jnp.fft.fft(S, axis=2)
+            p = jnp.real(S * jnp.conj(S))
+            return jnp.fft.fftshift(p, axes=2)
+
+        half = _shard_map(local_half, mesh,
+                          (P(DATA_AXIS, SEQ_AXIS, None),),
+                          P(DATA_AXIS, SEQ_AXIS, None))
+
+        def fn(dyns):
+            dyns = front(dyns)
+            real_dtype = jnp.float32 \
+                if dyns.dtype != jnp.float64 else jnp.float64
+            dyns = jnp.pad(dyns.astype(real_dtype),
+                           ((0, 0), (0, nrfft - nf),
+                            (0, ncfft - nt)))
+            dyns = jax.lax.with_sharding_constraint(dyns, sharded)
+            return jax.lax.with_sharding_constraint(half(dyns),
+                                                    sharded)
+
+        return fn
+
+    fft2 = make_fft2_sharded(mesh)
+
+    def fn(dyns):
+        dyns = front(dyns)
         dyns = jnp.pad(dyns.astype(jnp.complex64),
                        ((0, 0), (0, nrfft - nf), (0, ncfft - nt)))
         dyns = jax.lax.with_sharding_constraint(dyns, sharded)

@@ -114,7 +114,7 @@ class SurveyService:
                  prefetch=4, inflight=2, loader_workers=2,
                  journal_name="results.jsonl", http=("127.0.0.1", 0),
                  heartbeat=True, warmup=None, stale_after_s=5.0,
-                 report=True):
+                 report=True, on_published=None):
         self.source = source
         self.process = process
         self.workdir = os.fspath(workdir)
@@ -130,6 +130,12 @@ class SurveyService:
         self.stale_after_s = float(stale_after_s)
         self.report = bool(report)
         self._warmup_fn = warmup
+        # post-publish consumers (ISSUE 14): ``fn(service, epoch_id,
+        # loaded_payload, outcome)`` runs in the loop thread AFTER
+        # the epoch's result is journaled — the hook point the online
+        # arc detector (detect/online.py) registers through, instead
+        # of forking or monkeypatching _consume_one
+        self._hooks = list(on_published or [])
 
         os.makedirs(self.workdir, exist_ok=True)
         self.store = ResultsStore(self.workdir, name=journal_name)
@@ -398,6 +404,46 @@ class SurveyService:
                     epoch_id, payload, value, report, self.process,
                     self.tiers, self.retries, self.validate)
         self._publish(out)
+        self._run_hooks(epoch_id, payload, out)
+
+    # ---- post-publish hook point (ISSUE 14) --------------------------
+    def add_on_published(self, fn):
+        """Register a post-publish consumer ``fn(service, epoch_id,
+        loaded_payload, outcome)``. Hooks run in the ingest-loop
+        thread AFTER the epoch's result is journaled (the epoch's own
+        ingest→publish latency is already accounted); each hook call
+        is a named span on the epoch's trace (``fn.hook_stage``,
+        default ``'on_published'``) and a hook crash is contained —
+        logged as ``serve.hook_error``, counted, never fatal to the
+        loop. Call before :meth:`start` (single-writer: the loop
+        thread is the only reader)."""
+        self._hooks.append(fn)
+        return fn
+
+    def annotate(self, key, **fields):
+        """Merge extra fields into an epoch's ``/state`` entry (hook
+        consumers attach their per-epoch results — e.g. the detector's
+        ``detect={...}`` record)."""
+        with self._lock:
+            st = self._states.get(str(key))
+            if st is not None:
+                st.update(fields)
+
+    def _run_hooks(self, epoch_id, payload, out):
+        for fn in self._hooks:
+            stage = getattr(fn, "hook_stage", "on_published")
+            try:
+                with self.timeline.span(epoch_id, stage):
+                    fn(self, epoch_id, payload, out)
+            except Exception as e:  # noqa: BLE001 — a consumer crash
+                # must not take the serving loop down; surfaced via
+                # slog + metrics, the stream keeps flowing
+                slog.log_failure("serve.hook_error", stage=stage,
+                                 error=e, epoch=str(epoch_id))
+                _metrics.counter(
+                    "serve_hook_errors_total",
+                    help="post-publish hook failures (epoch "
+                         "unaffected, hook skipped)").inc()
 
     def _publish(self, out):
         key = str(out.epoch)
@@ -518,9 +564,20 @@ class SurveyService:
         counts = {}
         for st in epochs.values():
             counts[st["status"]] = counts.get(st["status"], 0) + 1
-        return {"epochs": epochs, "counts": counts,
-                "backlog": self.backlog(),
-                "latency": self.latency_percentiles()}
+        out = {"epochs": epochs, "counts": counts,
+               "backlog": self.backlog(),
+               "latency": self.latency_percentiles()}
+        det = {"scanned": 0, "triggered": 0, "confirmed": 0}
+        for st in epochs.values():
+            d = st.get("detect")
+            if not isinstance(d, dict):
+                continue
+            det["scanned"] += 1
+            det["triggered"] += bool(d.get("triggered"))
+            det["confirmed"] += bool(d.get("confirmed"))
+        if det["scanned"]:
+            out["detect"] = det
+        return out
 
     def results(self):
         """Published results via the store's atomic read API."""

@@ -60,6 +60,49 @@ def test_sspec_sharded_matches_single(mesh, rng):
         np.testing.assert_allclose(got[b], want, rtol=1e-5, atol=1e-6)
 
 
+def test_sspec_sharded_half_matches_dense(mesh, rng):
+    """ISSUE 14 satellite (ROADMAP 4b): the sharded power program's
+    halved-spectrum lowering — real all_to_all transpose first, rfft
+    over the delay axis, the halve crop folded BEFORE the Doppler
+    transform — is exact against the sharded dense oracle AND the
+    single-device path, variant-for-variant."""
+    B, nf, nt = 4, 24, 12
+    dyns = rng.normal(size=(B, nf, nt))
+    wins = get_window(nt, nf, window="hanning", frac=0.1)
+    half = jax.jit(par.make_sspec_power_sharded(
+        mesh, nf, nt, window_arrays=wins, variant="half"))
+    dense = jax.jit(par.make_sspec_power_sharded(
+        mesh, nf, nt, window_arrays=wins, variant="dense"))
+    got_h = np.asarray(half(jnp.asarray(dyns)))
+    got_d = np.asarray(dense(jnp.asarray(dyns)))
+    nrfft, ncfft = fft_shapes(nf, nt)
+    assert got_h.shape == got_d.shape == (B, nrfft // 2, ncfft)
+    scale = np.abs(got_d).max()
+    np.testing.assert_allclose(got_h, got_d, rtol=1e-5,
+                               atol=1e-7 * scale)
+    for b in range(B):
+        want = secondary_spectrum_power(dyns[b], window_arrays=wins,
+                                        backend="numpy",
+                                        variant="half")
+        np.testing.assert_allclose(got_h[b], want, rtol=1e-5,
+                                   atol=1e-7 * scale)
+
+
+def test_sspec_sharded_full_frame_keeps_dense(mesh, rng):
+    """halve=False needs every spectral row — it must stay on the
+    dense program regardless of the active formulation."""
+    B, nf, nt = 4, 8, 8
+    dyns = rng.normal(size=(B, nf, nt))
+    fn = jax.jit(par.make_sspec_power_sharded(
+        mesh, nf, nt, halve=False, variant="half"))
+    got = np.asarray(fn(jnp.asarray(dyns)))
+    for b in range(B):
+        want = secondary_spectrum_power(dyns[b], halve=False,
+                                        backend="numpy")
+        np.testing.assert_allclose(
+            got[b], want, rtol=1e-5, atol=1e-6 * np.abs(want).max())
+
+
 def test_eta_search_sharded_matches_batch(mesh, rng):
     from scintools_tpu.thth.search import chunk_geometry
 

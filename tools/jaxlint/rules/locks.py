@@ -157,7 +157,7 @@ class LockDisciplineRule(Rule):
              "in threaded modules")
     # the threaded tier only — flagging single-threaded code would be
     # all noise
-    scope = ("serve/", "fleet/", "parallel/pipeline.py",
+    scope = ("detect/", "serve/", "fleet/", "parallel/pipeline.py",
              "parallel/checkpoint.py", "obs/", "utils/slog.py",
              "utils/profiling.py")
 
