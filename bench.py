@@ -2390,6 +2390,148 @@ def bench_scattered_image(jax, jnp):
             "queries_per_sec": round(tq.size / t_jax)}
 
 
+def bench_mcmc_batch(jax, jnp):
+    """Config (ISSUE 15): the fleet-scale posterior engine — walkers
+    × epochs on traced batch axes of ONE cached program
+    (mcmc/sampler.py) vs the host-looped ``sample_emcee_jax`` per
+    epoch. Both sides produce the SAME survey product per epoch:
+    chains plus the convergence diagnostics journal rows carry
+    (quantiles, ESS, split-R̂ — on-device reductions for the batched
+    path, the numpy twin per epoch for the loop). The design point is
+    the dispatch-amortisation regime that dominates a 1-core host
+    (minimal 2·ndim walker ensembles, short survey-screening chains);
+    survey-default ensembles (24–32 walkers) are compute-bound on one
+    core and batching is there a wash — on an accelerator the wider
+    lanes are close to free, so the ratio grows with walker count
+    instead (docs/posteriors.md "Performance"). Steady batched calls
+    run under ``retrace_guard`` — a silent rebuild fails the config,
+    not just the gate. Gate: batched ≥5× looped, steady."""
+    from scintools_tpu.fit.ensemble import sample_emcee_jax
+    from scintools_tpu.fit.models import scint_acf_model
+    from scintools_tpu.fit.parameters import Parameters
+    from scintools_tpu.mcmc.likelihood import make_acf1d_loglike
+    from scintools_tpu.mcmc.posterior import summarize_posterior
+    from scintools_tpu.mcmc.sampler import run_ensemble_batched
+    from scintools_tpu.obs.retrace import retrace_guard
+
+    full = jax.default_backend() != "cpu"
+    B, nw, steps = (512, 8, 150) if full else (192, 8, 150)
+    nt, nf, dt, df = 32, 16, 8.0, 0.4
+    ndim = 4                                # tau, dnu, amp, __lnsigma
+    tl, fl = dt * np.arange(nt), df * np.arange(nf)
+
+    def synth(seed):
+        r = np.random.default_rng(seed)
+        tau = 160.0 * (1 + 0.2 * r.random())
+        dnu = 4.0 * (1 + 0.2 * r.random())
+        yt = (np.exp(-(tl / tau) ** (5 / 3)) * (1 - tl / tl.max())
+              + 0.02 * r.normal(size=nt))
+        yf = (np.exp(-fl / (dnu / np.log(2))) * (1 - fl / fl.max())
+              + 0.02 * r.normal(size=nf))
+        return yt.astype(np.float32), yf.astype(np.float32), tau
+
+    def make_batch(s0):
+        yts, yfs, taus = zip(*(synth(s0 + i) for i in range(B)))
+        return np.stack(yts), np.stack(yfs), np.asarray(taus)
+
+    build, _, lo, hi, key = make_acf1d_loglike(nt, nf, dt, df,
+                                               is_weighted=False)
+    wt = np.full((B, nt), np.sqrt(nt / 2), np.float32)
+    wf = np.full((B, nf), np.sqrt(nf / 2), np.float32)
+    x0 = np.tile(np.array([100.0, 3.0, 1.0, np.log(0.1)],
+                          np.float32), (B, 1))
+
+    def run_batched(s0):
+        yts, yfs, taus = make_batch(s0)
+        out = run_ensemble_batched(
+            build, key, (jnp.asarray(yts), jnp.asarray(yfs),
+                         jnp.asarray(wt), jnp.asarray(wf)),
+            x0, lo.astype(np.float32), hi.astype(np.float32),
+            nwalkers=nw, steps=steps, seeds=list(range(B)))
+        return summarize_posterior(out, burn=0.4), taus
+
+    t0 = time.perf_counter()
+    summ, taus = run_batched(0)
+    t_compile = time.perf_counter() - t0
+    assert int(np.asarray(summ["ok"]).sum()) == 0
+    t_batch = np.inf
+    for r in range(3):
+        with retrace_guard():               # steady = zero rebuilds
+            t0 = time.perf_counter()
+            summ, taus = run_batched(100 * (r + 1))
+            t_batch = min(t_batch, time.perf_counter() - t0)
+    # the batched posterior medians must recover the per-epoch
+    # synthesis truths — a fast-but-wrong sampler scores zero
+    rel = np.abs(np.asarray(summ["q50"])[:, 0] - taus) / taus
+    assert np.median(rel) < 0.25, "batched posteriors off truth"
+
+    # ---- host-looped sample_emcee_jax + per-epoch numpy diagnostics -
+    def host_diag(chain):
+        """The numpy twin of the on-device reductions (quantiles,
+        walker-mean FFT-autocorrelation ESS, split-R̂) — the loop
+        must emit the same journal row the batched path does."""
+        K, w, nd = chain.shape
+        wm = chain.mean(axis=1)
+        ess, rhat = [], []
+        for j in range(nd):
+            x = wm[:, j] - wm[:, j].mean()
+            f = np.fft.rfft(x, n=2 * K)
+            acov = np.fft.irfft(np.abs(f) ** 2, n=2 * K)[:K]
+            rho = acov / (acov[0] if acov[0] > 0 else 1.0)
+            neg = np.flatnonzero(rho < 0)
+            win = neg[0] if len(neg) else K
+            ess.append(K * w / max(1.0, 1 + 2 * rho[1:win].sum()))
+            S2 = K // 2
+            halves = np.concatenate([chain[:S2, :, j],
+                                     chain[S2:2 * S2, :, j]], axis=1)
+            m, v = halves.mean(axis=0), halves.var(axis=0, ddof=1)
+            W = v.mean()
+            rhat.append(np.sqrt(
+                ((S2 - 1) / S2 * W + np.var(m, ddof=1))
+                / (W if W > 0 else 1.0)))
+        q = np.quantile(chain.reshape(-1, nd),
+                        [0.025, 0.16, 0.5, 0.84, 0.975], axis=0)
+        return q, ess, rhat
+
+    params = Parameters()
+    params.add("tau", value=100.0, vary=True, min=1e-3 * dt,
+               max=np.inf)
+    params.add("dnu", value=3.0, vary=True, min=1e-3 * df,
+               max=np.inf)
+    params.add("amp", value=1.0, vary=True, min=1e-8, max=np.inf)
+    params.add("alpha", value=5 / 3, vary=False)
+
+    def run_looped(s0):
+        for i in range(B):
+            yt, yf, _ = synth(s0 + i)
+            res = sample_emcee_jax(
+                scint_acf_model, params,
+                ((tl, fl), (yt, yf), (wt[0], wf[0])), nwalkers=nw,
+                steps=steps, burn=0.4, thin=1, seed=i,
+                is_weighted=False)
+            host_diag(res.flatchain.reshape(-1, nw, ndim))
+
+    run_looped(0)                           # warm the B=1 program
+    t_loop = np.inf
+    for r in range(2):
+        t0 = time.perf_counter()
+        run_looped(100 * (r + 1))
+        t_loop = min(t_loop, time.perf_counter() - t0)
+
+    speedup = t_loop / t_batch
+    return {
+        "epochs": B, "nwalkers": nw, "steps": steps, "ndim": ndim,
+        "compile_s": round(t_compile, 3),
+        "jax_s": round(t_batch, 3),
+        "epochs_per_sec": round(B / t_batch, 1),
+        "looped_s": round(t_loop, 3),
+        "looped_epochs_per_sec": round(B / t_loop, 1),
+        "speedup": round(speedup, 2),
+        "median_rel_dtau_vs_truth": round(float(np.median(rel)), 4),
+        "gate_5x_steady": bool(speedup >= 5.0),
+    }
+
+
 def _newest_onchip_artifact():
     """Newest driver bench artifact whose jax path actually ran on an
     accelerator (platform != cpu), as a citable string — so the
@@ -2445,6 +2587,7 @@ _EST_S = {
     "scatim":        {"acc": 60,  "cpu": 60},
     "fft_layer":     {"acc": 60,  "cpu": 60},
     "arc_detect":    {"acc": 120, "cpu": 120},
+    "mcmc_batch":    {"acc": 90,  "cpu": 60},
 }
 
 
@@ -2584,6 +2727,7 @@ def main():
         ("scatim", bench_scattered_image),
         ("fft_layer", bench_fft_layer),
         ("arc_detect", bench_arc_detect),
+        ("mcmc_batch", bench_mcmc_batch),
     ]
     # The tunneled TPU can WEDGE mid-run (observed live: after a
     # healthy 4096² headline run, the next config's first device call

@@ -771,8 +771,17 @@ class Dynspec:
         default, ``'highest'`` → the dense ambient-dtype oracle); the
         single-epoch fit here and survey batches
         (fit/acf2d.py:fit_acf2d_batch) share one compiled-program
-        cache either way."""
-        methods = ("nofit", "acf1d", "acf2d_approx", "acf2d", "sspec")
+        cache either way.
+
+        ``method='mcmc'`` runs the acf1d likelihood through the
+        batched posterior engine (scintools_tpu/mcmc — the B=1 lane
+        of the survey sampler) instead of least squares: parameter
+        values/stderr come from the posterior median/std, and the
+        full posterior summary (quantiles, mean, std per sampled
+        parameter) is stored as ``self.mcmc_summary``
+        (docs/posteriors.md)."""
+        methods = ("nofit", "acf1d", "acf2d_approx", "acf2d", "sspec",
+                   "mcmc")
         if method not in methods:
             raise ValueError(f"method must be one of {methods}, "
                              f"got {method!r}")
@@ -863,9 +872,16 @@ class Dynspec:
             mdl.scint_acf_model, params,
             ((xdata_t, xdata_f), (ydata_t, ydata_f),
              (weights_t, weights_f)), max_nfev=50000,
-            nan_policy=nan_policy, mcmc=mcmc, nwalkers=nwalkers,
-            steps=steps, burn=burn, progress=progress,
-            backend=self.backend)
+            nan_policy=nan_policy, mcmc=(mcmc or method == "mcmc"),
+            nwalkers=nwalkers, steps=steps, burn=burn,
+            progress=progress, backend=self.backend)
+        if method == "mcmc" \
+                and getattr(results, "flatchain", None) is not None:
+            from .mcmc.posterior import flatchain_summary
+
+            self.mcmc_summary = flatchain_summary(
+                results.flatchain, getattr(results, "var_names",
+                                           params.varying_names()))
 
         if results.params["dnu"].stderr is not None:
             for k in ("tau", "dnu", "amp"):

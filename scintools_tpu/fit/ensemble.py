@@ -1,15 +1,25 @@
-"""TPU-resident affine-invariant ensemble MCMC (vmapped walkers).
+"""TPU-resident affine-invariant ensemble MCMC — the B=1 lane of the
+batched posterior engine (scintools_tpu/mcmc).
 
 The reference runs lmfit's ``Minimizer.emcee`` with process-based
 walker parallelism (``workers=`` — /root/reference/scintools/
 scint_models.py:38-39, dynspec.py:2548-2551). At its defaults
 (50 walkers × 10,000 steps) that is ~10⁶ serial residual calls. Here
 the whole sampler is ONE jitted program: a ``lax.scan`` over steps
-whose body evaluates the log-probability of every proposal with
-``jax.vmap`` — the stretch move (Goodman & Weare 2010, the emcee
-algorithm) updates each half of the ensemble against the other, so
-one scan step = two vmapped half-updates. Walker chains live on
-device; burn/thin slicing happens once on host at the end.
+whose body evaluates every proposal's log-probability under
+``jax.vmap``.
+
+Since the mcmc/ subsystem landed, this module owns NO sampler of its
+own: both entries delegate to the batched engine
+(mcmc/sampler.py:ensemble_program — walkers × epochs on traced batch
+axes) as its single-lane case, parity-pinned (same key → same chain,
+tests/test_mcmc.py), so surveys and the single-epoch operator path
+exercise one implementation. Programs live in the engine's keyed
+cache (``mcmc.sampler`` record_build site): repeated
+``sample_emcee_jax`` calls over same-geometry epochs reuse ONE
+compiled program — epoch DATA is traced, not baked into closure
+constants as the pre-engine sampler did (one retrace per epoch,
+~0.3 s each on the CPU host).
 
 The host/numpy sampler in ``fitter.py`` remains the bit-reproducible
 fallback; cross-backend agreement is statistical (different RNGs) and
@@ -30,8 +40,10 @@ def make_logp(model, params, args, is_weighted=True, backend="jax"):
     varying-parameter vector ``x``, with lmfit ``Minimizer.emcee``
     likelihood semantics (is_weighted / __lnsigma, see fitter._log_prob).
 
-    The model must be xp-generic (every model in fit/models.py is); it
-    is called as ``model(valuesdict, *args, backend='jax')``.
+    Kept as the standalone closure-constant form (data baked in) for
+    callers composing their own programs; the samplers below use the
+    engine's traced-data kernels (mcmc/likelihood.py) instead so
+    per-epoch data never forces a retrace.
     """
     import jax.numpy as jnp
 
@@ -61,56 +73,30 @@ def make_logp(model, params, args, is_weighted=True, backend="jax"):
 
 
 def make_ensemble_sampler(logp, nwalkers, ndim, a=2.0):
-    """Compile ``run(key, pos0, steps) -> (chain, logps)`` where chain
-    is (steps, nwalkers, ndim) and ``steps`` is static.
-
-    One scan step performs the two stretch-move half-updates of the
-    emcee red-black scheme; all walker log-probs evaluate under vmap.
-    """
+    """Compile ``run(key, pos0, steps) -> (chain, logps, acc_frac)``
+    where chain is (steps, nwalkers, ndim) and ``steps`` is static —
+    the single-lane view of the batched engine
+    (mcmc/sampler.py:ensemble_program), program-cached on the ``logp``
+    callable's identity (pass the same function object to reuse the
+    compiled program)."""
     jax = get_jax()
     import jax.numpy as jnp
 
-    if nwalkers % 2:
-        raise ValueError("nwalkers must be even for the half-ensemble "
-                         "stretch move")
-    half = nwalkers // 2
-    vlogp = jax.vmap(logp)
+    from ..mcmc.sampler import ensemble_program
 
-    def half_update(active, other, lp_active, key):
-        ku, kp, ka = jax.random.split(key, 3)
-        z = ((a - 1.0) * jax.random.uniform(ku, (half,)) + 1.0) ** 2 / a
-        partners = jax.random.randint(kp, (half,), 0, half)
-        comp = other[partners]
-        prop = comp + z[:, None] * (active - comp)
-        lp_prop = vlogp(prop)
-        log_accept = (ndim - 1) * jnp.log(z) + lp_prop - lp_active
-        accept = jnp.log(jax.random.uniform(ka, (half,))) < log_accept
-        active = jnp.where(accept[:, None], prop, active)
-        lp_active = jnp.where(accept, lp_prop, lp_active)
-        return active, lp_active, accept
-
-    def step(carry, key):
-        pos, lp = carry
-        k1, k2 = jax.random.split(key)
-        first, lp1, acc1 = half_update(pos[:half], pos[half:],
-                                       lp[:half], k1)
-        second, lp2, acc2 = half_update(pos[half:], first,
-                                        lp[half:], k2)
-        pos = jnp.concatenate([first, second])
-        lp = jnp.concatenate([lp1, lp2])
-        n_acc = jnp.sum(acc1) + jnp.sum(acc2)
-        return (pos, lp), (pos, lp, n_acc)
+    run_b = ensemble_program(
+        lambda: (lambda x, data: logp(x)),
+        ("fit.ensemble.custom", logp), nwalkers, ndim, a=a)
 
     def run(key, pos0, steps):
-        lp0 = vlogp(pos0)
-        keys = jax.random.split(key, steps)
-        (_, _), (chain, logps, n_acc) = jax.lax.scan(
-            step, (pos0, lp0), keys)
-        return chain, logps, jnp.sum(n_acc) / (steps * nwalkers)
+        pos0 = jnp.asarray(pos0)
+        out = run_b(jnp.asarray(key)[None], pos0[None],
+                    jnp.full((ndim,), -jnp.inf, pos0.dtype),
+                    jnp.full((ndim,), jnp.inf, pos0.dtype),
+                    jnp.ones((1,), pos0.dtype), (), steps)
+        return out["chain"][0], out["logp"][0], out["acc_frac"][0]
 
-    # lint-ok: retrace-hazard: one-shot build per sample_emcee_jax
-    # call (a user-facing sampler entry, not a per-epoch survey path)
-    return jax.jit(run, static_argnames="steps")
+    return run
 
 
 def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
@@ -119,20 +105,22 @@ def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
     """Drop-in TPU replacement for :func:`fitter.sample_emcee` — same
     result contract (MinimizerResult with flatchain / median / std),
     different RNG stream (jax.random vs numpy Generator), so agreement
-    with the host sampler is statistical, not bitwise.
+    with the host sampler is statistical, not bitwise. Runs as the
+    B=1 lane of the batched engine; epoch data rides as traced
+    arguments, so a host loop over same-geometry epochs compiles
+    ONCE.
     """
     jax = get_jax()
     import jax.numpy as jnp
 
+    from ..mcmc.likelihood import make_model_loglike, model_data_key
+    from ..mcmc.sampler import ensemble_program
+
     params = params.copy()
-    names = params.varying_names()
-    lo, hi = params.varying_bounds()
+    build, names, lo, hi, key_base = make_model_loglike(
+        model, params, is_weighted=is_weighted)
     x0 = params.varying_values()
-    logp, _ = make_logp(model, params, args, is_weighted=is_weighted)
     if not is_weighted:
-        names = names + ["__lnsigma"]
-        lo = np.append(lo, -np.inf)
-        hi = np.append(hi, np.inf)
         x0 = np.append(x0, np.log(0.1))
     ndim = len(names)
 
@@ -154,17 +142,23 @@ def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
     if nwalkers % 2:
         raise ValueError("nwalkers must be even")
 
-    run = make_ensemble_sampler(logp, nwalkers, ndim)
+    data = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None],
+                                  tuple(args))
+    run = ensemble_program(build, model_data_key(key_base, args),
+                           nwalkers, ndim)
     key = jax.random.PRNGKey(0 if seed is None else seed)
     if progress:
         # the whole chain is ONE device program — no per-step python
         # callbacks exist to hook a live progress bar into
         print(f"ensemble: {nwalkers} walkers x {steps} steps "
               f"(single jitted scan)...")
-    chain, logps, acc_frac = run(key, jnp.asarray(pos), steps)
+    out = run(jnp.asarray(key)[None], jnp.asarray(pos)[None],
+              jnp.asarray(lo), jnp.asarray(hi),
+              jnp.ones((1,), jnp.asarray(pos).dtype), data, steps)
     if progress:
         print("ensemble: done")
-    chain = np.asarray(chain)                     # (steps, nw, ndim)
+    chain = np.asarray(out["chain"][0])           # (steps, nw, ndim)
+    acc_frac = out["acc_frac"][0]
 
     nburn = int(burn * steps) if burn < 1 else int(burn)
     kept = chain[nburn::thin] if nburn < steps else chain[-1:]
@@ -179,7 +173,7 @@ def sample_emcee_jax(model, params, args=(), nwalkers=100, steps=1000,
                              nfev=nwalkers * steps,
                              nextra_vary=0 if is_weighted else 1)
     result.flatchain = flat
-    result.var_names = names
+    result.var_names = list(names)
     result.acceptance_fraction = float(acc_frac)
     _attach_chain_covar(result, flat, params)
     return result

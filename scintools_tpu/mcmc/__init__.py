@@ -1,0 +1,46 @@
+"""Fleet-scale posterior engine: walkers × epochs batched ensemble
+MCMC with coverage-calibrated survey posteriors and model evidence.
+
+The reference fits scintillation parameters "via least-squares or
+MCMC" (lmfit/emcee, scint_models.py:29-46) one epoch at a time with a
+process pool of walkers. Here the whole sampler is a device program on
+TWO traced batch axes — every walker of every epoch of a survey batch
+advances in one geometry-keyed jitted scan — so posteriors become a
+survey product, not a per-epoch luxury:
+
+- :mod:`~scintools_tpu.mcmc.sampler` — the batched affine-invariant
+  (stretch-move) ensemble engine, cached per geometry at the
+  ``mcmc.sampler`` site, with per-lane guards-pattern health masks;
+- :mod:`~scintools_tpu.mcmc.likelihood` — vmappable log-likelihood
+  kernels over the existing fit models (acf1d cuts, the rank-r
+  Fresnel acf2d model, the secondary-spectrum η profile, the
+  velocity/orbit curvature models) plus uniform-box priors;
+- :mod:`~scintools_tpu.mcmc.posterior` — on-device chain reductions
+  (quantiles, ESS, split-R̂, truth-rank statistics, tempered-lane
+  evidence) so only summaries round-trip the host;
+- :mod:`~scintools_tpu.mcmc.survey` — the scenario-factory posterior
+  survey through the full ladder/journal/resume/report stack, with
+  the truth-coverage calibration gate.
+
+See docs/posteriors.md for the operator view.
+"""
+
+from .sampler import (ensemble_program, run_ensemble_batched,
+                      walker_init)
+from .likelihood import (make_model_loglike, make_acf1d_loglike,
+                         make_acf2d_loglike, make_eta_profile_loglike,
+                         velocity_model_loglike)
+from .posterior import (posterior_program, summarize_posterior,
+                        flatchain_summary, log_evidence)
+from .survey import (mcmc_scenario_workload, run_mcmc_survey,
+                     run_mcmc_fleet, coverage_summary,
+                     model_evidence_batched)
+
+__all__ = [
+    "ensemble_program", "run_ensemble_batched", "walker_init",
+    "make_model_loglike", "make_acf1d_loglike", "make_acf2d_loglike",
+    "make_eta_profile_loglike", "velocity_model_loglike",
+    "posterior_program", "summarize_posterior", "flatchain_summary",
+    "log_evidence", "mcmc_scenario_workload", "run_mcmc_survey",
+    "run_mcmc_fleet", "coverage_summary", "model_evidence_batched",
+]

@@ -1,0 +1,278 @@
+"""Vmappable log-likelihood kernels + uniform-box priors for the
+batched ensemble engine.
+
+Every kernel here is a pure ``loglike(x[ndim], data) -> scalar`` over
+ONE walker of ONE epoch; the engine (mcmc/sampler.py) vmaps it over
+walkers and lanes. Data rides as TRACED arguments — a survey of
+epochs with identical shapes shares one compiled program, which is
+the whole point (the retired fit/ensemble.py path baked each epoch's
+data into closure constants, recompiling per epoch).
+
+The kernels reuse the existing fit models rather than reimplementing
+them:
+
+- :func:`make_acf1d_loglike` — the joint 1-D ACF-cut model
+  (fit/models.py:scint_acf_model, the ``get_scint_params('acf1d')``
+  likelihood) with lmfit ``Minimizer.emcee`` noise semantics
+  (``is_weighted`` / ``__lnsigma``);
+- :func:`make_acf2d_loglike` — the PR-3 rank-r Fresnel analytic-ACF
+  surface (sim/acf_model.py:make_acf2d_model_core, the ``acf2d``
+  fit's model) as a 2-D image likelihood;
+- :func:`make_eta_profile_loglike` — the secondary-spectrum
+  arc-curvature likelihood: the reference's Gaussian
+  peak-probability of the normalised Doppler profile
+  (utils/velocity.py:calculate_curvature_peak_probability,
+  scint_utils.py:835-854) over the device-computed folded profile
+  (ops/fitarc_device.py);
+- :func:`velocity_model_loglike` / :func:`make_model_loglike` — the
+  velocity/orbit models (fit/models.py:arc_curvature /
+  veff_thin_screen over utils/orbit.py Kepler solves) and ANY
+  xp-generic residual model as swappable priors-and-parameterisations.
+
+Priors are uniform boxes from the ``Parameters`` bounds, enforced
+inside the engine (out-of-bounds → log-posterior −inf); the evidence
+convention treats them as normalised (mcmc/posterior.py
+:func:`~scintools_tpu.mcmc.posterior.log_evidence` — finite bounds
+required).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+
+
+def _hashable(v):
+    """Cache-key form of a fixed-parameter value."""
+    if isinstance(v, (str, bytes, int, float, bool, type(None))):
+        return v
+    arr = np.asarray(v)
+    return (str(arr.dtype), arr.shape, arr.tobytes())
+
+
+def _leaf_sig(tree):
+    """Hashable (treedef, leaf shape/dtype) signature of a data
+    pytree — the part of a program's identity the data contributes."""
+    jax = get_jax()
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sig = tuple((np.asarray(l).shape, str(np.asarray(l).dtype))
+                for l in leaves)
+    return (str(treedef), sig)
+
+
+def make_model_loglike(model, params, is_weighted=True):
+    """Bridge ANY xp-generic residual model ``model(valuesdict,
+    *args, backend='jax')`` (every model in fit/models.py) into an
+    engine kernel.
+
+    Returns ``(build, names, lo, hi, key_base)``: ``build()`` makes
+    ``loglike(x, data)`` where ``data`` is the model's ``args`` tuple
+    (traced; lane axis added by the caller); ``names``/``lo``/``hi``
+    are the sampled parameter vector (with ``__lnsigma`` appended
+    when ``is_weighted=False`` — lmfit ``Minimizer.emcee`` noise
+    semantics, fit/fitter.py:_log_prob); ``key_base`` is the hashable
+    program-identity contribution (model, names, fixed values,
+    weighting) — combine with :func:`_leaf_sig` of the data for the
+    full geometry key.
+    """
+    params = params.copy()
+    names = list(params.varying_names())
+    lo, hi = params.varying_bounds()
+    fixed = {k: v.value for k, v in params.items() if not v.vary}
+    n_model = len(names)
+
+    if not is_weighted:
+        names = names + ["__lnsigma"]
+        lo = np.append(lo, -np.inf)
+        hi = np.append(hi, np.inf)
+
+    def build():
+        import jax.numpy as jnp
+
+        def loglike(x, data):
+            xv = x[:n_model] if not is_weighted else x
+            pd = dict(fixed)
+            for i, name in enumerate(names[:n_model]):
+                pd[name] = xv[i]
+            r = jnp.ravel(model(pd, *data, backend="jax"))
+            if is_weighted:
+                return -0.5 * jnp.sum(r * r)
+            lnsigma = x[-1]
+            s2 = jnp.exp(2.0 * lnsigma)
+            return -0.5 * jnp.sum(r * r / s2
+                                  + jnp.log(2 * np.pi * s2))
+
+        return loglike
+
+    key_base = ("model", getattr(model, "__module__", ""),
+                getattr(model, "__qualname__", repr(model)),
+                tuple(names),
+                tuple(sorted((k, _hashable(v))
+                             for k, v in fixed.items())),
+                bool(is_weighted))
+    return build, names, np.asarray(lo, float), \
+        np.asarray(hi, float), key_base
+
+
+def make_acf1d_loglike(nt, nf, dt, df, alpha=5 / 3, is_weighted=False):
+    """The survey acf1d kernel: joint (time, freq) one-sided ACF-cut
+    likelihood over ``x = (tau, dnu, amp[, __lnsigma])`` with
+    ``data = (tcut[nt], fcut[nf], wt[nt], wf[nf])`` (Bartlett weights
+    as data — fit/batch.py:bartlett_weights).
+
+    Defaults to ``is_weighted=False``: the sampled ``__lnsigma``
+    noise scale lets the posterior width absorb the residual scatter
+    the Bartlett formula underestimates on simulated epochs — the
+    coverage-calibration default (docs/posteriors.md).
+
+    Returns ``(build, names, lo, hi, key)`` with the full geometry
+    key (static lag grids baked in).
+    """
+    from ..fit.models import scint_acf_model
+
+    tlags = dt * np.arange(int(nt))
+    flags = df * np.arange(int(nf))
+
+    names = ["tau", "dnu", "amp"]
+    lo = np.array([1e-3 * dt, 1e-3 * df, 1e-8])
+    hi = np.array([np.inf, np.inf, np.inf])
+    if not is_weighted:
+        names = names + ["__lnsigma"]
+        lo = np.append(lo, -np.inf)
+        hi = np.append(hi, np.inf)
+
+    def build():
+        import jax.numpy as jnp
+
+        tl = jnp.asarray(tlags)
+        fl = jnp.asarray(flags)
+
+        def loglike(x, data):
+            yt, yf, wt, wf = data
+            pd = {"tau": x[0], "dnu": x[1], "amp": x[2],
+                  "alpha": alpha}
+            r = jnp.ravel(scint_acf_model(
+                pd, (tl, fl), (yt, yf), (wt, wf), backend="jax"))
+            if is_weighted:
+                return -0.5 * jnp.sum(r * r)
+            s2 = jnp.exp(2.0 * x[3])
+            return -0.5 * jnp.sum(r * r / s2
+                                  + jnp.log(2 * np.pi * s2))
+
+        return loglike
+
+    key = ("acf1d", int(nt), int(nf), float(dt), float(df),
+           float(alpha), bool(is_weighted))
+    return build, names, lo, hi, key
+
+
+def make_acf2d_loglike(nt_crop, nf_crop, ar, alpha, theta, tau0, dt0,
+                       precision="default"):
+    """The rank-r Fresnel analytic-ACF surface (PR 3,
+    sim/acf_model.py:make_acf2d_model_core) as a 2-D image
+    likelihood over ``x = (tau, dnu, amp, phasegrad, psi, wn)`` with
+    ``data = (ydata[nf_crop, nt_crop], weights[nf_crop, nt_crop],
+    dt, df)`` — per-epoch lag steps ride as data, so one compiled
+    program serves a mixed-geometry survey exactly like the batched
+    LM fit (fit/acf2d.py).
+
+    Returns ``(build, names, lo, hi, key)``.
+    """
+    from ..sim.acf_model import make_acf2d_model_core
+
+    names = ["tau", "dnu", "amp", "phasegrad", "psi", "wn"]
+    lo = np.array([1e-6, 1e-6, 1e-8, -10.0, -180.0, 0.0])
+    hi = np.array([np.inf, np.inf, np.inf, 10.0, 180.0, np.inf])
+
+    def build():
+        import jax.numpy as jnp
+
+        core = make_acf2d_model_core(
+            int(nt_crop), int(nf_crop), float(ar), float(alpha),
+            float(theta), float(tau0), float(dt0),
+            precision=precision)
+
+        def loglike(x, data):
+            ydata, weights, dt, df = data
+            m = core(x[0], x[1], x[2], x[3], x[4], x[5], dt, df)
+            r = (jnp.asarray(ydata) - m) * jnp.asarray(weights)
+            return -0.5 * jnp.sum(r * r)
+
+        return loglike
+
+    key = ("acf2d", int(nt_crop), int(nf_crop), float(ar),
+           float(alpha), float(theta), float(tau0), float(dt0),
+           str(precision))
+    return build, names, lo, hi, key
+
+
+def make_eta_profile_loglike(nprof):
+    """Arc-curvature posterior kernel: the reference's Gaussian
+    peak-probability of the folded, arc-normalised Doppler profile
+    (scint_utils.py:835-854; host twin
+    utils/velocity.py:calculate_curvature_peak_probability) as a 1-D
+    likelihood over ``x = (eta,)``.
+
+    ``data = (profile[nprof], eta_row[nprof], pmax, noise)`` — the
+    device-computed folded profile (ops/fitarc_device.py, ascending
+    per-lane η grid ``eta_row``), its in-window maximum and the
+    pooled secondary-spectrum noise (ops/fitarc.py:sspec_noise).
+    ``loglike(η) = −½·((P(η) − Pmax)/noise)²`` with P interpolated on
+    the lane's η grid.
+
+    Returns ``(build, names, lo, hi, key)`` — bounds ride as data-fed
+    runtime arrays per lane, so ``lo``/``hi`` here are the engine's
+    formal (−inf, inf); callers pass per-lane bounds via the walker
+    init and the profile crop (entries beyond the lane's valid length
+    must be pre-masked to the window edges).
+    """
+    names = ["eta"]
+    lo = np.array([0.0])
+    hi = np.array([np.inf])
+
+    def build():
+        import jax.numpy as jnp
+
+        def loglike(x, data):
+            profile, eta_row, pmax, noise = data
+            p = jnp.interp(x[0], eta_row, profile)
+            # outside the searched window the profile is clamped to
+            # its edge values; the box prior (walker bounds) confines
+            # the chain to the window
+            return -0.5 * ((p - pmax) / noise) ** 2
+
+        return loglike
+
+    key = ("eta_profile", int(nprof))
+    return build, names, lo, hi, key
+
+
+#: the velocity/orbit parameterisations exposed by name — the MCMC
+#: workloads of the reference's scint_models.py (arc curvature vs
+#: MJD through the Kepler solve in utils/orbit.py, and the Rickett+14
+#: thin-screen scintillation-velocity model)
+VELOCITY_MODELS = ("arc_curvature", "veff_thin_screen")
+
+
+def velocity_model_loglike(model_name, params, is_weighted=True):
+    """Named velocity/orbit kernel: :func:`make_model_loglike` over
+    ``fit.models.arc_curvature`` or ``fit.models.veff_thin_screen``
+    with ``data = (ydata, weights, true_anomaly, vearth_ra,
+    vearth_dec, mjd)`` (the reference MCMC call signature,
+    scint_models.py:350-496)."""
+    from ..fit import models as _models
+
+    if model_name not in VELOCITY_MODELS:
+        raise ValueError(f"model_name must be one of "
+                         f"{VELOCITY_MODELS}, got {model_name!r}")
+    return make_model_loglike(getattr(_models, model_name), params,
+                              is_weighted=is_weighted)
+
+
+def model_data_key(key_base, data):
+    """Full program-identity key for :func:`make_model_loglike`
+    kernels: the kernel's ``key_base`` plus the data pytree's
+    structure/shape/dtype signature."""
+    return key_base + (_leaf_sig(data),)

@@ -1,0 +1,211 @@
+"""On-device chain reductions: only SUMMARIES round-trip the host.
+
+A survey batch's chains are (B, steps, nwalkers, ndim) device arrays
+— for B=64 lanes that is tens of MB per batch, and over a tunneled
+link fetching them would dominate the sampler itself. This module
+reduces chains to per-lane summary scalars in one cached jitted
+program (``mcmc.posterior`` site): posterior quantiles, mean/std,
+integrated-autocorrelation ESS, split-R̂ convergence, truth-rank
+statistics for the coverage calibration, and the post-burn mean
+log-likelihood that the tempered-lane evidence integrates.
+
+Diagnostics conventions:
+
+- **ESS** — integrated autocorrelation time of the walker-mean chain
+  (the emcee estimator), computed with an FFT autocovariance and the
+  initial-positive-sequence truncation (the window closes at the
+  first negative autocorrelation — traced ``argmax`` over the static
+  lag grid, no dynamic shapes). ESS = kept-samples / τ_int, a
+  per-parameter effective posterior sample count.
+- **split-R̂** — every walker's kept chain is split in half over
+  time and the 2·nwalkers half-chains enter the Gelman–Rubin
+  between/within variance ratio. R̂ ≈ 1 marks convergence; the
+  survey journals it per parameter.
+- **rank** — the fraction of kept posterior samples BELOW the lane's
+  closed-form truth: uniform on [0, 1] when the posterior is
+  calibrated (the SBC statistic the coverage gate tests);
+  ``rank ∈ (0.16, 0.84)`` ⇔ the central 68% credible interval covers
+  the truth.
+- **evidence** — thermodynamic integration over tempered lanes
+  sharing the sampler's batch axis: d(ln Z)/dβ = ⟨ln L⟩_β, so
+  ln Z = ∫₀¹ ⟨ln L⟩_β dβ (trapezoid over the β ladder) under a
+  NORMALISED uniform-box prior. Finite bounds required — an improper
+  prior has no evidence (docs/posteriors.md "Evidence caveats").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+
+_POSTERIOR_CACHE = {}
+_POSTERIOR_CACHE_MAX = 32
+
+
+def _build_summarize(steps, nwalkers, ndim, nburn, thin):
+    """Program body: ``summarize(chain[B, S, nw, nd], loglike[B, S,
+    nw], truths[B, nd]) -> dict of per-lane arrays``."""
+    get_jax()
+    import jax
+    import jax.numpy as jnp
+
+    kept_idx = np.arange(int(nburn), int(steps), int(thin))
+    S2 = len(kept_idx) // 2
+    n_kept = len(kept_idx) * nwalkers
+
+    def ess_one(x):
+        """ESS of one lane's one-parameter walker-mean chain
+        ``x[S_kept]``."""
+        n = x.shape[0]
+        x = x - jnp.mean(x)
+        f = jnp.fft.rfft(x, n=2 * n)
+        acov = jnp.fft.irfft(jnp.abs(f) ** 2, n=2 * n)[:n]
+        rho = acov / jnp.where(acov[0] > 0, acov[0], 1.0)
+        neg = rho < 0
+        first_neg = jnp.where(jnp.any(neg),
+                              jnp.argmax(neg), n)
+        lag = jnp.arange(n)
+        win = (lag >= 1) & (lag < first_neg)
+        tau = 1.0 + 2.0 * jnp.sum(jnp.where(win, rho, 0.0))
+        tau = jnp.maximum(tau, 1.0)
+        return n_kept / tau
+
+    def rhat_one(w):
+        """Split-R̂ of one lane's one-parameter kept chain
+        ``w[S_kept, nw]`` (walkers as chains, split in time)."""
+        halves = jnp.concatenate([w[:S2], w[S2:2 * S2]], axis=1)
+        means = jnp.mean(halves, axis=0)
+        vars_ = jnp.var(halves, axis=0, ddof=1)
+        W = jnp.mean(vars_)
+        Bv = S2 * jnp.var(means, ddof=1)
+        var_plus = (S2 - 1) / S2 * W + Bv / S2
+        return jnp.sqrt(var_plus / jnp.where(W > 0, W, 1.0))
+
+    def summarize(chain, loglike, truths):
+        kept = chain[:, kept_idx]                # (B, K, nw, nd)
+        ll_kept = loglike[:, kept_idx]           # (B, K, nw)
+        B = kept.shape[0]
+        flat = kept.reshape(B, -1, ndim)         # (B, K*nw, nd)
+        q = jnp.quantile(flat, jnp.asarray([0.025, 0.16, 0.5, 0.84,
+                                            0.975]), axis=1)
+        mean = jnp.mean(flat, axis=1)
+        std = jnp.std(flat, axis=1)
+        rank = jnp.mean(flat < truths[:, None, :], axis=1)
+        walker_mean = jnp.mean(kept, axis=2)     # (B, K, nd)
+        ess = jax.vmap(jax.vmap(ess_one, in_axes=1))(walker_mean)
+        rhat = jax.vmap(jax.vmap(rhat_one, in_axes=2))(kept)
+        return {
+            "q025": q[0], "q16": q[1], "q50": q[2], "q84": q[3],
+            "q975": q[4], "mean": mean, "std": std, "rank": rank,
+            "ess": ess, "rhat": rhat,
+            "mean_loglike": jnp.mean(ll_kept.reshape(B, -1), axis=1),
+        }
+
+    return summarize
+
+
+def posterior_program(steps, nwalkers, ndim, nburn, thin=1):
+    """Cached jitted chain-summary program (``mcmc.posterior`` site).
+
+    ``nburn``/``thin`` are kept-sample selectors over the step axis
+    (static — they shape the kept-index grid). Returns
+    ``summarize(chain[B, steps, nw, nd], loglike[B, steps, nw],
+    truths[B, nd]) -> dict`` of device arrays; pass NaN truths when
+    no closed-form truth exists (ranks come back NaN-propagated,
+    everything else is unaffected).
+    """
+    key = (int(steps), int(nwalkers), int(ndim), int(nburn),
+           int(thin))
+    fn = _POSTERIOR_CACHE.get(key)
+    if fn is None:
+        jax = get_jax()
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("mcmc.posterior", key)
+        fn = jax.jit(_build_summarize(*key))
+        if len(_POSTERIOR_CACHE) >= _POSTERIOR_CACHE_MAX:
+            _POSTERIOR_CACHE.pop(next(iter(_POSTERIOR_CACHE)))
+        _POSTERIOR_CACHE[key] = fn
+    return fn
+
+
+def summarize_posterior(out, burn=0.3, thin=1, truths=None):
+    """Reduce a sampler result dict (mcmc/sampler.py) on device and
+    fetch ONLY the summaries: ``{name: np.ndarray}`` per-lane arrays
+    plus the sampler's ``acc_frac``/``ok`` passed through.
+
+    ``burn`` — fraction (<1) or step count; ``truths[B, ndim]`` —
+    closed-form per-lane truths for the rank statistic (optional).
+    """
+    import jax.numpy as jnp
+
+    chain = out["chain"]
+    B, steps, nwalkers, ndim = chain.shape
+    nburn = int(burn * steps) if burn < 1 else int(burn)
+    nburn = min(nburn, steps - 2)
+    if truths is None:
+        truths = np.full((B, ndim), np.nan)
+    fn = posterior_program(steps, nwalkers, ndim, nburn, thin)
+    summ = fn(chain, out["loglike"], jnp.asarray(truths))
+    host = {k: np.asarray(v) for k, v in summ.items()}
+    host["acc_frac"] = np.asarray(out["acc_frac"])
+    host["ok"] = np.asarray(out["ok"])
+    return host
+
+
+def log_evidence(mean_loglikes, betas):
+    """Thermodynamic-integration log-evidence from tempered-lane
+    mean log-likelihoods: ``ln Z = ∫₀¹ ⟨ln L⟩_β dβ`` (trapezoid over
+    the sorted β ladder, β=0 … 1) under a NORMALISED prior.
+
+    ``mean_loglikes[..., T]`` — post-burn ⟨ln L⟩ per temperature
+    (the posterior program's ``mean_loglike`` column, lanes grouped
+    by epoch); ``betas[T]``. Broadcasts over leading axes, so one
+    call integrates every epoch of a batch.
+    """
+    betas = np.asarray(betas, dtype=float)
+    order = np.argsort(betas)
+    b = betas[order]
+    ll = np.asarray(mean_loglikes, dtype=float)[..., order]
+    return np.trapezoid(ll, b, axis=-1) if hasattr(np, "trapezoid") \
+        else np.trapz(ll, b, axis=-1)
+
+
+def flatchain_summary(flatchain, var_names, truths=None):
+    """Host-side summary of a single-epoch ``flatchain[N, ndim]``
+    (the fit/ensemble.py MinimizerResult field) — the operator-path
+    twin of the device reductions, for
+    ``Dynspec.get_scint_params(method='mcmc')``."""
+    flat = np.asarray(flatchain, dtype=float)
+    out = {}
+    for i, name in enumerate(var_names):
+        col = flat[:, i]
+        q = np.quantile(col, [0.025, 0.16, 0.5, 0.84, 0.975])
+        rec = {"q025": q[0], "q16": q[1], "q50": q[2], "q84": q[3],
+               "q975": q[4], "mean": float(np.mean(col)),
+               "std": float(np.std(col))}
+        if truths is not None and name in truths:
+            rec["rank"] = float(np.mean(col < truths[name]))
+        out[name] = rec
+    return out
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("mcmc.posterior")
+def _probe_mcmc_posterior():
+    """The cached chain-summary program at a fixed 2-lane, 8-step,
+    4-walker, 2-parameter geometry (burn 2, thin 1)."""
+    import jax
+
+    fn = posterior_program(8, 4, 2, 2, 1)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 8, 4, 2), np.float32), S((2, 8, 4), np.float32),
+                S((2, 2), np.float32))

@@ -1,0 +1,271 @@
+"""Batched affine-invariant ensemble sampling: walkers × epochs on
+traced batch axes of ONE cached jitted program.
+
+The single-epoch sampler (fit/ensemble.py, now a B=1 shim over this
+module) runs the Goodman & Weare (2010) stretch move as a
+``lax.scan`` whose body evaluates every proposal's log-probability
+under ``jax.vmap`` over walkers. This module adds the second batch
+axis: a whole SURVEY BATCH of epochs rides ``jax.vmap`` over lanes of
+the same scan, each lane carrying its own PRNG key, walker ensemble,
+data pytree and inverse temperature. Lanes are mathematically
+independent — the vmapped program performs exactly the per-lane
+arithmetic of the B=1 program, which is what makes the single-lane
+parity pin (tests/test_mcmc.py) and the bitwise NaN-lane quarantine
+possible.
+
+Program identity: compiled programs are cached in a FIFO dict keyed
+on (caller geometry key, nwalkers, ndim, a) and every cache miss is
+one :func:`~scintools_tpu.obs.retrace.record_build` at the
+``mcmc.sampler`` site — the tier-1 ``retrace_guard`` gate and the
+jaxprcheck program audit (JP2xx) both read that registry. ``steps``
+is a jit-static argument; data arrays, bounds, temperatures and keys
+are all traced, so a regime sweep (different data values, same
+shapes) is ZERO new programs.
+
+Per-lane health (robust/guards.py bit conventions): ``BAD_INPUT``
+(bit 1) marks a lane whose data pytree carried non-finite values;
+``BAD_FIT`` (bit 8) marks a lane whose final ensemble holds no
+finite log-probability (the sampler never found a finite-likelihood
+point — e.g. an all-NaN likelihood surface). A flagged lane's chain
+is frozen at its initial ensemble (every proposal rejects against a
+−inf log-probability), so the quarantine is bitwise local: healthy
+neighbours are untouched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+from ..robust import guards
+
+#: FIFO cache of compiled sampler programs — one entry per
+#: (geometry key, nwalkers, ndim, a); see :func:`ensemble_program`.
+_SAMPLER_CACHE = {}
+_SAMPLER_CACHE_MAX = 32
+
+
+def _tree_finite(data):
+    """Scalar bool: every leaf of the (single-lane) data pytree is
+    finite — the ``BAD_INPUT`` stage flag, traced-safe."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(data)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    ok = jnp.asarray(True)
+    for leaf in leaves:
+        ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def _build_run(loglike, nwalkers, ndim, a):
+    """The batched program body: ``run(keys, pos0, lo, hi, betas,
+    data, steps)`` (see :func:`ensemble_program` for the contract).
+    ``loglike(x, data) -> scalar`` is the per-walker, per-lane
+    log-likelihood kernel."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    if nwalkers % 2:
+        raise ValueError("nwalkers must be even for the half-ensemble "
+                         "stretch move")
+    half = nwalkers // 2
+
+    def run(keys, pos0, lo, hi, betas, data, steps):
+        steps = int(steps)                       # jit-static
+
+        def run_one(key, pos0, beta, data):
+            def lp_ll(x):
+                ll = loglike(x, data)
+                in_bounds = jnp.all(x >= lo) & jnp.all(x <= hi)
+                lp = jnp.where(jnp.isfinite(ll) & in_bounds,
+                               beta * ll, -jnp.inf)
+                return lp, ll
+
+            vlogp = jax.vmap(lp_ll)
+
+            def half_update(active, other, lp_active, ll_active, key):
+                ku, kp, ka = jax.random.split(key, 3)
+                z = ((a - 1.0) * jax.random.uniform(ku, (half,))
+                     + 1.0) ** 2 / a
+                partners = jax.random.randint(kp, (half,), 0, half)
+                comp = other[partners]
+                prop = comp + z[:, None] * (active - comp)
+                lp_prop, ll_prop = vlogp(prop)
+                log_accept = (ndim - 1) * jnp.log(z) \
+                    + lp_prop - lp_active
+                accept = jnp.log(jax.random.uniform(ka, (half,))) \
+                    < log_accept
+                active = jnp.where(accept[:, None], prop, active)
+                lp_active = jnp.where(accept, lp_prop, lp_active)
+                ll_active = jnp.where(accept, ll_prop, ll_active)
+                return active, lp_active, ll_active, accept
+
+            def step(carry, key):
+                pos, lp, ll = carry
+                k1, k2 = jax.random.split(key)
+                first, lp1, ll1, acc1 = half_update(
+                    pos[:half], pos[half:], lp[:half], ll[:half], k1)
+                second, lp2, ll2, acc2 = half_update(
+                    pos[half:], first, lp[half:], ll[half:], k2)
+                pos = jnp.concatenate([first, second])
+                lp = jnp.concatenate([lp1, lp2])
+                ll = jnp.concatenate([ll1, ll2])
+                n_acc = jnp.sum(acc1) + jnp.sum(acc2)
+                return (pos, lp, ll), (pos, lp, ll, n_acc)
+
+            lp0, ll0 = vlogp(pos0)
+            step_keys = jax.random.split(key, steps)
+            (_, lp_end, _), (chain, lps, lls, n_acc) = jax.lax.scan(
+                step, (pos0, lp0, ll0), step_keys)
+            acc_frac = jnp.sum(n_acc) / (steps * nwalkers)
+            ok = guards.health_code(
+                input_ok=_tree_finite(data),
+                fit_ok=jnp.any(jnp.isfinite(lp_end)), xp=jnp)
+            return chain, lps, lls, acc_frac, ok
+
+        chain, lps, lls, acc, ok = jax.vmap(run_one)(
+            keys, pos0, betas, data)
+        return {"chain": chain, "logp": lps, "loglike": lls,
+                "acc_frac": acc, "ok": ok}
+
+    return run
+
+
+def ensemble_program(build_loglike, key, nwalkers, ndim, a=2.0):
+    """The cached, jitted batched sampler for one geometry.
+
+    ``build_loglike() -> loglike(x[ndim], data) -> scalar`` builds the
+    per-walker log-likelihood kernel (only called on a cache miss);
+    ``key`` is the caller's hashable geometry key — it must determine
+    the kernel (model identity, static shapes, fixed parameters), the
+    way every other ``record_build`` site keys its cache.
+
+    Returns ``run(keys[B, 2], pos0[B, nw, ndim], lo[ndim], hi[ndim],
+    betas[B], data, steps) -> dict`` where ``data`` is a pytree whose
+    array leaves carry a leading lane axis ``B`` and ``steps`` is
+    static. The result dict holds device arrays::
+
+        chain    (B, steps, nw, ndim)   walker positions per step
+        logp     (B, steps, nw)         tempered log-posterior
+        loglike  (B, steps, nw)         UNtempered log-likelihood
+        acc_frac (B,)                   acceptance fraction
+        ok       (B,) int32             guards health bitmask
+
+    ``betas`` are per-lane inverse temperatures (1.0 for plain
+    sampling); tempered lanes ride the same batch axis for the
+    thermodynamic-integration evidence (mcmc/posterior.py).
+    """
+    full_key = (key, int(nwalkers), int(ndim), float(a))
+    fn = _SAMPLER_CACHE.get(full_key)
+    if fn is None:
+        jax = get_jax()
+        from ..obs import retrace as _retrace
+
+        _retrace.record_build("mcmc.sampler", full_key)
+        fn = jax.jit(_build_run(build_loglike(), nwalkers, ndim, a),
+                     static_argnames="steps")
+        if len(_SAMPLER_CACHE) >= _SAMPLER_CACHE_MAX:
+            _SAMPLER_CACHE.pop(next(iter(_SAMPLER_CACHE)))
+        _SAMPLER_CACHE[full_key] = fn
+    return fn
+
+
+def lane_keys(seeds, salt=0):
+    """Per-lane legacy uint32 PRNG keys from integer epoch seeds
+    (``salt`` derives independent streams — walker init vs chain —
+    from the same seed). Built on device, stable per seed: an
+    epoch's chain is independent of batch grouping and resume
+    boundaries."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    seeds = jnp.asarray(seeds, dtype=jnp.uint32)
+    return jax.vmap(
+        lambda s: jax.random.fold_in(
+            jax.random.PRNGKey(s), salt).astype(jnp.uint32))(seeds)
+
+
+def walker_init(keys, x0, lo, hi, nwalkers, rel_jitter=0.05):
+    """Deterministic on-device walker-ensemble init: per-lane walkers
+    scattered around ``x0[B, ndim]`` with relative jitter, clipped
+    strictly inside any finite bounds. ``keys[B, 2]`` are lane keys
+    (:func:`lane_keys`); eager jax ops — nothing here compiles a
+    cached program."""
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    x0 = jnp.asarray(x0)
+    B, ndim = x0.shape
+    scale = rel_jitter * jnp.maximum(jnp.abs(x0), 1e-8)
+    noise = jax.vmap(
+        lambda k: jax.random.normal(k, (nwalkers, ndim)))(
+            jnp.asarray(keys))
+    pos = x0[:, None, :] + scale[:, None, :] * noise
+    lo = jnp.asarray(lo)
+    hi = jnp.asarray(hi)
+    span = jnp.where(jnp.isfinite(hi - lo), hi - lo, 1.0)
+    lo_in = jnp.where(jnp.isfinite(lo), lo + 1e-9 * span, lo)
+    hi_in = jnp.where(jnp.isfinite(hi), hi - 1e-9 * span, hi)
+    return jnp.clip(pos, lo_in, hi_in)
+
+
+def run_ensemble_batched(build_loglike, key, data, x0, lo, hi,
+                         nwalkers=32, steps=500, seeds=None, betas=None,
+                         a=2.0, rel_jitter=0.05):
+    """One-call batched sampling: walker init + chain, device-resident
+    results. ``data`` leaves carry the lane axis ``B``; ``x0[B,
+    ndim]`` per-lane start points; ``seeds[B]`` integer epoch seeds
+    (default ``arange``). Returns the :func:`ensemble_program` result
+    dict (device arrays — reduce with mcmc/posterior.py before
+    fetching)."""
+    import jax.numpy as jnp
+
+    x0 = jnp.asarray(x0)
+    B, ndim = x0.shape
+    if seeds is None:
+        seeds = np.arange(B)
+    pos0 = walker_init(lane_keys(seeds, salt=1), x0, lo, hi, nwalkers,
+                       rel_jitter=rel_jitter)
+    if betas is None:
+        betas = jnp.ones((B,), dtype=pos0.dtype)
+    run = ensemble_program(build_loglike, key, nwalkers, ndim, a=a)
+    return run(lane_keys(seeds, salt=2), pos0, jnp.asarray(lo),
+               jnp.asarray(hi), jnp.asarray(betas), data, steps)
+
+
+# ---------------------------------------------------------------------
+# abstract program probe (obs/programs.py) — audited by the jaxlint
+# JP2xx program pass (tools/jaxlint/program.py)
+# ---------------------------------------------------------------------
+
+from ..obs.programs import register_probe as _register_probe  # noqa: E402
+
+
+@_register_probe("mcmc.sampler")
+def _probe_mcmc_sampler():
+    """The cached batched stretch-move program at a toy 2-parameter
+    gaussian likelihood: 2 lanes x 4 walkers x 3 steps, 8-point data
+    vectors (mu, sigma traced per lane)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        def loglike(x, data):
+            y, w = data
+            return -0.5 * jnp.sum(((y - x[0]) * w * x[1]) ** 2)
+
+        return loglike
+
+    run = ensemble_program(build, ("probe.gauss", 8), 4, 2)
+    S = jax.ShapeDtypeStruct
+    fn = functools.partial(run, steps=3)
+    return (lambda keys, pos0, lo, hi, betas, y, w:
+            fn(keys, pos0, lo, hi, betas, (y, w))), (
+        S((2, 2), np.uint32), S((2, 4, 2), np.float32),
+        S((2,), np.float32), S((2,), np.float32),
+        S((2,), np.float32), S((2, 8), np.float32),
+        S((2, 8), np.float32))

@@ -1,0 +1,584 @@
+"""Coverage-calibrated posterior SURVEYS: the scenario factory's
+closed-form truths against full MCMC posteriors, at fleet scale.
+
+The closed loop (sim/scenario.py) proved recovery of point estimates;
+this module upgrades the product to POSTERIORS: every factory epoch's
+ACF cuts are sampled by the batched ensemble engine (walkers × epochs
+on traced batch axes, mcmc/sampler.py), the secondary-spectrum arc
+gets the reference's curvature-peak-probability posterior on the same
+batch axes, and only per-lane summaries (quantiles, ESS, split-R̂,
+truth ranks) round-trip the host into journal rows. The whole thing
+runs through ``run_survey_batched`` — ladder fallback, CRC journal,
+SIGKILL resume, RunReport — and through the fleet tier by spec
+(:func:`run_mcmc_fleet`), making it the second large embarrassingly
+parallel fleet workload after the scenario survey.
+
+**Calibration is the acceptance gate**: each journal row carries the
+rank of the lane's closed-form η/τ_d/Δν_d truth within its posterior
+samples. Over an epoch batch those ranks must be uniform (SBC) and
+the stated credible intervals must cover the truths at their stated
+rates within tolerance — :func:`coverage_summary` aggregates them
+per regime, and tests/test_mcmc.py turns a coverage failure into a
+tier-1 failure, not a warning.
+
+Tier ladder: FUSED = the batched engine over factory stacks; STAGED =
+the same engine, single lane, on the factory's highest-precision
+oracle path; NUMPY = the reference ``Simulation`` + the host numpy
+ensemble sampler (fit/fitter.py:sample_emcee) + the host arc fit —
+genuinely jax-free, with gaussian η quantiles from the parabola fit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import get_jax
+from ..obs import metrics as _metrics
+from ..utils import slog
+from .likelihood import make_acf1d_loglike, make_eta_profile_loglike
+from .posterior import log_evidence, summarize_posterior
+from .sampler import run_ensemble_batched
+
+# the regime sweep and closed-form truth model are the scenario
+# factory's (one calibration, two consumers)
+from ..sim.scenario import (DEFAULT_REGIMES, _lane_table,
+                            make_sspec_db_batch, scenario_truths)
+
+#: posterior parameters journaled per epoch, with their truth keys
+_PARAMS = ("tau", "dnu", "eta")
+
+
+def _truths(p, rf, ds, dt, freq, dlam):
+    t = scenario_truths(p["mb2"], p["ar"], p["psi"], p["alpha"],
+                        rf=rf, ds=ds, dt=dt, freq=freq, dlam=dlam)
+    return {k: float(v) for k, v in t.items()}
+
+
+def _param_row(name, q16, q50, q84, std, ess, rhat, rank, true,
+               q025=None, q975=None, fse=None):
+    """One parameter's journal columns (JSON scalars).
+
+    Raw posterior quantiles/rank are journaled as sampled. The
+    COVERAGE columns (``cov68``/``cov95``/``rank``) additionally fold
+    a finite-scintle error ``fse`` (when given) into the posterior
+    width in quadrature — the reference's own error model
+    (dynspec.py:1012-1020): a single epoch's ACF posterior measures
+    the realisation's parameters, while the closed-form truth is the
+    ENSEMBLE parameter, whose dominant epoch-level uncertainty is
+    finite-scintle variance. Without ``fse`` the raw sample rank and
+    interval membership are used."""
+    from scipy.stats import norm as _norm
+
+    row = {
+        f"{name}_q16": float(q16), f"{name}_q50": float(q50),
+        f"{name}_q84": float(q84), f"{name}_std": float(std),
+        f"{name}_ess": float(ess), f"{name}_rhat": float(rhat),
+        f"{name}_rank": float(rank), f"{name}_true": float(true),
+    }
+    if q025 is not None:
+        row[f"{name}_q025"] = float(q025)
+        row[f"{name}_q975"] = float(q975)
+    if not np.isfinite(true):
+        row[f"{name}_cov68"] = 0
+        row[f"{name}_cov95"] = 0
+        return row
+    if fse is not None and np.isfinite(fse):
+        sig = float(np.hypot(std, fse))
+        row[f"{name}_fse"] = float(fse)
+        row[f"{name}_cov68"] = int(abs(q50 - true) <= sig)
+        row[f"{name}_cov95"] = int(abs(q50 - true) <= 1.96 * sig)
+        row[f"{name}_rank"] = float(_norm.cdf(true, loc=q50,
+                                              scale=max(sig, 1e-30)))
+    else:
+        row[f"{name}_cov68"] = int(q16 <= true <= q84)
+        row[f"{name}_cov95"] = int(q025 <= true <= q975) \
+            if q025 is not None else 0
+    return row
+
+
+def mcmc_scenario_workload(regimes=DEFAULT_REGIMES,
+                           epochs_per_regime=48, ns=128, nf=64,
+                           dlam=0.05, rf=1.0, ds=0.02, dt=30.0,
+                           freq=1400.0, inner=0.001, seed=0,
+                           nwalkers=32, steps=400, burn=0.4, thin=1,
+                           numsteps=1500, eta_window=(0.2, 5.0),
+                           alpha_fit=5 / 3):
+    """The posterior survey as a WORKLOAD (epoch table + batched and
+    per-epoch process functions), runner-agnostic: fed to
+    ``run_survey_batched`` by :func:`run_mcmc_survey` in-process, or
+    resolved by spec in fleet worker processes
+    (``{"target": "scintools_tpu.mcmc.survey:mcmc_scenario_workload",
+    "params": {...}}`` — every parameter is JSON-able).
+
+    Per epoch, TWO posteriors ride the batch axes:
+
+    - ``(τ_d, Δν_d, amp, __lnsigma)`` from the joint 1-D ACF-cut
+      likelihood (mcmc/likelihood.py:make_acf1d_loglike — the sampled
+      noise scale absorbs the Bartlett formula's underestimate on
+      simulated epochs, which is what makes the coverage honest);
+    - ``η`` from the curvature-peak-probability of the folded
+      arc-normalised Doppler profile, sampled in window-normalised
+      units ``u = η/η_ref`` so every lane shares one program and one
+      box prior (``eta_window``).
+
+    Returns ``{"epochs", "process_batch", "process"}``.
+    """
+    get_jax()
+    import jax.numpy as jnp
+
+    from ..fit.batch import (acf_cuts_batch, bartlett_weights,
+                             initial_guesses_batch)
+    from ..ops.fitarc import fit_arc_batch
+    from ..ops.sspec import sspec_axes
+    from ..robust.ladder import TIER_NUMPY
+    from ..sim.factory import lane_keys_from_seeds, simulate_scenarios
+
+    nt = ns
+    df = freq * dlam / (nf - 1)
+    tobs, bw = nt * dt, nf * df
+    fdop, tdel, _ = sspec_axes(nf, nt, dt, df)
+    sspec_db = make_sspec_db_batch(nt, nf)
+    epochs = _lane_table(regimes, epochs_per_regime, seed)
+    H = (int(numsteps) + int(numsteps) % 2) // 2
+
+    acf_build, acf_names, acf_lo, acf_hi, acf_key = \
+        make_acf1d_loglike(nt, nf, dt, df, alpha=alpha_fit,
+                           is_weighted=False)
+    eta_build, _, _, _, eta_key = make_eta_profile_loglike(H)
+    u_lo = np.array([float(eta_window[0])])
+    u_hi = np.array([float(eta_window[1])])
+
+    def _acf_x0(tcuts, fcuts):
+        """Per-lane start points (device, eager ops): the reference
+        initial-guess recipe + ln σ₀ = ln 0.1."""
+        tau0, dnu0, amp0, _ = initial_guesses_batch(
+            tcuts, fcuts, dt, df, tobs, bw, jnp)
+        lnsig0 = jnp.full(tau0.shape, np.log(0.1), tcuts.dtype)
+        return jnp.stack(
+            [jnp.clip(tau0, acf_lo[0], None),
+             jnp.clip(dnu0, acf_lo[1], None),
+             jnp.clip(amp0, acf_lo[2], None), lnsig0], axis=-1)
+
+    def _eta_data(arcs, etas_ref):
+        """Fixed-shape η-sampler data from the arc-fit diagnostics:
+        window-normalised profile grids padded to H (floor-padded
+        power, ascending u beyond the window), per-lane peak power
+        and pooled sspec noise. A NaN-quarantined arc lane gets NaN
+        data so the engine's BAD_INPUT mask condemns it bitwise."""
+        B = len(arcs)
+        prof = np.full((B, H), np.nan, dtype=np.float32)
+        urow = np.full((B, H), np.nan, dtype=np.float32)
+        pmax = np.full((B,), np.nan, dtype=np.float32)
+        noise = np.full((B,), np.nan, dtype=np.float32)
+        x0 = np.ones((B, 1), dtype=np.float32)
+        for b, fit in enumerate(arcs):
+            spec = getattr(fit, "profile", None)
+            eta_s = getattr(fit, "eta_array", None)
+            if (spec is None or eta_s is None
+                    or not np.isfinite(getattr(fit, "eta", np.nan))
+                    or not np.all(np.isfinite(spec))
+                    or not np.isfinite(getattr(fit, "noise", np.nan))
+                    or getattr(fit, "noise", 0) <= 0):
+                continue
+            L = min(len(spec), H)
+            u = np.asarray(eta_s[:L], float) / etas_ref[b]
+            if L < 4 or not np.all(np.diff(u) > 0):
+                continue                 # unusable / non-ascending grid
+            floor = float(np.min(spec[:L]))
+            prof[b, :L] = spec[:L]
+            prof[b, L:] = floor
+            urow[b, :L] = u
+            if L < H:
+                urow[b, L:] = u[-1] + 1.0 + np.arange(H - L)
+            pmax[b] = float(np.max(spec[:L]))
+            noise[b] = float(fit.noise)
+            eta_fit = getattr(fit, "eta", np.nan)
+            u0 = eta_fit / etas_ref[b] if np.isfinite(eta_fit) \
+                else u[int(np.argmax(spec[:L]))]
+            x0[b, 0] = np.clip(u0, eta_window[0] * 1.05,
+                               eta_window[1] * 0.95)
+        return (jnp.asarray(prof), jnp.asarray(urow),
+                jnp.asarray(pmax), jnp.asarray(noise)), x0
+
+    def _sample_stack(dyns, payloads, seeds):
+        """Both posteriors over a device-resident epoch stack
+        ``dyns[B, nf, nt]``: batched ACF-cut sampling + batched
+        η-profile sampling, summaries fetched host-side."""
+        B = len(payloads)
+        truths = [_truths(p, rf, ds, dt, freq, dlam) for p in payloads]
+        tcuts, fcuts = acf_cuts_batch(dyns)
+        wt = bartlett_weights(tcuts, nt, xp=jnp)
+        wf = bartlett_weights(fcuts, nf, xp=jnp)
+        x0 = _acf_x0(tcuts, fcuts)
+        out = run_ensemble_batched(
+            acf_build, acf_key, (tcuts, fcuts, wt, wf), x0,
+            acf_lo.astype(np.float32), acf_hi.astype(np.float32),
+            nwalkers=nwalkers, steps=steps, seeds=seeds)
+        tr = np.full((B, 4), np.nan)
+        tr[:, 0] = [t["tau"] for t in truths]
+        tr[:, 1] = [t["dnu"] for t in truths]
+        summ = summarize_posterior(out, burn=burn, thin=thin,
+                                  truths=tr)
+
+        sec_db = sspec_db(dyns)
+        etas_ref = np.array([t["eta"] for t in truths])
+        arcs = fit_arc_batch(
+            np.asarray(sec_db), tdel, fdop, numsteps=numsteps,
+            etamin=eta_window[0] * etas_ref,
+            etamax=eta_window[1] * etas_ref,
+            sspecs_device=sec_db, full_output=True)
+        eta_data, u0 = _eta_data(arcs, etas_ref)
+        out_eta = run_ensemble_batched(
+            eta_build, eta_key, eta_data, jnp.asarray(u0),
+            u_lo.astype(np.float32), u_hi.astype(np.float32),
+            nwalkers=nwalkers, steps=steps,
+            seeds=[s + 500009 for s in seeds])
+        summ_eta = summarize_posterior(
+            out_eta, burn=burn, thin=thin,
+            truths=np.ones((B, 1)))
+        _metrics.counter(
+            "mcmc_epochs_sampled_total",
+            help="epochs whose posteriors the batched engine sampled",
+        ).inc(B)
+        _metrics.counter(
+            "mcmc_sampler_steps_total",
+            help="ensemble steps advanced across all sampled lanes",
+        ).inc(2 * B * steps)
+        return summ, summ_eta, truths, etas_ref
+
+    def _fse(tau50, dnu50):
+        """Finite-scintle errors at the posterior medians (the
+        reference's nscint recipe, dynspec.py:1012-1016)."""
+        nscint = ((1 + 0.2 * bw / max(dnu50, 1e-30))
+                  * (1 + 0.2 * tobs / (max(tau50, 1e-30)
+                                       * np.log(2))))
+        rt = 2 * np.sqrt(max(nscint, 1.0))
+        return tau50 / rt, dnu50 / rt
+
+    def _result(p, summ, summ_eta, truths_i, eta_ref, i, code):
+        row = {"ok": int(code), "regime": p["regime"],
+               "acc_frac": float(summ["acc_frac"][i]),
+               "eta_acc_frac": float(summ_eta["acc_frac"][i])}
+        fses = _fse(float(summ["q50"][i, 0]),
+                    float(summ["q50"][i, 1]))
+        for j, name in enumerate(("tau", "dnu")):
+            row.update(_param_row(
+                name, summ["q16"][i, j], summ["q50"][i, j],
+                summ["q84"][i, j], summ["std"][i, j],
+                summ["ess"][i, j], summ["rhat"][i, j],
+                summ["rank"][i, j], truths_i[name],
+                q025=summ["q025"][i, j], q975=summ["q975"][i, j],
+                fse=fses[j]))
+        s = float(eta_ref)
+        row.update(_param_row(
+            "eta", summ_eta["q16"][i, 0] * s,
+            summ_eta["q50"][i, 0] * s, summ_eta["q84"][i, 0] * s,
+            summ_eta["std"][i, 0] * s, summ_eta["ess"][i, 0],
+            summ_eta["rhat"][i, 0], summ_eta["rank"][i, 0],
+            truths_i["eta"], q025=summ_eta["q025"][i, 0] * s,
+            q975=summ_eta["q975"][i, 0] * s))
+        return row
+
+    def _params_ok(p):
+        vals = (p["mb2"], p["ar"], p["psi"], p["alpha"])
+        return (all(np.isfinite(v) for v in vals) and p["mb2"] > 0
+                and p["ar"] > 0 and 0 < p["alpha"] < 2)
+
+    def process_batch(payloads, tier=None):
+        B = len(payloads)
+        seeds = [p["seed"] for p in payloads]
+        keys = lane_keys_from_seeds(seeds)
+        dyn, code = simulate_scenarios(
+            B, mb2=[p["mb2"] for p in payloads],
+            ar=[p["ar"] for p in payloads],
+            psi=[p["psi"] for p in payloads],
+            alpha=[p["alpha"] for p in payloads],
+            ns=ns, nf=nf, dlam=dlam, rf=rf, ds=ds, inner=inner,
+            keys=keys, with_ok=True, device_out=True)
+        dyns = jnp.transpose(dyn, (0, 2, 1))          # (B, nf, nt)
+        summ, summ_eta, truths, etas_ref = _sample_stack(
+            dyns, payloads, seeds)
+        code = np.asarray(code)
+        out = []
+        for i, p in enumerate(payloads):
+            lane = int(code[i]) | int(summ["ok"][i]) \
+                | int(summ_eta["ok"][i])
+            if lane:
+                _metrics.counter(
+                    "mcmc_lanes_quarantined_total",
+                    help="sampled lanes rejected by the health mask",
+                ).inc()
+            out.append(_result(p, summ, summ_eta, truths[i],
+                               etas_ref[i], i, lane))
+        return out
+
+    def process(p, tier=None):
+        """Per-epoch fallback tiers (PR-10 ladder contract: tiers
+        RAISE on unhealthy lanes — a returned row is an accepted
+        result)."""
+        from ..io import MalformedInputError
+
+        if not _params_ok(p):
+            raise MalformedInputError(
+                f"<lane seed={p['seed']}>",
+                "invalid regime params (non-finite or out of range)")
+        if tier == TIER_NUMPY:
+            return _process_numpy(p)
+        # staged tier: single lane on the factory's exact oracle path
+        keys = lane_keys_from_seeds([p["seed"]])
+        dyn, code = simulate_scenarios(
+            1, mb2=p["mb2"], ar=p["ar"], psi=p["psi"],
+            alpha=p["alpha"], ns=ns, nf=nf, dlam=dlam, rf=rf, ds=ds,
+            inner=inner, keys=keys, precision="highest",
+            with_ok=True, device_out=True)
+        lane = int(np.asarray(code)[0])
+        if lane != 0:
+            raise ValueError(f"staged lane unhealthy (code {lane})")
+        dyns = jnp.transpose(dyn, (0, 2, 1)).astype(jnp.float32)
+        summ, summ_eta, truths, etas_ref = _sample_stack(
+            dyns, [p], [p["seed"]])
+        lane = int(summ["ok"][0]) | int(summ_eta["ok"][0])
+        if lane != 0:
+            raise ValueError(
+                f"staged sampler lane unhealthy (code {lane})")
+        return _result(p, summ, summ_eta, truths[0], etas_ref[0], 0,
+                       0)
+
+    def _process_numpy(p):
+        """Jax-free tier: reference simulator, host numpy ensemble
+        sampler on the ACF cuts, host arc fit with gaussian η
+        quantiles (an approximation, flagged nowhere — the numpy tier
+        trades posterior fidelity for independence from the jax
+        stack; docs/posteriors.md)."""
+        from ..fit.fitter import sample_emcee
+        from ..fit.models import scint_acf_model
+        from ..fit.parameters import Parameters
+        from ..ops.acf import autocovariance
+        from ..ops.fitarc import fit_arc
+        from ..ops.sspec import secondary_spectrum
+        from ..sim.simulation import Simulation
+        from scipy.stats import norm as _norm
+
+        t = _truths(p, rf, ds, dt, freq, dlam)
+        sim = Simulation(ns=ns, nf=nf, dlam=dlam, seed=p["seed"],
+                         mb2=p["mb2"], ar=p["ar"], psi=p["psi"],
+                         alpha=p["alpha"], rf=rf, ds=ds, inner=inner,
+                         dt=dt, freq=freq, backend="numpy")
+        dyn1 = np.asarray(sim.dyn, dtype=float)[None]     # (1, nf, nt)
+        acf = autocovariance(dyn1, backend="numpy")[0]
+        nf2, nt2 = acf.shape
+        yt = acf[nf2 // 2, nt2 // 2:]
+        yf = acf[nf2 // 2:, nt2 // 2]
+        from ..fit.batch import bartlett_weights as _bw
+
+        wt = _bw(yt, nt, xp=np)
+        wf = _bw(yf, nf, xp=np)
+        params = Parameters()
+        params.add("tau", value=max(dt, t["tau"]), vary=True,
+                   min=1e-3 * dt, max=np.inf)
+        params.add("dnu", value=max(df, t["dnu"]), vary=True,
+                   min=1e-3 * df, max=np.inf)
+        params.add("amp", value=1.0, vary=True, min=1e-8, max=np.inf)
+        params.add("alpha", value=alpha_fit, vary=False)
+        res = sample_emcee(
+            scint_acf_model, params,
+            ((dt * np.arange(len(yt)), df * np.arange(len(yf))),
+             (yt, yf), (wt, wf)),
+            nwalkers=min(nwalkers, 24), steps=min(steps, 250),
+            burn=burn, thin=thin, seed=p["seed"] % (2 ** 31),
+            is_weighted=False)
+        flat = res.flatchain
+        # -1.0 sentinels: the host tier has no jitted-lane acceptance
+        # bookkeeping; NaN would be nonstandard JSON in the journal
+        row = {"ok": 0, "regime": p["regime"],
+               "acc_frac": -1.0, "eta_acc_frac": -1.0}
+        fses = _fse(float(np.median(flat[:, 0])),
+                    float(np.median(flat[:, 1])))
+        for j, name in enumerate(("tau", "dnu")):
+            col = flat[:, j]
+            q025, q16, q50, q84, q975 = np.quantile(
+                col, [0.025, 0.16, 0.5, 0.84, 0.975])
+            row.update(_param_row(
+                name, q16, q50, q84, np.std(col), len(col), 1.0,
+                float(np.mean(col < t[name])), t[name],
+                q025=q025, q975=q975, fse=fses[j]))
+        _, _, sec = secondary_spectrum(dyn1[0], dt, df,
+                                       backend="numpy")
+        arc = fit_arc(np.asarray(sec), tdel, fdop, numsteps=numsteps,
+                      etamin=eta_window[0] * t["eta"],
+                      etamax=eta_window[1] * t["eta"],
+                      backend="numpy")[0]
+        eta_f, err = float(arc.eta), float(arc.etaerr)
+        if not (np.isfinite(eta_f) and np.isfinite(err) and err > 0):
+            raise ValueError("numpy-tier arc fit refused")
+        q025, q16, q50, q84, q975 = _norm.ppf(
+            [0.025, 0.16, 0.5, 0.84, 0.975], loc=eta_f, scale=err)
+        row.update(_param_row("eta", q16, q50, q84, err,
+                              -1.0, 1.0,
+                              float(_norm.cdf(t["eta"], loc=eta_f,
+                                              scale=err)), t["eta"],
+                              q025=q025, q975=q975))
+        return row
+
+    return {"epochs": epochs, "process_batch": process_batch,
+            "process": process}
+
+
+def coverage_summary(results, params=_PARAMS):
+    """Per-regime coverage calibration over the healthy lanes of a
+    posterior-survey result map: empirical 68% credible-interval
+    coverage, mean truth rank, and the max |ECDF − uniform| deviation
+    of the ranks (a finite-sample Kolmogorov–Smirnov distance — the
+    SBC uniformity statistic the calibration gate tests)."""
+    by_regime = {}
+    for rec in results.values():
+        if not isinstance(rec, dict) or "tau_rank" not in rec:
+            continue
+        by_regime.setdefault(rec.get("regime", "?"), []).append(rec)
+    out = {}
+    for regime, recs in sorted(by_regime.items()):
+        healthy = [r for r in recs if int(r.get("ok", 1)) == 0]
+        d = {"n": len(recs), "n_ok": len(healthy)}
+        for name in params:
+            ranks = np.array([r[f"{name}_rank"] for r in healthy
+                              if np.isfinite(r[f"{name}_rank"])])
+            cov = np.array([r[f"{name}_cov68"] for r in healthy])
+            cov95 = np.array([r.get(f"{name}_cov95", 0)
+                              for r in healthy])
+            if len(ranks):
+                ecdf = np.arange(1, len(ranks) + 1) / len(ranks)
+                ks = float(np.max(np.abs(np.sort(ranks) - ecdf)))
+            else:
+                ks = float("nan")
+            d[f"{name}_cov68"] = float(np.mean(cov)) if len(cov) \
+                else float("nan")
+            d[f"{name}_cov95"] = float(np.mean(cov95)) \
+                if len(cov95) else float("nan")
+            d[f"{name}_rank_mean"] = float(np.mean(ranks)) \
+                if len(ranks) else float("nan")
+            d[f"{name}_rank_ks"] = ks
+        out[regime] = d
+    return out
+
+
+def run_mcmc_survey(workdir, batch_size=48, resume=True,
+                    heartbeat=None, report=True, retries=1,
+                    **workload_params):
+    """The posterior survey as a journaled, resumable product:
+    :func:`mcmc_scenario_workload` through ``run_survey_batched``
+    (per-epoch quarantine, tier ladder, CRC journal, SIGKILL resume).
+    Returns the runner result extended with ``"coverage"``
+    (:func:`coverage_summary`); with ``report=True`` the RunReport is
+    rewritten with the coverage block under ``"mcmc_coverage"`` so
+    the artifact carries the calibration verdict."""
+    import time
+
+    from ..obs import report as _report
+    from ..robust import run_survey_batched
+
+    wl = mcmc_scenario_workload(**workload_params)
+    epochs = wl["epochs"]
+    t0 = time.perf_counter()
+    with slog.span("mcmc.survey", n_epochs=len(epochs),
+                   batch_size=batch_size, workdir=str(workdir)):
+        out = run_survey_batched(
+            epochs, wl["process_batch"], workdir,
+            process=wl["process"], batch_size=batch_size,
+            retries=retries, resume=resume, heartbeat=heartbeat,
+            report=False)
+    wall_s = time.perf_counter() - t0
+    cov = coverage_summary(out["results"])
+    out["coverage"] = cov
+    slog.log_event("mcmc.coverage_summary", n_epochs=len(epochs),
+                   coverage={r: {k: (round(v, 4)
+                                     if isinstance(v, float) else v)
+                                 for k, v in d.items()}
+                             for r, d in cov.items()})
+    if report:
+        _report.write_run_report(workdir, _report.build_run_report(
+            out["summary"], out["outcomes"], wall_s=wall_s,
+            runner="run_mcmc_survey", extra={"mcmc_coverage": cov}))
+    return out
+
+
+def run_mcmc_fleet(workdir, n_workers=3, batch_size=48, timeout=900.0,
+                   pod_options=None, plane_port=None,
+                   **workload_params):
+    """The posterior survey DISTRIBUTED over the PR-11 fleet tier:
+    epoch-batch tasks on the shared work queue, lease-based stealing,
+    per-worker journals merged deterministically, pod-level
+    observability (``plane_port`` starts the merged telemetry
+    plane). ``workload_params`` travel to worker processes by spec
+    file — all JSON-able. Returns the pod result extended with
+    ``"coverage"``."""
+    from ..fleet.pod import run_pod
+
+    spec = {"target": "scintools_tpu.mcmc.survey:"
+                      "mcmc_scenario_workload",
+            "params": dict(workload_params)}
+    options = dict(pod_options or {})
+    if plane_port is not None:
+        options.setdefault("plane_port", plane_port)
+    out = run_pod(workdir, spec, n_workers=n_workers,
+                  batch_size=batch_size, timeout=timeout, **options)
+    cov = coverage_summary(out["results"])
+    out["coverage"] = cov
+    slog.log_event("mcmc.coverage_summary",
+                   n_epochs=out["summary"]["n_epochs"],
+                   coverage={r: {k: (round(v, 4)
+                                     if isinstance(v, float) else v)
+                                 for k, v in d.items()}
+                             for r, d in cov.items()})
+    return out
+
+
+def model_evidence_batched(build_loglike, key, data, x0, lo, hi,
+                           betas=None, nwalkers=32, steps=400,
+                           burn=0.4, seeds=None):
+    """Per-epoch log-evidence by thermodynamic integration with
+    TEMPERED LANES on the sampler's batch axis: the ``B`` epochs are
+    tiled over a β ladder into ``B·T`` lanes of ONE batched program
+    (same cached ``mcmc.sampler`` geometry as plain sampling — β is a
+    traced per-lane input), then ``ln Z = ∫⟨ln L⟩_β dβ`` integrates
+    the post-burn mean log-likelihoods (mcmc/posterior.py:
+    :func:`~scintools_tpu.mcmc.posterior.log_evidence`).
+
+    ``data`` leaves carry the epoch axis ``B``; ``betas`` defaults to
+    a 9-rung cubic ladder (dense near β=0, where the integrand is
+    steepest — the dominant discretisation bias). Requires finite
+    bounds
+    (normalised uniform prior — see docs/posteriors.md "Evidence
+    caveats"). Returns ``(logz[B], mean_ll[B, T], betas[T])``.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    lo = np.asarray(lo, float)
+    hi = np.asarray(hi, float)
+    if not (np.all(np.isfinite(lo)) and np.all(np.isfinite(hi))):
+        raise ValueError(
+            "model evidence needs finite parameter bounds — an "
+            "improper uniform prior has no normalisation")
+    if betas is None:
+        betas = np.linspace(0.0, 1.0, 9) ** 3
+    betas = np.asarray(betas, dtype=float)
+    T = len(betas)
+    x0 = np.asarray(x0)
+    B = x0.shape[0]
+    if seeds is None:
+        seeds = np.arange(B)
+    seeds = np.asarray(seeds)
+    # lane layout: epoch-major (epoch b's T temperatures contiguous)
+    data_t = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(jnp.asarray(a), T, axis=0), data)
+    x0_t = np.repeat(x0, T, axis=0)
+    betas_t = np.tile(betas, B).astype(np.float32)
+    seeds_t = (np.repeat(seeds, T) * 31 + np.tile(
+        np.arange(T), B)).tolist()
+    out = run_ensemble_batched(
+        build_loglike, key, data_t, x0_t, lo.astype(np.float32),
+        hi.astype(np.float32), nwalkers=nwalkers, steps=steps,
+        seeds=seeds_t, betas=jnp.asarray(betas_t))
+    summ = summarize_posterior(out, burn=burn)
+    mean_ll = summ["mean_loglike"].reshape(B, T)
+    return log_evidence(mean_ll, betas), mean_ll, betas

@@ -80,6 +80,8 @@ PROBE_MODULES = (
     "scintools_tpu.ops.xfft",
     "scintools_tpu.fit.acf2d",
     "scintools_tpu.fit.batch",
+    "scintools_tpu.mcmc.sampler",
+    "scintools_tpu.mcmc.posterior",
     "scintools_tpu.thth.core",
     "scintools_tpu.thth.search",
     "scintools_tpu.thth.retrieval",

@@ -322,8 +322,9 @@ class TestBudgetFallback:
         # every config is present and explicitly marked skipped
         # ISSUE 10: +sim_factory +scenario_loop (sim_batch kept as the
         # legacy-entry continuity measurement); ISSUE 12: +fft_layer;
-        # ISSUE 13: +fleet_plane; ISSUE 14: +arc_detect
-        assert len(d["configs"]) == 20
+        # ISSUE 13: +fleet_plane; ISSUE 14: +arc_detect;
+        # ISSUE 15: +mcmc_batch
+        assert len(d["configs"]) == 21
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9

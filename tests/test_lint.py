@@ -43,9 +43,9 @@ PKG = os.path.join(REPO, "scintools_tpu")
 
 # every subpackage the self-check requires nonzero scanned files in
 # ("." is the package root: dynspec.py, backend.py, ...)
-EXPECTED_PACKAGES = {"detect", "fit", "fleet", "io", "obs", "ops",
-                     "parallel", "robust", "serve", "sim", "thth",
-                     "utils", "."}
+EXPECTED_PACKAGES = {"detect", "fit", "fleet", "io", "mcmc", "obs",
+                     "ops", "parallel", "robust", "serve", "sim",
+                     "thth", "utils", "."}
 
 # the legacy scan targets of the old four-pass scheme, per script
 LEGACY_SYNC_DIRS = ("ops", "fit", "thth", "parallel", "serve",

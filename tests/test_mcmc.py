@@ -1,0 +1,457 @@
+"""Tests for the fleet-scale posterior engine (scintools_tpu/mcmc):
+sampler mechanics (batched-vs-single-lane bitwise parity, NaN-lane
+quarantine, steady-state retrace discipline), the tempered-lane
+evidence, the fit/ensemble.py delegation contract, and the
+truth-coverage CALIBRATION GATE — posteriors over scenario-factory
+epochs must cover the closed-form η/τ_d/Δν_d truths at stated
+credibility (ISSUE 15 acceptance)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from scintools_tpu.mcmc.sampler import (ensemble_program,  # noqa: E402
+                                        run_ensemble_batched)
+from scintools_tpu.mcmc.posterior import (log_evidence,  # noqa: E402
+                                          summarize_posterior)
+from scintools_tpu.mcmc.survey import (coverage_summary,  # noqa: E402
+                                       mcmc_scenario_workload,
+                                       model_evidence_batched,
+                                       run_mcmc_survey)
+from scintools_tpu.obs import retrace  # noqa: E402
+from scintools_tpu.robust import guards  # noqa: E402
+
+#: two-regime sweep used across the calibration tests (weak =
+#: Fresnel-limited, strong = diffractive; sim/scenario.py constants)
+REGIMES_2 = (
+    {"name": "weak", "mb2": 0.5, "ar": 1.0, "psi": 0.0,
+     "alpha": 5 / 3},
+    {"name": "strong", "mb2": 16.0, "ar": 1.0, "psi": 0.0,
+     "alpha": 5 / 3},
+)
+
+
+def _gauss_build():
+    import jax.numpy as jnp
+
+    def loglike(x, data):
+        mu, sig = data
+        return -0.5 * jnp.sum(((x - mu) / sig) ** 2)
+
+    return loglike
+
+
+def _gauss_batch(B=3, nd=2, nwalkers=16, steps=500, seeds=(5, 6, 7),
+                 mus=None):
+    import jax.numpy as jnp
+
+    if mus is None:
+        mus = np.linspace(-2, 2, B * nd).reshape(B, nd)
+    mus = np.asarray(mus, np.float32)
+    sigs = np.full((B, nd), 0.5, np.float32)
+    return run_ensemble_batched(
+        _gauss_build, ("test.gauss", nd), (jnp.asarray(mus),
+                                           jnp.asarray(sigs)),
+        x0=np.nan_to_num(mus), lo=np.full(nd, -np.inf),
+        hi=np.full(nd, np.inf), nwalkers=nwalkers, steps=steps,
+        seeds=list(seeds)), mus, sigs
+
+
+class TestBatchedEngine:
+    def test_single_lane_parity_bitwise(self):
+        """A batched lane's chain is BITWISE the B=1 run with the
+        same epoch seed — per-lane arithmetic is independent of the
+        surrounding batch (the property resume byte-identity and the
+        fleet journal merge stand on)."""
+        import jax.numpy as jnp
+
+        out, mus, sigs = _gauss_batch(B=3, steps=400)
+        out1 = run_ensemble_batched(
+            _gauss_build, ("test.gauss", 2),
+            (jnp.asarray(mus[1:2]), jnp.asarray(sigs[1:2])),
+            x0=mus[1:2], lo=np.full(2, -np.inf),
+            hi=np.full(2, np.inf), nwalkers=16, steps=400, seeds=[6])
+        assert np.array_equal(np.asarray(out["chain"])[1],
+                              np.asarray(out1["chain"])[0])
+        assert np.array_equal(np.asarray(out["logp"])[1],
+                              np.asarray(out1["logp"])[0])
+
+    def test_posterior_matches_analytic_gaussian(self):
+        out, mus, sigs = _gauss_batch(B=2, steps=1200,
+                                      seeds=(11, 12))
+        s = summarize_posterior(out, burn=0.4, truths=mus)
+        assert np.allclose(s["q50"], mus, atol=0.2)
+        assert np.allclose(s["std"], sigs, rtol=0.35)
+        assert np.all(s["rhat"] < 1.25)
+        assert np.all(s["ess"] > 30)
+        # truth = posterior centre → ranks central
+        assert np.all((s["rank"] > 0.2) & (s["rank"] < 0.8))
+        assert np.all(np.asarray(out["ok"]) == 0)
+
+    def test_nan_epoch_bitwise_quarantine(self):
+        """A NaN-likelihood lane is condemned by the guards bitmask
+        while every neighbour's chain stays BITWISE identical to the
+        all-healthy run."""
+        import jax.numpy as jnp
+
+        out, mus, sigs = _gauss_batch(B=3, steps=300)
+        mus_bad = mus.copy()
+        mus_bad[0, 0] = np.nan
+        out_bad = run_ensemble_batched(
+            _gauss_build, ("test.gauss", 2),
+            (jnp.asarray(mus_bad), jnp.asarray(sigs)),
+            x0=np.nan_to_num(mus_bad), lo=np.full(2, -np.inf),
+            hi=np.full(2, np.inf), nwalkers=16, steps=300,
+            seeds=[5, 6, 7])
+        ok = np.asarray(out_bad["ok"])
+        assert ok[0] & guards.BAD_INPUT
+        assert ok[0] & guards.BAD_FIT
+        assert ok[1] == 0 and ok[2] == 0
+        assert np.array_equal(np.asarray(out_bad["chain"])[1:],
+                              np.asarray(out["chain"])[1:])
+
+    def test_program_cache_and_geometry_key(self):
+        """Same geometry key → same compiled program object (zero
+        new builds); a different key is a new accounted build."""
+        before = retrace.compile_counts().get("mcmc.sampler", 0)
+        run_a = ensemble_program(_gauss_build, ("test.gauss", 2), 16,
+                                 2)
+        run_b = ensemble_program(_gauss_build, ("test.gauss", 2), 16,
+                                 2)
+        assert run_a is run_b
+        assert retrace.compile_counts()["mcmc.sampler"] == before
+        ensemble_program(_gauss_build, ("test.gauss.other", 2), 16, 2)
+        assert retrace.compile_counts()["mcmc.sampler"] == before + 1
+
+    def test_evidence_tempered_lanes_analytic(self):
+        """Thermodynamic-integration evidence on a 1-D gaussian with
+        a uniform box prior matches the analytic
+        ln Z = ln(√(2π)·σ / (2a)) per lane."""
+        import jax.numpy as jnp
+
+        a = 4.0
+        sig = np.array([0.3, 0.5], np.float32)
+        data = (jnp.zeros((2, 1), jnp.float32),
+                jnp.asarray(sig[:, None]))
+        logz, mean_ll, betas = model_evidence_batched(
+            _gauss_build, ("test.gauss", 1), data,
+            x0=np.zeros((2, 1)), lo=np.array([-a]), hi=np.array([a]),
+            betas=np.linspace(0, 1, 16) ** 3, nwalkers=16, steps=800,
+            burn=0.5, seeds=[3, 4])
+        expect = np.log(np.sqrt(2 * np.pi) * sig / (2 * a))
+        assert mean_ll.shape == (2, 16)
+        # remaining slack is the trapezoid's own ~0.05 discretisation
+        # bias at this ladder (measured analytically) + MC noise
+        assert np.allclose(logz, expect, atol=0.2), (logz, expect)
+        # the better-constrained lane has the lower evidence
+        assert logz[0] < logz[1]
+
+    def test_evidence_requires_finite_bounds(self):
+        import jax.numpy as jnp
+
+        with pytest.raises(ValueError, match="finite"):
+            model_evidence_batched(
+                _gauss_build, ("test.gauss", 1),
+                (jnp.zeros((1, 1)), jnp.ones((1, 1))),
+                x0=np.zeros((1, 1)), lo=np.array([-np.inf]),
+                hi=np.array([np.inf]))
+
+
+class TestEnsembleDelegation:
+    """fit/ensemble.py is the B=1 lane of the engine (ISSUE 15
+    satellite): one implementation, parity-pinned, program-cached."""
+
+    def test_make_ensemble_sampler_is_engine_lane(self):
+        import jax
+        import jax.numpy as jnp
+
+        from scintools_tpu.fit.ensemble import make_ensemble_sampler
+
+        mu = np.array([1.0, -2.0])
+
+        def logp(x):
+            return -0.5 * jnp.sum((x - mu) ** 2)
+
+        run = make_ensemble_sampler(logp, nwalkers=12, ndim=2)
+        key = jax.random.PRNGKey(7)
+        pos0 = jnp.asarray(mu + 0.1 * np.random.default_rng(0)
+                           .standard_normal((12, 2)))
+        chain, logps, acc = run(key, pos0, 200)
+        assert chain.shape == (200, 12, 2)
+        # same logp OBJECT → cached program; same key → same chain
+        run2 = make_ensemble_sampler(logp, nwalkers=12, ndim=2)
+        chain2, _, _ = run2(key, pos0, 200)
+        assert np.array_equal(np.asarray(chain), np.asarray(chain2))
+
+    def test_sample_emcee_jax_reuses_program_across_epochs(self):
+        """Two same-geometry epochs (different DATA) share one
+        compiled sampler program — the retired per-call jit rebuild
+        is gone (satellite 'small fix')."""
+        from scintools_tpu.fit.ensemble import sample_emcee_jax
+        from scintools_tpu.fit.models import tau_acf_model
+        from scintools_tpu.fit.parameters import Parameters
+
+        rng = np.random.default_rng(2)
+        t = np.linspace(0, 300.0, 80)
+
+        def epoch(seed):
+            r = np.random.default_rng(seed)
+            y = (np.exp(-(t / 60.0) ** (5 / 3)) * (1 - t / t.max())
+                 + 0.02 * r.normal(size=len(t)))
+            return (t, y, np.full_like(t, 50.0))
+
+        params = Parameters()
+        params.add("tau", value=40.0, vary=True, min=5.0, max=200.0)
+        params.add("amp", value=0.8, vary=True, min=0.1, max=2.0)
+        params.add("alpha", value=5 / 3, vary=False)
+        res1 = sample_emcee_jax(tau_acf_model, params, epoch(1),
+                                nwalkers=16, steps=200, seed=3)
+        with retrace.retrace_guard(sites=["mcmc.sampler"]):
+            res2 = sample_emcee_jax(tau_acf_model, params, epoch(2),
+                                    nwalkers=16, steps=200, seed=4)
+        assert res1.params["tau"].value != res2.params["tau"].value
+        del rng
+
+
+class TestScenarioPosteriorSurvey:
+    """The survey workload: steady-state retrace discipline and the
+    ladder/journal/resume stack over a SMALL geometry (mechanics;
+    the calibration gate runs at full geometry below)."""
+
+    WL = dict(regimes=REGIMES_2, epochs_per_regime=8, ns=32, nf=16,
+              nwalkers=8, steps=40, numsteps=400)
+
+    def test_zero_steady_rebuilds_across_regime_sweep(self):
+        """Regime parameters ride traced lanes: after one warm batch,
+        a batch from a DIFFERENT regime compiles nothing anywhere."""
+        wl = mcmc_scenario_workload(**self.WL)
+        by_regime = {}
+        for eid, p in wl["epochs"]:
+            by_regime.setdefault(p["regime"], []).append(p)
+        rows = wl["process_batch"](by_regime["weak"])      # warm
+        assert len(rows) == 8
+        with retrace.retrace_guard():
+            rows = wl["process_batch"](by_regime["strong"])
+        assert len(rows) == 8
+        assert all(r["regime"] == "strong" for r in rows)
+
+    def test_survey_runs_resumes_and_reports(self, tmp_path):
+        out = run_mcmc_survey(tmp_path, batch_size=8, **self.WL)
+        s = out["summary"]
+        assert s["n_epochs"] == 16
+        assert s["n_ok"] + s["n_quarantined"] == 16
+        # posterior summaries ride in the journal rows
+        row = next(iter(out["results"].values()))
+        for k in ("tau_q50", "tau_rank", "dnu_ess", "eta_rhat",
+                  "tau_cov95", "eta_true", "acc_frac"):
+            assert k in row, row.keys()
+        # RunReport carries the coverage block
+        with open(os.path.join(tmp_path, "run_report.json")) as fh:
+            rep = json.load(fh)
+        assert "mcmc_coverage" in rep
+        assert set(rep["mcmc_coverage"]) == {"weak", "strong"}
+        journal1 = (tmp_path / "journal.jsonl").read_bytes()
+        # resume: everything served verbatim from the journal
+        out2 = run_mcmc_survey(tmp_path, batch_size=8, report=False,
+                               **self.WL)
+        assert out2["summary"]["n_resumed"] == 16
+        assert out2["results"] == out["results"]
+        assert (tmp_path / "journal.jsonl").read_bytes() == journal1
+
+
+_KILL_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from scintools_tpu.mcmc.survey import mcmc_scenario_workload
+from scintools_tpu.robust import run_survey_batched
+
+workdir, kill_after = sys.argv[1], int(sys.argv[2])
+wl = mcmc_scenario_workload(
+    regimes=({{"name": "weak", "mb2": 0.5, "ar": 1.0, "psi": 0.0,
+              "alpha": 5 / 3}},),
+    epochs_per_regime=8, ns=32, nf=16, nwalkers=8, steps=40,
+    numsteps=400)
+count = {{"n": 0}}
+
+
+def pb(payloads, tier=None):
+    if kill_after >= 0 and count["n"] == kill_after:
+        os.kill(os.getpid(), 9)          # real SIGKILL mid-survey
+    count["n"] += 1
+    return wl["process_batch"](payloads, tier=tier)
+
+
+out = run_survey_batched(wl["epochs"], pb, workdir,
+                         process=wl["process"], batch_size=4,
+                         report=False)
+with open(os.path.join(workdir, "final.json"), "w") as fh:
+    json.dump({{k: out["results"][k] for k in sorted(out["results"])}},
+              fh, sort_keys=True)
+print("RESUMED", out["summary"]["n_resumed"])
+"""
+
+
+class TestKillAndResume:
+    """ISSUE 15 satellite: SIGKILL mid-survey → resume with a
+    BYTE-IDENTICAL journal and identical results (posterior rows are
+    deterministic per epoch seed, independent of batch grouping and
+    resume boundaries)."""
+
+    def _run(self, script, workdir, kill_after):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, script, str(workdir), str(kill_after)],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+
+    def test_sigkill_resume_byte_identical(self, tmp_path):
+        script = tmp_path / "driver.py"
+        script.write_text(_KILL_DRIVER.format(repo=REPO))
+        interrupted = tmp_path / "interrupted"
+        uninterrupted = tmp_path / "uninterrupted"
+
+        r = self._run(script, interrupted, kill_after=1)
+        assert r.returncode == -signal.SIGKILL
+        journal = interrupted / "journal.jsonl"
+        n_done = len(journal.read_bytes().splitlines())
+        assert 0 < n_done < 8            # died mid-run, journal intact
+
+        r = self._run(script, interrupted, kill_after=-1)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert f"RESUMED {n_done}" in r.stdout
+
+        r = self._run(script, uninterrupted, kill_after=-1)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert journal.read_bytes() == \
+            (uninterrupted / "journal.jsonl").read_bytes()
+        assert (interrupted / "final.json").read_text() == \
+            (uninterrupted / "final.json").read_text()
+
+
+class TestDynspecMcmcMethod:
+    def test_get_scint_params_method_mcmc(self):
+        """Dynspec.get_scint_params(method='mcmc') samples the acf1d
+        likelihood through the engine and stores the posterior
+        summary."""
+        from scintools_tpu.dynspec import BasicDyn, Dynspec
+        from scintools_tpu.sim.factory import simulate_scenarios
+
+        dyn = np.asarray(simulate_scenarios(
+            1, mb2=16.0, ns=64, nf=32, dlam=0.05, rf=1.0, ds=0.02,
+            seed=11))[0].T                                  # (nf, nt)
+        times = 30.0 * np.arange(dyn.shape[1])
+        freqs = np.linspace(1400, 1400 * 1.05, dyn.shape[0])
+        d = Dynspec(dyn=BasicDyn(dyn, name="mcmc_t", times=times,
+                                 freqs=freqs, mjd=60000),
+                    verbose=False, process=False, backend="jax")
+        res = d.get_scint_params(method="mcmc", nwalkers=16,
+                                 steps=150, burn=0.3, progress=False)
+        assert d.scint_param_method == "mcmc"
+        assert hasattr(res, "flatchain")
+        assert hasattr(d, "mcmc_summary")
+        for name in ("tau", "dnu", "amp"):
+            rec = d.mcmc_summary[name]
+            assert rec["q16"] <= rec["q50"] <= rec["q84"]
+        assert np.isfinite(d.tau) and np.isfinite(d.dnu)
+        assert d.tau > 0 and d.dnu > 0
+
+    def test_method_mcmc_rejected_values(self):
+        from scintools_tpu.dynspec import BasicDyn, Dynspec
+
+        rng = np.random.default_rng(0)
+        d = Dynspec(dyn=BasicDyn(rng.random((8, 8)) + 1,
+                                 times=10.0 * np.arange(8),
+                                 freqs=np.linspace(1000, 1010, 8)),
+                    verbose=False, process=False)
+        with pytest.raises(ValueError, match="method must be one of"):
+            d.get_scint_params(method="mcmcmc")
+
+
+def _coverage_gates(cov, params=("tau", "dnu", "eta")):
+    """The calibration gate: 95% credible intervals (finite-scintle
+    broadened for τ/Δν — the reference's own epoch-level error
+    model, docs/posteriors.md) must cover the closed-form truths at
+    ≥60% per regime and parameter, truth ranks must stay central
+    (mean in [0.15, 0.85]) and not pile on an edge (KS ≤ 0.6), and
+    ≥90% of lanes must be healthy. Tolerances are deliberately wide
+    of the measured state (cov95 ≥ 0.72, rank_mean 0.24–0.60,
+    KS ≤ 0.44 on 2026-08 CPU) — drift past them means posterior
+    widths or truth calibration genuinely broke."""
+    for regime, d in cov.items():
+        assert d["n_ok"] >= 0.9 * d["n"], (regime, d)
+        for p in params:
+            assert d[f"{p}_cov95"] >= 0.6, (regime, p, d)
+            assert 0.15 <= d[f"{p}_rank_mean"] <= 0.85, (regime, p, d)
+            assert d[f"{p}_rank_ks"] <= 0.6, (regime, p, d)
+
+
+class TestTruthCoverageCalibration:
+    """ISSUE 15 acceptance: over ≥96 scenario-factory epochs across
+    ≥2 regimes, the survey posteriors cover the closed-form truths at
+    stated credibility — a coverage failure is a test failure, not a
+    warning."""
+
+    def test_coverage_96_epochs_two_regimes(self):
+        wl = mcmc_scenario_workload(
+            regimes=REGIMES_2, epochs_per_regime=48, ns=128, nf=64,
+            nwalkers=24, steps=400, numsteps=1000)
+        epochs = wl["epochs"]
+        assert len(epochs) == 96
+        rows = []
+        for i in range(0, len(epochs), 48):
+            rows += wl["process_batch"](
+                [p for _, p in epochs[i:i + 48]])
+        res = {eid: r for (eid, _), r in zip(epochs, rows)}
+        cov = coverage_summary(res)
+        assert set(cov) == {"weak", "strong"}
+        _coverage_gates(cov)
+
+    @pytest.mark.slow
+    def test_coverage_large_epoch_variant(self):
+        """The large-epoch variant (3 regimes incl. anisotropic,
+        288 epochs) — same gates, tighter statistics."""
+        regimes = REGIMES_2 + (
+            {"name": "aniso", "mb2": 16.0, "ar": 2.0, "psi": 30.0,
+             "alpha": 5 / 3},)
+        wl = mcmc_scenario_workload(
+            regimes=regimes, epochs_per_regime=96, ns=128, nf=64,
+            nwalkers=24, steps=400, numsteps=1000)
+        epochs = wl["epochs"]
+        rows = []
+        for i in range(0, len(epochs), 48):
+            rows += wl["process_batch"](
+                [p for _, p in epochs[i:i + 48]])
+        res = {eid: r for (eid, _), r in zip(epochs, rows)}
+        cov = coverage_summary(res)
+        assert set(cov) == {"weak", "strong", "aniso"}
+        _coverage_gates({r: cov[r] for r in ("weak", "strong")})
+        # the anisotropic regime's τ/Δν truth constants carry the
+        # largest calibration slack (the ar^-1/2 / ar^1/4 crossover
+        # factors are single-point calibrations at ψ=30°,
+        # sim/scenario.py) — gate it looser but still meaningfully
+        # (measured 2026-08: tau_cov95 0.89, dnu_cov95 0.59), and
+        # require centred, non-edge-piled ranks for ALL params
+        d = cov["aniso"]
+        assert d["n_ok"] >= 0.9 * d["n"], d
+        for p in ("tau", "dnu"):
+            assert d[f"{p}_cov95"] >= 0.45, (p, d)
+        for p in ("tau", "dnu", "eta"):
+            assert 0.05 <= d[f"{p}_rank_mean"] <= 0.95, (p, d)
+            assert d[f"{p}_rank_ks"] <= 0.7, (p, d)
+
+
+class TestLogEvidenceHelper:
+    def test_trapezoid_orders_betas(self):
+        ll = np.array([[0.0, -1.0, -2.0]])
+        betas = np.array([1.0, 0.5, 0.0])       # unsorted
+        # sorted ascending: (-2, -1, 0) over (0, .5, 1) → trapz = -1
+        assert np.allclose(log_evidence(ll, betas), [-1.0])

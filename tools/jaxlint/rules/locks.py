@@ -157,9 +157,9 @@ class LockDisciplineRule(Rule):
              "in threaded modules")
     # the threaded tier only — flagging single-threaded code would be
     # all noise
-    scope = ("detect/", "serve/", "fleet/", "parallel/pipeline.py",
-             "parallel/checkpoint.py", "obs/", "utils/slog.py",
-             "utils/profiling.py")
+    scope = ("detect/", "mcmc/", "serve/", "fleet/",
+             "parallel/pipeline.py", "parallel/checkpoint.py",
+             "obs/", "utils/slog.py", "utils/profiling.py")
 
     def check(self, ctx, config):
         yield from self._check_classes(ctx)

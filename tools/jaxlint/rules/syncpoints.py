@@ -101,7 +101,8 @@ class SyncpointsRule(Rule):
     # the library hot paths the pipelined engine flows through; the
     # scan list grew with ISSUEs 4→7 (see tests/test_lint.py history)
     scope = ("ops/", "fit/", "thth/", "parallel/", "serve/",
-             "fleet/", "robust/", "obs/", "detect/", "dynspec.py")
+             "fleet/", "robust/", "obs/", "detect/", "mcmc/",
+             "dynspec.py")
     # profiling's whole JOB is fencing
     exclude = ("utils/profiling.py",)
 
