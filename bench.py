@@ -2093,6 +2093,141 @@ def bench_survey_service(jax, jnp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_serve_batched(jax, jnp):
+    """Config #22 (ISSUE 16 tentpole): backlog-adaptive batched
+    serving (serve/lanes.py + docs/serving.md "Batched service
+    mode") — arrivals become lanes of ONE device program when the
+    backlog rises, and latency must NOT degrade past the adaptive
+    window when the cadence sweeps 10x past single-epoch saturation.
+
+    Stages:
+
+    1. **warm** — every power-of-two bucket program (B=1..max_batch)
+       of the batched fit (``fit.scint_params_serve``) compiles
+       before serving; total compile_s recorded (the compile/steady
+       split).
+    2. **single-epoch saturation** — a flood through the daemon in
+       single-dispatch mode; wall/epoch is the saturation cadence
+       the sweep is scaled from.
+    3. **low cadence** — the batched daemon at 4x the saturation
+       interval: the controller idles at B=1 (single-epoch dispatch
+       path), p95 is the reference latency.
+    4. **high cadence** — the same daemon shape with arrivals at
+       10x PAST saturation, under ``retrace_guard`` over the batched
+       program site: the controller must widen B so the backlog
+       drains batched, with ZERO steady-state retraces (bucket
+       padding) and p95 within 1.5x of the low-cadence value.
+    """
+    import tempfile
+
+    from scintools_tpu.fit.batch import make_scint_params_serve
+    from scintools_tpu.obs import retrace
+    from scintools_tpu.serve import QueueSource, SurveyService
+
+    nf, nt = 16, 16          # dispatch-dominated on purpose: the
+    n_iter = 8               # config measures the SERVING overhead
+    max_batch = 8            # amortisation, not fit FLOPs
+    n_epochs = 48
+    rng = np.random.default_rng(31)
+    frames = (10.0 + rng.standard_normal(
+        (n_epochs, nf, nt))).astype(np.float32)
+
+    def run_b(payloads):
+        fn = make_scint_params_serve(len(payloads), nf, nt, 1.0, 1.0,
+                                     n_iter=n_iter)
+        out = {k: np.asarray(v)
+               for k, v in fn(np.stack(payloads)).items()}
+        return [{k: (int(v[i]) if k == "ok" else float(v[i]))
+                 for k, v in out.items()}
+                for i in range(len(payloads))]
+
+    def process(payload, tier=None):
+        return run_b([payload])[0]
+
+    def process_batch(payloads, tier=None):
+        return run_b(list(payloads))
+
+    # ---- 1. warm every bucket program (compile/steady split) ---------
+    t0 = time.perf_counter()
+    b = 1
+    while True:
+        run_b([frames[0]] * b)
+        if b >= max_batch:
+            break
+        b = min(b * 2, max_batch)
+    compile_s = time.perf_counter() - t0
+
+    def stage(tag, batched, interarrival_s):
+        src = QueueSource()
+        kw = dict(http=False, heartbeat=False, report=False,
+                  prefetch=16)
+        if batched:
+            kw.update(process_batch=process_batch,
+                      max_batch=max_batch)
+        svc = SurveyService(src, process,
+                            tempfile.mkdtemp(prefix=f"bench_sb_{tag}_"),
+                            **kw)
+        with svc:
+            t_first = time.perf_counter()
+            for i in range(n_epochs):
+                src.put(f"e{i:03d}", frames[i])
+                if interarrival_s:
+                    time.sleep(interarrival_s)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if len(svc.results()) >= n_epochs:
+                    break
+                time.sleep(0.005)
+            wall = time.perf_counter() - t_first
+            pct = svc.latency_percentiles()
+            counts = svc.state_snapshot()["counts"]
+        return {"wall_s": wall, "latency": pct, "counts": counts}
+
+    # ---- 2. single-epoch saturation (flood, no assembler) ------------
+    single = stage("single", batched=False, interarrival_s=0.0)
+    t_sat = single["wall_s"] / n_epochs
+
+    # ---- 3. low cadence: B drains to 1, reference p95 ----------------
+    low = stage("low", batched=True, interarrival_s=4.0 * t_sat)
+
+    # ---- 4. 10x past saturation, zero steady retraces ----------------
+    with retrace.retrace_guard(sites=("fit.scint_params_serve",)):
+        high = stage("high", batched=True,
+                     interarrival_s=t_sat / 10.0)
+
+    from scintools_tpu.obs import metrics as _obs_metrics
+
+    snap = _obs_metrics.snapshot()["counters"]
+    p95_low = low["latency"]["p95_s"]
+    p95_high = high["latency"]["p95_s"]
+    ratio = (p95_high / p95_low) if p95_low else float("inf")
+    return {
+        "epochs": n_epochs, "size": f"{nf}x{nt}",
+        "max_batch": max_batch,
+        "compile_s": round(compile_s, 3),
+        "single_epoch_s": round(t_sat, 5),
+        "single_flood_p95_s": single["latency"]["p95_s"],
+        "cadence_low_ms": round(4.0 * t_sat * 1e3, 3),
+        "cadence_high_ms": round(t_sat / 10.0 * 1e3, 3),
+        "latency_p95_low_s": p95_low,
+        "latency_p95_high_s": p95_high,
+        "p95_ratio": round(ratio, 3),
+        "latency_gate_1p5x_ok": bool(ratio <= 1.5),
+        "steady_retraces": 0,           # retrace_guard raised
+        "batched_epochs_per_sec": round(                 # otherwise
+            n_epochs / high["wall_s"], 1),
+        "single_epochs_per_sec": round(
+            n_epochs / single["wall_s"], 1),
+        "batches_dispatched": snap.get("serve_batches_total", 0),
+        "batch_lanes": snap.get("serve_batch_lanes_total", 0),
+        "padded_lanes": snap.get("serve_batch_padded_lanes_total", 0),
+        "quota_gate": "tests/test_serve_batched.py::"
+                      "TestBatchedDaemon",
+        "quarantine_gate": "tests/test_serve_batched.py::"
+                           "TestBitwiseLaneQuarantine",
+    }
+
+
 def bench_arc_detect(jax, jnp):
     """Config #20 (ISSUE 14): streaming template-bank arc detection
     (scintools_tpu/detect, docs/detection.md) — the overlap-save
@@ -2571,6 +2706,7 @@ _EST_S = {
     "survey":        {"acc": 150, "cpu": 120},
     "survey_pipeline": {"acc": 60, "cpu": 60},
     "survey_service": {"acc": 60, "cpu": 60},
+    "serve_batched":  {"acc": 60, "cpu": 60},
     "survey_arc":    {"acc": 180, "cpu": 90},
     "sim_batch":     {"acc": 60,  "cpu": 90},
     "sim_factory":   {"acc": 60,  "cpu": 60},
@@ -2714,6 +2850,7 @@ def main():
         ("survey", bench_survey),
         ("survey_pipeline", bench_survey_pipeline),
         ("survey_service", bench_survey_service),
+        ("serve_batched", bench_serve_batched),
         ("acf2d_batch", bench_acf2d_batch),
         ("survey_arc", bench_survey_arc),
         ("sim_batch", bench_sim_batch),

@@ -173,6 +173,56 @@ class ArcDetector:
         ).observe(time.perf_counter() - t0)
         return rec
 
+    def examine_group(self, epoch_ids, dyns, _quiet=False):
+        """Scan a same-geometry epoch GROUP ``[B, nf, nt]`` in ONE
+        bank program (the batched-service shape, ISSUE 16): epochs
+        that arrived as lanes of one device fit are confirmed as
+        lanes of one bank correlate — the spike-grouped confirmation
+        only escalates per-epoch (θ-θ) for actual hits. Returns
+        ``{epoch_id: detection record}`` with :meth:`examine`'s
+        record schema (``n_blocks`` is 1: group epochs are already
+        bank-framed)."""
+        t0 = time.perf_counter()
+        dyns = np.asarray(dyns)
+        lanes = self.scan_batch(dyns)
+        out = {}
+        for epoch_id, lane, dyn in zip(epoch_ids, lanes, dyns):
+            rec = dict(lane, n_blocks=1, triggered=bool(lane["hit"]),
+                       confirmed=False, eta=None, eta_sig=None)
+            del rec["hit"]
+            _metrics.counter(
+                "detect_epochs_scanned_total",
+                help="epochs scanned against the template bank").inc()
+            if rec["ok"] != 0:
+                from ..robust.guards import describe_health
+
+                rec["health"] = describe_health(rec["ok"])
+                _metrics.counter(
+                    "detect_epochs_unhealthy_total",
+                    help="epochs whose detection lanes failed the "
+                         "health guards (quarantined, never "
+                         "triggered)").inc()
+            if rec["triggered"]:
+                _metrics.counter(
+                    "detect_triggers_total",
+                    help="bank hits above the significance "
+                         "threshold").inc()
+                if not _quiet:
+                    slog.log_event("detect.trigger",
+                                   epoch=str(epoch_id),
+                                   eta_bank=rec["eta_bank"],
+                                   z=round(rec["z"], 2),
+                                   score=round(rec["score"], 2),
+                                   n_blocks=1)
+                if self.confirm:
+                    self._confirm(epoch_id, dyn, rec, _quiet)
+            out[str(epoch_id)] = rec
+        _metrics.histogram(
+            "detect_scan_seconds",
+            help="per-epoch bank scan + confirmation wall time",
+        ).observe(time.perf_counter() - t0)
+        return out
+
     def _confirm(self, epoch_id, frame, rec, _quiet):
         """θ-θ confirmation of a hit, on the best block's frame."""
         frame = np.asarray(frame)
@@ -249,6 +299,71 @@ class ArcDetector:
                          "daemon unaffected)").inc()
                 return
             service.annotate(epoch_id, detect=rec)
+
+        hook.hook_stage = "detect"
+        return hook
+
+    def make_group_hook(self, extract=None):
+        """Build the ``on_published_group`` hook for the batched
+        service mode
+        (:meth:`~scintools_tpu.serve.daemon.SurveyService.add_on_published_group`):
+        the group's ok lanes are stacked and scanned in ONE bank
+        correlate (:meth:`examine_group` — detection rides the same
+        lanes the fit did), epochs whose frame doesn't match the bank
+        take the per-epoch overlap-save path, and every scanned epoch
+        gets its ``detect`` annotation exactly as the per-epoch
+        hook's."""
+
+        def hook(service, entries, outcomes):
+            ids, dyns = [], []
+            for key, payload in entries:
+                out = outcomes.get(str(key))
+                if getattr(out, "status", None) != "ok":
+                    continue
+                try:
+                    dyn = extract(payload, out) if extract \
+                        else payload
+                    if dyn is None:
+                        continue
+                    dyn = np.asarray(dyn)
+                except Exception as e:  # noqa: BLE001 — see make_hook
+                    slog.log_failure("detect.error", stage="hook",
+                                     error=e, epoch=str(key))
+                    _metrics.counter(
+                        "detect_errors_total",
+                        help="detection hook failures (epoch "
+                             "skipped, daemon unaffected)").inc()
+                    continue
+                if dyn.ndim != 2:
+                    continue
+                if dyn.shape == (self.nf, self.nt):
+                    ids.append(str(key))
+                    dyns.append(dyn)
+                else:
+                    try:
+                        service.annotate(key, detect=self.examine(
+                            key, dyn))
+                    except Exception as e:  # noqa: BLE001
+                        slog.log_failure("detect.error", stage="hook",
+                                         error=e, epoch=str(key))
+                        _metrics.counter(
+                            "detect_errors_total",
+                            help="detection hook failures (epoch "
+                                 "skipped, daemon unaffected)").inc()
+            if not ids:
+                return
+            try:
+                recs = self.examine_group(ids, np.stack(dyns))
+            except Exception as e:  # noqa: BLE001 — see make_hook
+                slog.log_failure("detect.error", stage="hook",
+                                 error=e, epoch=ids[0])
+                _metrics.counter(
+                    "detect_errors_total",
+                    help="detection hook failures (epoch skipped, "
+                         "daemon unaffected)").inc()
+                return
+            for key, rec in recs.items():
+                service.annotate(key, detect=rec)
 
         hook.hook_stage = "detect"
         return hook

@@ -2329,6 +2329,45 @@ def _psrflux_survey_fns(crop, alpha, n_iter):
     return load_fn, process
 
 
+def _survey_batch_fns(alpha, n_iter):
+    """The batched-service pair (ISSUE 16): ``process_batch(payloads,
+    tier=...)`` fits a whole assembled lane group through ONE guarded
+    device program (fit/batch.py:make_scint_params_serve — per-lane
+    ``ok`` health bitmask, NaN-quarantined bad lanes, bitwise-
+    untouched neighbours), and ``geometry_fn(payload)`` keys the
+    daemon's lane assembler so only same-geometry epochs share a
+    batch. Payloads are the psrflux/FITS survey loaders' ``(dyn, dt,
+    df)`` tuples; the numpy tier (whole-batch fallback never reaches
+    it — per-lane descent does) is served by the per-epoch path."""
+    from .fit.batch import make_scint_params_serve
+    from .robust.ladder import TIER_NUMPY
+
+    def process_batch(payloads, tier=None):
+        if tier == TIER_NUMPY:
+            raise ValueError(
+                "batched serve program is device-only; the numpy "
+                "tier descends per-epoch")
+        dyns = np.stack([np.asarray(p[0], dtype=np.float32)
+                         for p in payloads])
+        dt, df = float(payloads[0][1]), float(payloads[0][2])
+        B, nf, nt = dyns.shape
+        program = make_scint_params_serve(B, nf, nt, dt, df,
+                                          alpha=alpha, n_iter=n_iter)
+        value = program(dyns)
+        # lane-group consumption boundary: the daemon publishes these
+        # results synchronously
+        out = {k: np.asarray(v) for k, v in value.items()}
+        return [{k: (int(v[i]) if k == "ok" else float(v[i]))
+                 for k, v in out.items()} for i in range(B)]
+
+    def geometry_fn(payload):
+        dyn, dt, df = payload
+        return (tuple(np.shape(dyn)), round(float(dt), 9),
+                round(float(df), 9))
+
+    return process_batch, geometry_fn
+
+
 def _psrflux_loader(path, load_fn):
     """Lazy per-file loader (the batch runner's callable-payload
     shape)."""
@@ -2341,7 +2380,7 @@ def _psrflux_loader(path, load_fn):
 def serve_psrflux_survey(spool_dir, workdir, crop=None, alpha=5 / 3,
                          n_iter=100, pattern="*.dynspec",
                          poll_s=0.2, host="127.0.0.1", port=0,
-                         start=True, **service_kw):
+                         start=True, max_batch=None, **service_kw):
     """Survey-as-a-service entry (docs/serving.md): watch
     ``spool_dir`` for arriving psrflux epochs and stream them through
     the pipelined fit engine for as long as the process lives.
@@ -2365,15 +2404,86 @@ def serve_psrflux_survey(spool_dir, workdir, crop=None, alpha=5 / 3,
     SIGKILL it — the next start resumes. Remaining ``service_kw``
     pass to :class:`~scintools_tpu.serve.SurveyService` (``heartbeat``
     cadence, ``prefetch``/``inflight``/``loader_workers``,
-    ``validate``, ``warmup``)."""
+    ``validate``, ``warmup``, ``tenant_policy``).
+
+    ``max_batch`` (>1) enables the BATCHED service mode (ISSUE 16,
+    docs/serving.md): arrivals assemble into lanes of one guarded
+    device program per geometry, with batch size tracking the
+    backlog up to ``max_batch`` and draining back to single-epoch
+    dispatch at idle. Tenant subdirectories of the spool become
+    tenant namespaces (serve/watch.py attribution)."""
     from .serve import SpoolWatcher, SurveyService
 
     load_fn, process = _psrflux_survey_fns(crop, alpha, n_iter)
+    if max_batch is not None and max_batch > 1:
+        process_batch, geometry_fn = _survey_batch_fns(alpha, n_iter)
+        service_kw.setdefault("process_batch", process_batch)
+        service_kw.setdefault("geometry_fn", geometry_fn)
+        service_kw.setdefault("max_batch", max_batch)
     source = SpoolWatcher(spool_dir, pattern=pattern, poll_s=poll_s)
+    service_kw.setdefault("http", (host, port))
     service = SurveyService(source, process, workdir,
-                            load_fn=load_fn, http=(host, port),
-                            **service_kw)
+                            load_fn=load_fn, **service_kw)
     return service.start() if start else service
+
+
+def serve_fits_survey(spool_dir, workdir, dt, df, crop=None,
+                      alpha=5 / 3, n_iter=100, pattern="*.fits",
+                      poll_s=0.2, host="127.0.0.1", port=0,
+                      start=True, max_batch=None, **service_kw):
+    """FITS-epoch counterpart of :func:`serve_psrflux_survey`
+    (ISSUE 16 satellite): watch ``spool_dir`` for arriving simple
+    FITS images (``io/fitsio.py:read_fits_image`` — primary-HDU 2-D
+    dynspec) and stream them through the same fit engine.
+
+    A simple FITS image carries no axis calibration, so the caller
+    supplies the shared ``dt`` [s] / ``df`` [MHz] spacings. Parsing
+    happens in the prefetch workers with ``survey=True`` semantics: a
+    truncated or malformed file raises the epoch-skipping
+    ``MalformedInputError`` and quarantines with a journal record
+    while the stream flows on. Everything else — settle/claim
+    watcher, content dedupe, resume, telemetry, the batched service
+    mode via ``max_batch``, tenant namespaces — is shared with the
+    psrflux entry (same survey-fns plumbing)."""
+    from .serve import SpoolWatcher, SurveyService
+
+    load_fn, process = _fits_survey_fns(dt, df, crop, alpha, n_iter)
+    if max_batch is not None and max_batch > 1:
+        process_batch, geometry_fn = _survey_batch_fns(alpha, n_iter)
+        service_kw.setdefault("process_batch", process_batch)
+        service_kw.setdefault("geometry_fn", geometry_fn)
+        service_kw.setdefault("max_batch", max_batch)
+    source = SpoolWatcher(spool_dir, pattern=pattern, poll_s=poll_s)
+    service_kw.setdefault("http", (host, port))
+    service = SurveyService(source, process, workdir,
+                            load_fn=load_fn, **service_kw)
+    return service.start() if start else service
+
+
+def _fits_survey_fns(dt, df, crop, alpha, n_iter):
+    """The (load_fn, process) pair of the FITS serving entry:
+    ``load_fn`` parses one primary-HDU image into the shared
+    ``(dyn, dt, df)`` payload shape; ``process`` is the psrflux
+    entries' batched-ACF acf1d fit verbatim (same plumbing, same
+    tiers, same quarantine semantics)."""
+    from .io.fitsio import read_fits_image
+
+    _, process = _psrflux_survey_fns(crop, alpha, n_iter)
+
+    def load_fn(path):
+        from .io import MalformedInputError
+
+        dyn = np.asarray(read_fits_image(path, survey=True),
+                         dtype=np.float32)
+        if dyn.ndim != 2:
+            raise MalformedInputError(
+                path, f"expected a 2-D dynspec image, got shape "
+                      f"{dyn.shape}")
+        if crop is not None:
+            dyn = dyn[:crop[0], :crop[1]]
+        return dyn, float(dt), float(df)
+
+    return load_fn, process
 
 
 def _wavefield_grid(dyn, cwf, cwt):

@@ -245,6 +245,66 @@ def scint_params_batch(dyns, dt, df, alpha=5 / 3, n_iter=100,
     return {k: np.asarray(v) for k, v in out.items()}
 
 
+# guarded-program cache for the serving tier: one jitted program per
+# (B, geometry, fit config). The daemon's lane assembler pads groups
+# up to power-of-two bucket sizes (serve/lanes.py), so steady-state
+# service touches a handful of cache keys and then never retraces.
+_SERVE_CACHE = {}
+
+
+def make_scint_params_serve(B, nf, nt, dt, df, alpha=5 / 3,
+                            n_iter=100, bartlett=True, weighted=True):
+    """Build the GUARDED batched serve program: ``program(dyns[B, nf,
+    nt]) → dict`` of per-lane device arrays (``tau, dnu, amp, *err,
+    chisqr, redchi``) plus the int32 ``ok`` health bitmask
+    (robust/guards.py codes).
+
+    This is :func:`scint_params_batch` hardened for multi-tenant
+    streaming service: a lane with non-finite input pixels gets
+    ``BAD_INPUT`` set, computes on sanitized zeros (so the shared
+    batched FFT/LM stays finite), and has its fitted results forced
+    to NaN — while every healthy neighbour lane is BITWISE identical
+    to what it would produce next to any other lane content (vmap
+    lanes are independent; pinned by tests/test_serve_batched.py).
+    The whole pipeline — ACF, cuts, vmapped LM, guards — is ONE
+    jitted program, cached per static key with a
+    ``fit.scint_params_serve`` retrace-accounting site.
+    """
+    jax = get_jax()
+    import jax.numpy as jnp
+
+    key = (int(B), int(nf), int(nt), float(dt), float(df),
+           float(alpha), int(n_iter), bool(bartlett), bool(weighted))
+    program = _SERVE_CACHE.get(key)
+    if program is not None:
+        return program
+    from ..obs import retrace as _retrace
+    from ..robust import guards as _guards
+
+    _retrace.record_build("fit.scint_params_serve", key)
+    fit_one = make_acf1d_fit_one(nt, nf, dt, df, alpha=alpha,
+                                 n_iter=n_iter, bartlett=bartlett,
+                                 weighted=weighted)
+
+    def body(dyns):
+        dyns = jnp.asarray(dyns, dtype=jnp.float32)
+        finite = jnp.all(jnp.isfinite(dyns), axis=(1, 2))
+        ok = jnp.where(finite, _guards.OK,
+                       _guards.BAD_INPUT).astype(jnp.int32)
+        # condemned lanes compute on zeros (guards.sanitize_chunks
+        # idiom): keeps the batched ACF/LM finite without branching
+        clean = jnp.where(finite[:, None, None], dyns, 0.0)
+        tcuts, fcuts = acf_cuts_batch(clean, backend="jax")
+        out = jax.vmap(fit_one)(tcuts, fcuts)
+        nan = jnp.float32(jnp.nan)
+        out = {k: jnp.where(finite, v, nan) for k, v in out.items()}
+        out["ok"] = ok
+        return out
+
+    program = _SERVE_CACHE[key] = jax.jit(body)
+    return program
+
+
 # ---------------------------------------------------------------------
 # abstract program probe (obs/programs.py) — audited by the jaxlint
 # JP2xx program pass (tools/jaxlint/program.py)
@@ -262,3 +322,14 @@ def _probe_acf1d_batch():
     fit = make_acf1d_batch(16, 16, 1.0, 1.0, n_iter=8)
     S = jax.ShapeDtypeStruct
     return fit, (S((2, 16), np.float32), S((2, 16), np.float32))
+
+
+@_register_probe("fit.scint_params_serve")
+def _probe_scint_params_serve():
+    """The guarded batched serve program (``make_scint_params_serve``)
+    at a 2-lane 16x16 bucket — the daemon's smallest padded group."""
+    import jax
+
+    program = make_scint_params_serve(2, 16, 16, 1.0, 1.0, n_iter=8)
+    S = jax.ShapeDtypeStruct
+    return program, (S((2, 16, 16), np.float32),)

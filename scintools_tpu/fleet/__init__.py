@@ -33,7 +33,8 @@ The proving workload is the closed-loop scenario survey
 docs/fleet.md.
 """
 
-from .merge import ATTRIBUTION_FIELDS, merge_journals, merge_records
+from .merge import (ATTRIBUTION_FIELDS, iter_merged, merge_journals,
+                    merge_records)
 from .pod import Pod, run_pod
 from .queue import Task, WorkQueue, claim_by_rename
 from .telemetry import (FleetStateTracker, JournalTail,
@@ -42,7 +43,8 @@ from .worker import (FleetWorker, demo_workload, resolve_workload,
                      run_worker)
 
 __all__ = [
-    "ATTRIBUTION_FIELDS", "merge_journals", "merge_records",
+    "ATTRIBUTION_FIELDS", "iter_merged", "merge_journals",
+    "merge_records",
     "Pod", "run_pod",
     "Task", "WorkQueue", "claim_by_rename",
     "FleetStateTracker", "JournalTail", "PodTelemetry",

@@ -30,15 +30,25 @@ turns them into ONE canonical survey journal with a hard contract
   those fields at the END of each record, stripping restores the
   exact field order a single-process run writes — so the merged
   journal of an N-worker (or killed-and-stolen) run is byte-identical
-  to an uninterrupted single-process run's journal.
+  to an uninterrupted single-process run's journal;
+- **bounded memory** (ISSUE 16 satellite, ROADMAP item 1d) —
+  :func:`merge_journals` streams through :func:`iter_merged`: an
+  external sort (``chunk_records`` per in-memory chunk, sorted spill
+  runs on disk) followed by a ``heapq.merge`` k-way pass, so a
+  10^6-line fleet journal merges in O(chunk) memory with the exact
+  same lines, winners, and stats as the in-memory
+  :func:`merge_records` oracle.
 """
 
 from __future__ import annotations
 
+import heapq
+import json
 import os
+import tempfile
 
 from ..obs import metrics as _metrics
-from ..parallel.checkpoint import EpochJournal, atomic_write_bytes
+from ..parallel.checkpoint import EpochJournal
 from ..utils import slog
 
 #: the worker-attribution columns stripped from merged lines — the
@@ -122,17 +132,157 @@ def _total_order(candidates, order):
     return keys
 
 
+# ---------------------------------------------------------------------
+# streaming k-way merge (ISSUE 16 satellite, ROADMAP item 1d): the
+# same contract as merge_records in O(chunk_records) memory — a
+# 10^6-line fleet journal merges without holding its records resident
+# ---------------------------------------------------------------------
+
+def _epoch_rank(order):
+    """Epoch id → canonical-order rank (first occurrence wins, the
+    _total_order dedupe); unlisted ids share the past-the-end rank
+    and fall back to lexicographic epoch-id order."""
+    rank = {}
+    for i, key in enumerate(order or ()):
+        rank.setdefault(str(key), i)
+    return rank
+
+
+def _stream_key(rec, rank_of, pi, li):
+    """The external-sort key: (order rank, epoch id, commit key) —
+    records of one epoch become ADJACENT in the merged stream with
+    the first-committed winner first, and epochs stream out in the
+    exact _total_order sequence."""
+    key = str(rec.get("epoch"))
+    t, worker, _, _ = _commit_key(rec, pi, li)
+    return (rank_of.get(key, len(rank_of)), key, t, worker, pi, li)
+
+
+def _spill_run(buf, tmp_dir):
+    """Sort one in-memory chunk and spill it as a JSON-lines run
+    file (``[key, record]`` per line; json round-trips the inf
+    commit stamps of unstamped records)."""
+    buf.sort(key=lambda e: e[0])
+    fd, path = tempfile.mkstemp(dir=tmp_dir, suffix=".run")
+    with os.fdopen(fd, "w", encoding="utf-8") as fh:
+        for k, rec in buf:
+            fh.write(json.dumps([list(k), rec]) + "\n")
+    return path
+
+
+def _iter_run(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            k, rec = json.loads(line)
+            yield ((int(k[0]), k[1], float(k[2]), k[3], int(k[4]),
+                    int(k[5])), rec)
+
+
+def iter_merged(journal_paths, order=None, strip=ATTRIBUTION_FIELDS,
+                chunk_records=100_000, stats=None, tmp_dir=None):
+    """Stream the canonical merged journal lines (sans newline, in
+    epoch total order) holding at most ``chunk_records`` records in
+    memory: chunks external-sort into spill runs, a ``heapq.merge``
+    k-way pass streams them back with same-epoch records adjacent
+    (winner first), and the duplicate/conflict accounting happens on
+    the fly. Byte-for-byte the same lines, winners, and stats as
+    :func:`merge_records` (pinned by tests/test_fleet.py); pass a
+    dict as ``stats`` to receive the counts."""
+    if stats is None:
+        stats = {}
+    paths = sorted(os.fspath(p) for p in journal_paths)
+    stats.update(epochs=0, records_read=0, duplicates=0, conflicts=0,
+                 sources=len(paths))
+    rank_of = _epoch_rank(order)
+    chunk_records = max(1, int(chunk_records))
+    runs, buf = [], []
+    own_tmp = None
+    try:
+        for pi, path in enumerate(paths):
+            for li, rec in enumerate(EpochJournal(path).iter_records()):
+                stats["records_read"] += 1
+                buf.append((_stream_key(rec, rank_of, pi, li), rec))
+                if len(buf) >= chunk_records:
+                    if own_tmp is None and tmp_dir is None:
+                        own_tmp = tempfile.mkdtemp(
+                            prefix="fleet-merge-")
+                    runs.append(_spill_run(buf, tmp_dir or own_tmp))
+                    buf = []
+        buf.sort(key=lambda e: e[0])
+        merged = heapq.merge(*([_iter_run(p) for p in runs]
+                               + [iter(buf)]),
+                             key=lambda e: e[0])
+        cur, winner = None, None
+        for k, rec in merged:
+            if k[1] != cur:
+                if winner is not None:
+                    stats["epochs"] += 1
+                    yield _format_line(winner, strip)
+                cur, winner = k[1], rec
+                continue
+            # adjacent same-epoch record: the winner streamed first
+            # (commit key is in the sort key) — this one lost
+            stats["duplicates"] += 1
+            if _stripped(winner, strip) != _stripped(rec, strip):
+                stats["conflicts"] += 1
+                slog.log_failure(
+                    "fleet.merge_conflict", epoch=cur, stage="merge",
+                    error=ValueError(
+                        "duplicate records differ after stripping "
+                        "attribution — workload is not "
+                        "deterministic"),
+                    winner=str(winner.get("worker", "")),
+                    loser=str(rec.get("worker", "")))
+        if winner is not None:
+            stats["epochs"] += 1
+            yield _format_line(winner, strip)
+    finally:
+        for p in runs:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        if own_tmp is not None:
+            try:
+                os.rmdir(own_tmp)
+            except OSError:
+                pass
+
+
+def _format_line(rec, strip):
+    rec = _stripped(rec, strip)
+    epoch = rec.pop("epoch")
+    return EpochJournal.format_line(epoch, **rec)
+
+
 def merge_journals(journal_paths, out_path, order=None,
-                   strip=ATTRIBUTION_FIELDS):
+                   strip=ATTRIBUTION_FIELDS, chunk_records=100_000):
     """Merge per-worker journals into the canonical survey journal at
-    ``out_path`` (written atomically: temp + rename, so a reader —
-    or a re-merge after a crash — never sees a torn merge). Returns
-    the merge stats dict; the merged file re-verifies line-for-line
-    through the normal :class:`EpochJournal` reader."""
-    lines, stats = merge_records(journal_paths, order=order,
-                                 strip=strip)
-    data = ("\n".join(lines) + "\n") if lines else ""
-    atomic_write_bytes(os.fspath(out_path), data.encode())
+    ``out_path`` (written atomically: temp + fsync + rename, so a
+    reader — or a re-merge after a crash — never sees a torn merge).
+    The merge STREAMS (:func:`iter_merged`): memory is bounded by
+    ``chunk_records``, not the journal size. Returns the merge stats
+    dict; the merged file re-verifies line-for-line through the
+    normal :class:`EpochJournal` reader."""
+    out_path = os.fspath(out_path)
+    stats = {}
+    out_dir = os.path.dirname(out_path) or "."
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".merge.tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            for line in iter_merged(journal_paths, order=order,
+                                    strip=strip, stats=stats,
+                                    chunk_records=chunk_records):
+                fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, out_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     _metrics.counter(
         "fleet_merge_epochs_total",
         help="epochs written to merged fleet journals").inc(

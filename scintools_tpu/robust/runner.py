@@ -493,6 +493,96 @@ def _consume_deferred(epoch_id, payload, value, report, process,
                         retries=report.retries, result=dict(result))
 
 
+def default_lane_validate(result):
+    """The batched entries' default per-lane screen: a lane is
+    healthy when its device health bitmask (``"ok"`` — the
+    fused-program / batched-LM guards code) is 0 or absent."""
+    return int(result.get("ok", 0) or 0) == 0
+
+
+def run_group(group, process_batch, process, tiers, retries,
+              validate, record, epoch_label, span_key=None,
+              timeline=None):
+    """Dispatch ONE group of ``(epoch_id, loaded_payload)`` pairs as
+    a single batched device program — the per-group engine shared by
+    :func:`run_survey_batched` (full epoch list up front) and the
+    streaming daemon's lane assembler (serve/daemon.py: arrivals
+    grouped into lanes by backlog pressure). Semantics are the batch
+    entry's, verbatim:
+
+    - the batch attempt runs ``process_batch(payloads, tier=tiers[0])``
+      through the ladder's bounded transient retries; a whole-batch
+      failure sends every lane down the per-epoch ladder (``process``;
+      quarantined outright when ``process`` is None);
+    - per-lane screening: a lane whose ``validate(result)`` is false
+      (guards health bitmask, by default) is retried INDIVIDUALLY
+      through the remaining tiers — one poisoned epoch never takes
+      its batch down;
+    - ``record(epoch_id, EpochOutcome)`` is called exactly once per
+      lane, in group order for the healthy path.
+
+    ``epoch_label`` names the group in ladder/slog records (e.g.
+    ``batch[0:32]``); ``span_key`` + ``timeline`` wrap the batch
+    attempt in a ``compute`` stage span."""
+    rest_tiers = tuple(tiers[1:])
+    try:
+        if timeline is not None and span_key is not None:
+            with timeline.span(span_key, "compute"):
+                value, report = _ladder.run_ladder(
+                    [(tiers[0], lambda: process_batch(
+                        [p for _, p in group], tier=tiers[0]))],
+                    epoch=epoch_label, stage="process_batch",
+                    retries=retries)
+        else:
+            value, report = _ladder.run_ladder(
+                [(tiers[0], lambda: process_batch(
+                    [p for _, p in group], tier=tiers[0]))],
+                epoch=epoch_label, stage="process_batch",
+                retries=retries)
+        batch_results = list(value)
+        if len(batch_results) != len(group):
+            raise ValueError(
+                f"process_batch returned {len(batch_results)} "
+                f"results for {len(group)} epochs")
+    except (_ladder.LadderError, ValueError) as exc:
+        slog.log_failure("robust.batch_fallback", epoch=epoch_label,
+                         stage="process_batch", error=exc,
+                         tier=tiers[0], retry=0)
+        # whole-batch failure: every lane takes the per-epoch ladder
+        # (quarantine isolation unchanged)
+        for epoch_id, payload in group:
+            if process is None:
+                record(epoch_id, EpochOutcome(
+                    epoch=epoch_id, status="quarantined",
+                    tier=tiers[0], error=str(exc),
+                    error_class=type(exc).__name__))
+            else:
+                record(epoch_id, _run_one(epoch_id, payload, process,
+                                          tiers, retries, None))
+        return
+    for (epoch_id, payload), result in zip(group, batch_results):
+        if validate(result):
+            record(epoch_id, EpochOutcome(
+                epoch=epoch_id, status="ok", tier=tiers[0],
+                result=dict(result)))
+            continue
+        slog.log_failure(
+            "robust.lane_reject", epoch=epoch_id,
+            stage="process_batch", tier=tiers[0],
+            error=ValueError(
+                f"lane health rejected (ok="
+                f"{result.get('ok', 'validator')!r})"),
+            retry=0)
+        if process is None or not rest_tiers:
+            record(epoch_id, EpochOutcome(
+                epoch=epoch_id, status="quarantined", tier=tiers[0],
+                error="lane health rejected",
+                error_class="LaneRejected"))
+        else:
+            record(epoch_id, _run_one(epoch_id, payload, process,
+                                      rest_tiers, retries, None))
+
+
 def run_survey_batched(epochs, process_batch, workdir, process=None,
                        batch_size=32, tiers=_DEFAULT_TIERS, retries=1,
                        validate=None, journal_name="journal.jsonl",
@@ -542,8 +632,7 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
     done = journal.records() if resume else {}
 
     if validate is None:
-        def validate(result):                 # noqa: ANN001
-            return int(result.get("ok", 0) or 0) == 0
+        validate = default_lane_validate
 
     writer = AsyncJournalWriter(journal, timeline=timeline) \
         if pipeline else None
@@ -606,76 +695,13 @@ def run_survey_batched(epochs, process_batch, workdir, process=None,
             if loader is not None:
                 loader.close()
 
-            rest_tiers = tuple(tiers[1:])
             for i in range(0, len(pending), batch_size):
                 group = pending[i:i + batch_size]
                 rec.tally["n_batches"] += 1
-                try:
-                    if timeline is not None:
-                        with timeline.span(f"batch[{i}]", "compute"):
-                            value, report = _ladder.run_ladder(
-                                [(tiers[0], lambda: process_batch(
-                                    [p for _, p in group],
-                                    tier=tiers[0]))],
-                                epoch=f"batch[{i}:{i + len(group)}]",
-                                stage="process_batch",
-                                retries=retries)
-                    else:
-                        value, report = _ladder.run_ladder(
-                            [(tiers[0], lambda: process_batch(
-                                [p for _, p in group],
-                                tier=tiers[0]))],
-                            epoch=f"batch[{i}:{i + len(group)}]",
-                            stage="process_batch", retries=retries)
-                    batch_results = list(value)
-                    if len(batch_results) != len(group):
-                        raise ValueError(
-                            f"process_batch returned "
-                            f"{len(batch_results)} results for "
-                            f"{len(group)} epochs")
-                except (_ladder.LadderError, ValueError) as exc:
-                    slog.log_failure("robust.batch_fallback",
-                                     epoch=f"batch[{i}]",
-                                     stage="process_batch", error=exc,
-                                     tier=tiers[0], retry=0)
-                    # whole-batch failure: every lane takes the
-                    # per-epoch ladder (quarantine isolation
-                    # unchanged)
-                    for epoch_id, payload in group:
-                        if process is None:
-                            _record(epoch_id, EpochOutcome(
-                                epoch=epoch_id, status="quarantined",
-                                tier=tiers[0], error=str(exc),
-                                error_class=type(exc).__name__))
-                        else:
-                            _record(epoch_id, _run_one(
-                                epoch_id, payload, process, tiers,
-                                retries, None))
-                    continue
-                for (epoch_id, payload), result in zip(group,
-                                                       batch_results):
-                    if validate(result):
-                        _record(epoch_id, EpochOutcome(
-                            epoch=epoch_id, status="ok",
-                            tier=tiers[0], result=dict(result)))
-                        continue
-                    slog.log_failure(
-                        "robust.lane_reject", epoch=epoch_id,
-                        stage="process_batch", tier=tiers[0],
-                        error=ValueError(
-                            f"lane health rejected (ok="
-                            f"{result.get('ok', 'validator')!r})"),
-                        retry=0)
-                    if process is None or not rest_tiers:
-                        _record(epoch_id, EpochOutcome(
-                            epoch=epoch_id, status="quarantined",
-                            tier=tiers[0],
-                            error="lane health rejected",
-                            error_class="LaneRejected"))
-                    else:
-                        _record(epoch_id, _run_one(
-                            epoch_id, payload, process, rest_tiers,
-                            retries, None))
+                run_group(group, process_batch, process, tiers,
+                          retries, validate, _record,
+                          epoch_label=f"batch[{i}:{i + len(group)}]",
+                          span_key=f"batch[{i}]", timeline=timeline)
                 if writer is not None:
                     # batch-boundary durability barrier (PR-2
                     # guarantee: at most the in-flight batch redone)

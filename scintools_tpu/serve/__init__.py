@@ -21,11 +21,21 @@ same engine into a deployable long-lived process:
   / ``/readyz`` probes, the live ``/report`` RunReport snapshot, and
   per-epoch ``/state``.
 
-``dynspec.serve_psrflux_survey`` is the psrflux-file entry point;
-docs/serving.md is the operator walkthrough.
+- :mod:`~scintools_tpu.serve.lanes` — the batched service mode's
+  host half (ISSUE 16): :class:`AdaptiveBatchController` (backlog →
+  batch-size target, track-up / decay-down), :class:`TenantPolicy`
+  (admission control + fair-share lane quotas), and
+  :class:`LaneAssembler` (per-geometry, tenant-round-robin group
+  formation with power-of-two bucket padding).
+
+``dynspec.serve_psrflux_survey`` / ``dynspec.serve_fits_survey`` are
+the file-format entry points; docs/serving.md is the operator
+walkthrough.
 """
 
 from .daemon import SurveyService  # noqa: F401
 from .http import TelemetryServer  # noqa: F401
+from .lanes import (AdaptiveBatchController, LaneAssembler,  # noqa: F401
+                    TenantPolicy)
 from .store import ResultsStore, content_hash  # noqa: F401
 from .watch import ArrivedEpoch, QueueSource, SpoolWatcher  # noqa: F401

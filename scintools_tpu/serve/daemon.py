@@ -56,6 +56,7 @@ from ..robust import runner as _runner
 from ..robust.runner import EpochOutcome
 from ..utils import slog
 from ..utils.profiling import StageTimeline
+from . import lanes as _lanes
 from .store import ResultsStore
 
 _STOP = object()
@@ -114,7 +115,10 @@ class SurveyService:
                  prefetch=4, inflight=2, loader_workers=2,
                  journal_name="results.jsonl", http=("127.0.0.1", 0),
                  heartbeat=True, warmup=None, stale_after_s=5.0,
-                 report=True, on_published=None):
+                 report=True, on_published=None, process_batch=None,
+                 max_batch=16, controller=None, tenant_policy=None,
+                 geometry_fn=None, bucket_lanes=True,
+                 on_published_group=None):
         self.source = source
         self.process = process
         self.workdir = os.fspath(workdir)
@@ -136,6 +140,30 @@ class SurveyService:
         # arc detector (detect/online.py) registers through, instead
         # of forking or monkeypatching _consume_one
         self._hooks = list(on_published or [])
+        self._group_hooks = list(on_published_group or [])
+
+        # batched service mode (ISSUE 16): when ``process_batch``
+        # is given, loaded arrivals STAGE in the lane assembler and
+        # dispatch as ONE batched device program per geometry; the
+        # controller maps the live backlog to the batch-size target
+        # (track-up / decay-down — serve/lanes.py), the optional
+        # tenant policy adds admission control + fair-share quotas,
+        # and groups pad up to power-of-two buckets so steady-state
+        # service never retraces.
+        self.process_batch = process_batch
+        self.max_batch = max(1, int(max_batch))
+        self.geometry_fn = geometry_fn
+        self.bucket_lanes = bool(bucket_lanes)
+        self.tenant_policy = tenant_policy
+        self._assembler = None
+        self._controller = None
+        if process_batch is not None:
+            self._assembler = _lanes.LaneAssembler(policy=tenant_policy)
+            self._controller = controller \
+                or _lanes.AdaptiveBatchController(max_batch=self.max_batch)
+            self.max_batch = self._controller.max_batch
+        self._tenant_pending = {}    # tenant -> admitted-not-published
+        self._staged_t = {}          # key -> staging-entry instant
 
         os.makedirs(self.workdir, exist_ok=True)
         self.store = ResultsStore(self.workdir, name=journal_name)
@@ -263,10 +291,16 @@ class SurveyService:
                     elif not self._stop_sent:
                         self._fresh_q.put(_STOP)
                         self._stop_sent = True
+                    busy = self._window or (
+                        self._assembler is not None
+                        and len(self._assembler))
                     got = self._loader.poll(
-                        timeout=0.02 if self._window else 0.05)
+                        timeout=0.02 if busy else 0.05)
                     if got is not None:
-                        self._dispatch(*got)
+                        self._route(*got)
+                    if self._assembler is not None:
+                        self._maybe_assemble(
+                            idle=(got is None) or stopping)
                     while len(self._window) > self.inflight:
                         self._consume_one()
                     if got is None and self._window:
@@ -276,7 +310,9 @@ class SurveyService:
                     self._update_gauges()
                     if stopping and self._stop_sent \
                             and self._loader.exhausted \
-                            and not self._window:
+                            and not self._window \
+                            and not (self._assembler is not None
+                                     and len(self._assembler)):
                         break
             self._writer.close()       # durability barrier (PR-2)
             self._rec.beat(force=True)
@@ -327,6 +363,7 @@ class SurveyService:
 
     def _admit(self, item):
         key = str(item.epoch)
+        tenant = getattr(item, "tenant", None) or "default"
         with self._lock:
             if key in self._states:
                 return                       # already seen this run
@@ -359,16 +396,40 @@ class SurveyService:
                 self._states[key] = {"status": "duplicate",
                                      "duplicate_of": dup_of}
                 return
+            # tenant admission control (ISSUE 16): an over-quota
+            # tenant's arrival is refused BEFORE it costs a load or a
+            # lane — neighbours' admission is untouched
+            if self.tenant_policy is not None \
+                    and not self.tenant_policy.admit(
+                        tenant, self._tenant_pending.get(tenant, 0)):
+                _metrics.counter(
+                    "serve_tenant_rejected_total",
+                    help="arrivals refused by per-tenant admission "
+                         "control").labels(tenant=tenant).inc()
+                slog.log_event("serve.tenant_rejected", epoch=key,
+                               tenant=tenant,
+                               pending=self._tenant_pending.get(
+                                   tenant, 0))
+                self._states[key] = {"status": "rejected",
+                                     "tenant": tenant}
+                return
             _metrics.counter(
                 "serve_epochs_ingested_total",
                 help="fresh epochs admitted into the pipeline").inc()
+            _metrics.counter(
+                "serve_tenant_ingested_total",
+                help="fresh epochs admitted, by tenant namespace"
+            ).labels(tenant=tenant).inc()
+            self._tenant_pending[tenant] = \
+                self._tenant_pending.get(tenant, 0) + 1
             self._rec.tally["n_epochs"] += 1
             self._rec.set_sha(key, item.sha)
             if item.sha:
                 self._inflight_sha[item.sha] = key
             self._states[key] = {"status": "queued",
                                  "t_ingest": item.t_arrive,
-                                 "sha": item.sha}
+                                 "sha": item.sha,
+                                 "tenant": tenant}
         self._fresh_q.put((key, item.payload))
 
     def _dispatch(self, eid, loaded):
@@ -391,6 +452,147 @@ class SurveyService:
                 self.retries, self.validate)
         # lint-ok: lock-discipline: loop-thread-only window (above)
         self._window.append(entry)
+
+    # ---- batched service mode (ISSUE 16) -----------------------------
+    def _route(self, eid, loaded):
+        """Loaded-arrival routing: batched mode stages healthy loads
+        in the lane assembler; everything else (no assembler, loader
+        failure, controller drained to B=1 with nothing staged) takes
+        the existing single-epoch dispatch window."""
+        if self._assembler is None or not loaded.ok:
+            self._dispatch(eid, loaded)
+            return
+        if self._controller.current <= 1 \
+                and not len(self._assembler):
+            # drained back to single-epoch dispatch at idle: bounded
+            # low-cadence latency, zero staging detour
+            self._dispatch(eid, loaded)
+            return
+        key = str(eid)
+        with self._lock:
+            st = self._states.get(key, {})
+            st["status"] = "staged"
+            tenant = st.get("tenant", "default")
+        geometry = self.geometry_fn(loaded.payload) \
+            if self.geometry_fn is not None else None
+        # lint-ok: lock-discipline: the assembler and the staging
+        # clock are loop-thread-only (staged by _route, drained by
+        # _maybe_assemble/_dispatch_group — all run in _loop)
+        self._staged_t[key] = time.perf_counter()
+        self._assembler.stage((key, loaded.payload), tenant, geometry)
+
+    def _maybe_assemble(self, idle):
+        """Form and dispatch one batched group when the staging
+        buffer has reached the controller's target B — or whatever is
+        staged, on an idle tick (a lull must flush staged lanes, the
+        single-path idle-drain guarantee carried over)."""
+        staged = len(self._assembler)
+        if not staged:
+            return
+        b = self._controller.current
+        if staged < b and not idle:
+            return
+        took = self._assembler.take(b)
+        if took is None:
+            return
+        geometry, entries = took
+        if len(entries) == 1:
+            # B drained to 1: ride the runner's per-epoch engine
+            # (identical to non-batched dispatch, window semantics
+            # and all)
+            key, payload = entries[0]
+            # lint-ok: lock-discipline: loop-thread-only staging
+            # clock (see _route)
+            t_staged = self._staged_t.pop(key, None)
+            if t_staged is not None:
+                self.timeline.record(key, "assemble", t_staged,
+                                     time.perf_counter())
+            with self._lock:
+                st = self._states.get(key, {})
+                st["status"] = "in_flight"
+            with self.timeline.span(key, "dispatch"):
+                entry = _runner._dispatch_first(
+                    key, payload, self.process, self.tiers,
+                    self.retries, self.validate)
+            # lint-ok: lock-discipline: loop-thread-only window (see
+            # _dispatch)
+            self._window.append(entry)
+            return
+        self._dispatch_group(geometry, entries)
+
+    def _group_process(self, payloads, tier=None):
+        """The assembler-facing ``process_batch`` wrapper: pads the
+        group up to its power-of-two bucket with copies of a real
+        payload (so the adaptive B never retraces the device program
+        in steady state) and slices the padded lanes' results back
+        off."""
+        if not self.bucket_lanes:
+            return self.process_batch(payloads, tier=tier)
+        padded, n = _lanes.pad_group(payloads, self.max_batch)
+        out = self.process_batch(padded, tier=tier)
+        return list(out)[:n]
+
+    def _dispatch_group(self, geometry, entries):
+        """ONE batched device program for ``entries`` — the runner's
+        shared group engine (robust/runner.py:run_group: ladder,
+        batch fallback, per-lane health screening and individual
+        descent), then per-lane publish in group order."""
+        keys = [k for k, _ in entries]
+        payloads = dict(entries)
+        now = time.perf_counter()
+        for key in keys:
+            # lint-ok: lock-discipline: loop-thread-only staging
+            # clock (see _route)
+            t_staged = self._staged_t.pop(key, None)
+            if t_staged is not None:
+                self.timeline.record(key, "assemble", t_staged, now)
+        with self._lock:
+            tenants = {}
+            for key in keys:
+                st = self._states.get(key, {})
+                st["status"] = "in_flight"
+                t = st.get("tenant", "default")
+                tenants[t] = tenants.get(t, 0) + 1
+        bucket = _lanes.bucket_size(len(entries), self.max_batch) \
+            if self.bucket_lanes else len(entries)
+        _metrics.counter(
+            "serve_batches_total",
+            help="assembled lane groups dispatched as one batched "
+                 "device program").inc()
+        _metrics.counter(
+            "serve_batch_lanes_total",
+            help="real (non-padding) lanes dispatched in batched "
+                 "groups").inc(len(entries))
+        _metrics.counter(
+            "serve_batch_padded_lanes_total",
+            help="padding lanes added to reach the power-of-two "
+                 "bucket (results discarded)").inc(
+            bucket - len(entries))
+        slog.log_event(
+            "serve.batch", n_lanes=len(entries), bucket=bucket,
+            b_target=self._controller.current,
+            geometry=repr(geometry) if geometry is not None else None,
+            tenants=tenants)
+        outs = []
+        t0 = time.perf_counter()
+        _runner.run_group(
+            entries, self._group_process, self.process, self.tiers,
+            self.retries, self.validate or _runner.default_lane_validate,
+            lambda eid, out: outs.append((eid, out)),
+            epoch_label=f"group[{keys[0]}+{len(entries)}]")
+        t1 = time.perf_counter()
+        for key in keys:
+            # the batched program is the device stage: dispatch +
+            # compute + fetch for every lane in one span
+            self.timeline.record(key, "dispatch", t0, t1)
+        for eid, out in outs:
+            # per-lane fence span: program return → this lane's
+            # publish (the lane's wait behind its groupmates)
+            self.timeline.record(eid, "fence", t1,
+                                 time.perf_counter())
+            self._publish(out)
+            self._run_hooks(eid, payloads.get(str(eid)), out)
+        self._run_group_hooks(entries, dict(outs))
 
     def _consume_one(self):
         # lint-ok: lock-discipline: loop-thread-only window (see
@@ -419,6 +621,38 @@ class SurveyService:
         thread is the only reader)."""
         self._hooks.append(fn)
         return fn
+
+    def add_on_published_group(self, fn):
+        """Register a post-publish GROUP consumer ``fn(service,
+        entries, outcomes)`` for the batched service mode: after a
+        whole assembled group publishes, the hook receives the
+        group's ``[(key, loaded_payload), ...]`` and its ``{key:
+        EpochOutcome}`` map in one call — the spike-grouped
+        confirmation hook point (detect/online.py:make_group_hook
+        scans all lanes in ONE bank program instead of per-epoch).
+        Same containment contract as :meth:`add_on_published`; spans
+        land on the group's first lane trace. Call before
+        :meth:`start`."""
+        self._group_hooks.append(fn)
+        return fn
+
+    def _run_group_hooks(self, entries, outcomes):
+        if not self._group_hooks or not entries:
+            return
+        first = str(entries[0][0])
+        for fn in self._group_hooks:
+            stage = getattr(fn, "hook_stage", "on_published_group")
+            try:
+                with self.timeline.span(first, stage):
+                    fn(self, entries, outcomes)
+            except Exception as e:  # noqa: BLE001 — contained like
+                # per-epoch hooks: the stream keeps flowing
+                slog.log_failure("serve.hook_error", stage=stage,
+                                 error=e, epoch=first)
+                _metrics.counter(
+                    "serve_hook_errors_total",
+                    help="post-publish hook failures (epoch "
+                         "unaffected, hook skipped)").inc()
 
     def annotate(self, key, **fields):
         """Merge extra fields into an epoch's ``/state`` entry (hook
@@ -467,6 +701,20 @@ class SurveyService:
                     buckets=LATENCY_BUCKETS).observe(lat)
             self.store.note_published(key, st.get("sha"))
             self._inflight_sha.pop(st.get("sha"), None)
+            tenant = st.get("tenant")
+            if tenant is not None:
+                pend = self._tenant_pending.get(tenant, 0)
+                if pend > 0:
+                    self._tenant_pending[tenant] = pend - 1
+                _metrics.counter(
+                    "serve_tenant_published_total",
+                    help="published epochs, by tenant namespace"
+                ).labels(tenant=tenant).inc()
+                if out.status == "quarantined":
+                    _metrics.counter(
+                        "serve_tenant_quarantined_total",
+                        help="quarantined epochs, by tenant "
+                             "namespace").labels(tenant=tenant).inc()
         self.timeline.record(key, "publish", t0, time.perf_counter())
         if out.status == "ok":
             # lint-ok: lock-discipline: monotonic False→True latch,
@@ -474,10 +722,18 @@ class SurveyService:
             self._warm = True
 
     def _update_gauges(self):
+        backlog = self.backlog()
         _metrics.gauge(
             "serve_backlog_depth",
             help="epochs arrived but not yet published",
-        ).set(self.backlog())
+        ).set(backlog)
+        if self._controller is not None:
+            # the feedback loop: the backlog gauge drives the
+            # batch-size target every tick
+            _metrics.gauge(
+                "serve_batch_size",
+                help="current adaptive batch-size target B",
+            ).set(self._controller.observe(backlog))
 
     # ---- live surfaces (HTTP handlers + heartbeat) ------------------
     def backlog(self):
@@ -485,6 +741,8 @@ class SurveyService:
         admitted-but-unloaded + loaded-or-loading + dispatch window."""
         n = self._fresh_q.qsize() + len(self._window) \
             + self._loader.buffered()
+        if self._assembler is not None:
+            n += len(self._assembler)
         if hasattr(self.source, "backlog"):
             n += self.source.backlog()
         return n

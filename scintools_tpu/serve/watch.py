@@ -63,6 +63,10 @@ class ArrivedEpoch:
     payload: object
     sha: str = None
     t_arrive: float = field(default_factory=time.perf_counter)
+    #: multi-tenant namespace the arrival belongs to (ISSUE 16):
+    #: admission control, fair-share lane quotas, and per-tenant
+    #: metrics key off this; None = the daemon's default tenant
+    tenant: str = None
 
 
 class QueueSource:
@@ -77,10 +81,11 @@ class QueueSource:
         self._closed = threading.Event()
         self._last = time.time()
 
-    def put(self, epoch, payload, sha=None):
+    def put(self, epoch, payload, sha=None, tenant=None):
         if sha is None and self._hash:
             sha = content_hash(payload)
-        self._q.put(ArrivedEpoch(str(epoch), payload, sha=sha))
+        self._q.put(ArrivedEpoch(str(epoch), payload, sha=sha,
+                                 tenant=tenant))
 
     def get(self, timeout=None):
         try:
@@ -118,6 +123,16 @@ class SpoolWatcher:
     store to skip what was already published (resume) or already seen
     under another name (content dedupe).
 
+    **Tenant attribution** (ISSUE 16): a first-level subdirectory of
+    the spool is a tenant namespace — ``<spool>/<tenant>/<file>``
+    arrives with ``tenant=<tenant>`` and epoch key
+    ``<tenant>/<file>`` (two tenants may drop the same filename
+    without colliding), while top-level files keep ``tenant=None``
+    (the daemon's default tenant). ``tenant_of(rel_name, path)``
+    overrides the mapping (return None for the default tenant). The
+    daemon's admission control and fair-share lane quotas key off
+    this attribution.
+
     **Claim mode** (``claim=True`` — the shared-spool fleet shape,
     ROADMAP item 2): N daemons watching ONE spool directory must
     never fit the same epoch twice. Before admitting a stable file,
@@ -135,9 +150,10 @@ class SpoolWatcher:
 
     def __init__(self, spool_dir, pattern="*.dynspec", poll_s=0.2,
                  settle_polls=1, start=True, claim=False,
-                 owner=None):
+                 owner=None, tenant_of=None):
         self.spool_dir = os.fspath(spool_dir)
         self.pattern = pattern
+        self.tenant_of = tenant_of
         self.poll_s = max(0.01, float(poll_s))
         self.settle_polls = max(1, int(settle_polls))
         self.claim = bool(claim)
@@ -178,12 +194,32 @@ class SpoolWatcher:
             self._last_poll = time.time()
             self._closed.wait(self.poll_s)
 
+    def _scan_names(self):
+        """Spool-relative names of candidate files: top-level matches
+        plus one level of tenant-namespace subdirectories
+        (``<tenant>/<file>``), sorted."""
+        names = []
+        for n in os.listdir(self.spool_dir):
+            if n.startswith("."):
+                continue
+            if fnmatch.fnmatch(n, self.pattern):
+                names.append(n)
+                continue
+            sub = os.path.join(self.spool_dir, n)
+            if not os.path.isdir(sub):
+                continue
+            try:
+                names.extend(
+                    f"{n}/{m}" for m in os.listdir(sub)
+                    if not m.startswith(".")
+                    and fnmatch.fnmatch(m, self.pattern))
+            except OSError:
+                continue                 # tenant dir vanished mid-poll
+        return sorted(names)
+
     def _poll_once(self):
         try:
-            names = sorted(
-                n for n in os.listdir(self.spool_dir)
-                if not n.startswith(".")
-                and fnmatch.fnmatch(n, self.pattern))
+            names = self._scan_names()
         except FileNotFoundError:
             return                       # spool not created yet
         for name in names:
@@ -234,9 +270,13 @@ class SpoolWatcher:
             return
         self._admitted.add(name)
         self._seen.pop(name, None)
-        self._q.put(ArrivedEpoch(name, path, sha=sha))
+        if self.tenant_of is not None:
+            tenant = self.tenant_of(name, path)
+        else:
+            tenant = name.split("/", 1)[0] if "/" in name else None
+        self._q.put(ArrivedEpoch(name, path, sha=sha, tenant=tenant))
         slog.log_event("serve.ingest", epoch=name, path=path,
-                       sha=sha[:12])
+                       sha=sha[:12], tenant=tenant)
 
     # ---- source interface -------------------------------------------
     def get(self, timeout=None):

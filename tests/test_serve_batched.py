@@ -1,0 +1,401 @@
+"""Batched service mode (ISSUE 16 tentpole): serve/lanes.py + the
+daemon's lane-assembly paths + the batched fit program.
+
+Gates, in order:
+
+- the controller law (track-up, decay-down) as a pure step response —
+  B rises to the cap within one observation of a burst backlog and
+  drains geometrically to 1 at idle;
+- bucket padding: power-of-two group sizes, cap always a valid
+  bucket, padded lanes sliced back off;
+- the lane assembler: per-geometry grouping (mixed shapes never share
+  a batch), tenant round-robin with wheel resumption, fair-share
+  quota caps — a flooding tenant cannot crowd a quiet one out of
+  lanes;
+- tenant admission control: an over-quota tenant's arrivals are
+  rejected (status ``rejected``) BEFORE they cost a load, neighbours
+  admitted untouched;
+- the daemon end-to-end: a burst assembles into batched dispatches
+  (``serve_batches_total``; bucketed program widths), everything
+  publishes, and an idle daemon drains B back to single-epoch
+  dispatch;
+- bad-tenant lane quarantine: a poisoned lane quarantines through the
+  guards pattern while its groupmates' results are BITWISE identical
+  to an all-healthy run of the same program (the vmap lane-
+  independence contract, checked on the real batched fit program);
+- streaming journal merge (satellite, ROADMAP 1d): iter_merged with
+  forced spill runs is byte- and stats-identical to the in-memory
+  merge_records oracle.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scintools_tpu.io import MalformedInputError
+from scintools_tpu.obs import metrics as obs_metrics
+from scintools_tpu.parallel.checkpoint import EpochJournal
+from scintools_tpu.serve import (AdaptiveBatchController, LaneAssembler,
+                                 QueueSource, SurveyService,
+                                 TenantPolicy)
+from scintools_tpu.serve.lanes import bucket_size, pad_group
+from scintools_tpu.utils import slog
+
+from test_serve import _wait
+
+
+class TestController:
+    def test_tracks_up_and_decays_down(self):
+        c = AdaptiveBatchController(max_batch=16)
+        assert c.current == 1
+        assert c.observe(40) == 16        # burst → cap in ONE step
+        assert c.observe(40) == 16
+        # idle → geometric drain to single-epoch dispatch
+        assert [c.observe(0) for _ in range(5)] == [8, 4, 2, 1, 1]
+
+    def test_gain_scales_the_target(self):
+        c = AdaptiveBatchController(max_batch=16, gain=0.5)
+        assert c.observe(8) == 4          # ceil(0.5 * 8)
+        assert c.observe(7) == 4          # ceil(3.5) = 4, holds
+        c2 = AdaptiveBatchController(max_batch=16)
+        assert c2.observe(3) == 3         # partial backlog tracks up
+        assert c2.observe(2) == 2         # decay floor vs target: max
+
+    def test_lull_does_not_collapse_a_burst(self):
+        c = AdaptiveBatchController(max_batch=16, decay=0.5)
+        c.observe(32)
+        assert c.observe(0) == 8          # one lull tick: halved,
+        assert c.observe(30) == 16        # not reset — and recovers
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_batch"):
+            AdaptiveBatchController(max_batch=0)
+        with pytest.raises(ValueError, match="decay"):
+            AdaptiveBatchController(decay=1.0)
+
+
+class TestBuckets:
+    def test_power_of_two_buckets(self):
+        assert [bucket_size(n, 16) for n in (1, 2, 3, 5, 8, 9, 20)] \
+            == [1, 2, 4, 8, 8, 16, 16]
+        # the cap itself is always a valid bucket, power of two or not
+        assert bucket_size(5, 6) == 6
+        assert bucket_size(3, 6) == 4
+
+    def test_pad_group_slices_back(self):
+        padded, n = pad_group(["a", "b", "c"], 16)
+        assert n == 3
+        assert padded == ["a", "b", "c", "a"]
+        padded, n = pad_group(["a"], 16)
+        assert (padded, n) == (["a"], 1)
+
+
+class TestLaneAssembler:
+    def _staged(self, pairs):
+        a = LaneAssembler()
+        for tenant, entry in pairs:
+            a.stage(entry, tenant, None)
+        return a
+
+    def test_geometries_never_mix(self):
+        a = LaneAssembler()
+        for i in range(3):
+            a.stage(f"g1e{i}", "t", ("g1",))
+        for i in range(2):
+            a.stage(f"g2e{i}", "t", ("g2",))
+        g, entries = a.take(8)
+        assert g == ("g1",) and len(entries) == 3   # biggest first
+        g, entries = a.take(8)
+        assert g == ("g2",) and len(entries) == 2
+        assert a.take(8) is None and len(a) == 0
+
+    def test_round_robin_interleaves_tenants(self):
+        a = self._staged([("flood", f"f{i}") for i in range(10)]
+                         + [("quiet", "q0"), ("quiet", "q1")])
+        _, entries = a.take(4)
+        # one lane per pending tenant per wheel pass: the quiet
+        # tenant is in the FIRST batch despite staging last
+        assert entries == ["f0", "q0", "f1", "q1"]
+
+    def test_wheel_resumes_after_last_served(self):
+        a = self._staged([(t, f"{t}{i}") for i in range(4)
+                          for t in ("a", "b", "c")])
+        served = [[e[0] for e in a.take(2)[1]] for _ in range(3)]
+        assert served == [["a", "b"], ["c", "a"], ["b", "c"]]
+
+    def test_quota_caps_lanes_per_batch(self):
+        a = LaneAssembler(policy=TenantPolicy(
+            quotas={"flood": 0.5}))
+        for i in range(10):
+            a.stage(f"f{i}", "flood", None)
+        a.stage("q0", "quiet", None)
+        _, entries = a.take(4)
+        # flood capped at floor(0.5*4)=2 lanes; quiet has one staged
+        assert entries.count("q0") == 1
+        assert sum(e.startswith("f") for e in entries) == 2
+        # with only the capped tenant left, the batch stays short
+        _, entries = a.take(4)
+        assert entries == ["f2", "f3"]
+
+    def test_minimum_one_lane_per_tenant(self):
+        p = TenantPolicy(quotas={"t": 0.01})
+        assert p.lane_cap("t", 8) == 1    # floor would be 0
+        assert p.lane_cap("other", 8) == 8
+        assert TenantPolicy(quotas={"t": 2.0}).lane_cap("t", 4) == 4
+
+    def test_admission_policy(self):
+        p = TenantPolicy(max_pending=2)
+        assert p.admit("t", 0) and p.admit("t", 1)
+        assert not p.admit("t", 2)
+        assert TenantPolicy().admit("t", 10 ** 6)   # disabled
+
+
+def _numeric_process(payload, tier=None):
+    if isinstance(payload, np.ndarray) \
+            and not np.isfinite(payload).all():
+        raise MalformedInputError("<epoch>", "non-finite epoch")
+    return {"v": float(np.mean(payload)), "ok": 0}
+
+
+class TestBatchedDaemon:
+    """The daemon's lane-assembly paths over the in-process queue."""
+
+    def _service(self, tmp_path, calls=None, **kw):
+        def process_batch(payloads, tier=None):
+            if calls is not None:
+                calls.append(len(payloads))
+            return [_numeric_process(p) for p in payloads]
+
+        src = QueueSource(hash_payloads=True)
+        kw.setdefault("http", False)
+        kw.setdefault("heartbeat", False)
+        kw.setdefault("report", False)
+        kw.setdefault("max_batch", 8)
+        svc = SurveyService(src, _numeric_process, tmp_path / "run",
+                            process_batch=process_batch, **kw)
+        return src, svc
+
+    def test_burst_batches_then_drains_to_single_dispatch(
+            self, tmp_path):
+        calls = []
+        before = obs_metrics.snapshot()["counters"]
+        src, svc = self._service(tmp_path, calls=calls, prefetch=16)
+        with svc:
+            for i in range(32):
+                src.put(f"e{i:02d}", np.full((3, 3), float(i)))
+            assert _wait(lambda: len(svc.results()) == 32)
+            # B target rose under the burst and work was dispatched
+            # as batched groups of power-of-two width
+            snap = obs_metrics.snapshot()["counters"]
+            n_batches = snap.get("serve_batches_total", 0) \
+                - before.get("serve_batches_total", 0)
+            assert n_batches >= 1
+            assert calls and all(
+                c in (2, 4, 8) for c in calls)   # bucketed widths
+            # idle → the controller drains back to B=1 in O(log B)
+            # gauge ticks, restoring single-epoch dispatch
+            assert _wait(lambda: svc._controller.current == 1,
+                         timeout=10)
+            src.put("late", np.full((3, 3), 99.0))
+            assert _wait(lambda: "late" in svc.results())
+            snap2 = obs_metrics.snapshot()["counters"]
+            assert snap2.get("serve_batches_total", 0) \
+                == snap.get("serve_batches_total", 0)
+        results = svc.results()
+        assert all(r["status"] == "ok" for r in results.values())
+        assert results["e07"]["result"]["v"] == 7.0
+
+    def test_quota_keeps_quiet_tenant_in_every_batch(self, tmp_path):
+        """Starvation gate: a flooding tenant never fills more than
+        its fair share of any batch, and the quiet tenant's epochs
+        all publish."""
+        ctrl = AdaptiveBatchController(max_batch=4)
+        ctrl.observe(16)                  # start batched (B=4)
+        src, svc = self._service(
+            tmp_path, max_batch=4, controller=ctrl, prefetch=16,
+            tenant_policy=TenantPolicy(quotas={"flood": 0.5}))
+        with svc:
+            for i in range(12):
+                src.put(f"f{i:02d}", np.full((2, 2), float(i)),
+                        tenant="flood")
+            for i in range(2):
+                src.put(f"q{i}", np.full((2, 2), 100.0 + i),
+                        tenant="quiet")
+            assert _wait(lambda: len(svc.results()) == 14)
+        for ev in slog.recent(event="serve.batch"):
+            cap = max(1, int(0.5 * ev["b_target"]))
+            assert ev["tenants"].get("flood", 0) <= cap
+        snap = obs_metrics.snapshot()["counters"]
+        assert snap['serve_tenant_published_total{tenant="quiet"}'] \
+            >= 2
+        assert snap['serve_tenant_published_total{tenant="flood"}'] \
+            >= 12
+
+    def test_admission_control_rejects_before_load(self, tmp_path):
+        """Over-quota arrivals are refused at admission — status
+        ``rejected``, never loaded or published; a neighbour tenant's
+        admission is untouched."""
+        src, svc = self._service(
+            tmp_path, tenant_policy=TenantPolicy(max_pending=2))
+        # everything queued BEFORE the loop starts pulling: t1's
+        # pending count walks 0,1,2,2,2 deterministically
+        for i in range(5):
+            src.put(f"t1e{i}", np.full((2, 2), float(i)),
+                    tenant="t1")
+        src.put("t2e0", np.full((2, 2), 50.0), tenant="t2")
+        with svc:
+            assert _wait(
+                lambda: svc.state_snapshot()["counts"].get(
+                    "rejected", 0) == 3
+                and len(svc.results()) == 3)
+            state = svc.state_snapshot()
+        rejected = {k: v for k, v in state["epochs"].items()
+                    if v["status"] == "rejected"}
+        assert set(rejected) == {"t1e2", "t1e3", "t1e4"}
+        assert all(v["tenant"] == "t1" for v in rejected.values())
+        assert state["epochs"]["t2e0"]["status"] == "ok"
+        assert set(svc.results()) == {"t1e0", "t1e1", "t2e0"}
+        assert slog.recent(event="serve.tenant_rejected")
+        snap = obs_metrics.snapshot()["counters"]
+        assert snap['serve_tenant_rejected_total{tenant="t1"}'] == 3
+
+    def test_bad_tenant_lane_quarantines_in_group(self, tmp_path):
+        """A poisoned lane inside a batched group quarantines (guards
+        health word → lane reject → per-epoch descent raises) while
+        its groupmates publish ok — and per-tenant quarantine
+        accounting lands on the right namespace."""
+        ctrl = AdaptiveBatchController(max_batch=4)
+        ctrl.observe(16)
+
+        def process_batch(payloads, tier=None):
+            out = []
+            for p in payloads:
+                bad = not np.isfinite(p).all()
+                out.append({"v": 0.0 if bad else float(np.mean(p)),
+                            "ok": 1 if bad else 0})
+            return out
+
+        src = QueueSource(hash_payloads=True)
+        svc = SurveyService(
+            src, _numeric_process, tmp_path / "run",
+            process_batch=process_batch, max_batch=4,
+            controller=ctrl, http=False, heartbeat=False,
+            report=False, prefetch=16)
+        with svc:
+            for i in range(3):
+                src.put(f"good{i}", np.full((2, 2), float(i)),
+                        tenant="healthy")
+            bad = np.full((2, 2), np.nan)
+            src.put("poison", bad, tenant="rogue")
+            assert _wait(lambda: len(svc.results()) == 4)
+            state = svc.state_snapshot()["epochs"]
+        assert state["poison"]["status"] == "quarantined"
+        assert state["poison"]["error_class"] == "MalformedInputError"
+        for i in range(3):
+            assert state[f"good{i}"]["status"] == "ok"
+            assert svc.results()[f"good{i}"]["result"]["v"] \
+                == float(i)
+        snap = obs_metrics.snapshot()["counters"]
+        assert snap[
+            'serve_tenant_quarantined_total{tenant="rogue"}'] == 1
+        assert snap[
+            'serve_tenant_published_total{tenant="healthy"}'] >= 3
+
+
+class TestBitwiseLaneQuarantine:
+    def test_neighbour_lanes_bitwise_untouched(self):
+        """The real batched fit program (fit.scint_params_serve): a
+        NaN-poisoned lane flips its health word and NaNs its own
+        results; every OTHER lane's bytes are IDENTICAL to an
+        all-healthy run of the same program — the guards-pattern
+        quarantine is bitwise, not just approximate."""
+        from scintools_tpu.fit.batch import make_scint_params_serve
+
+        B, nf, nt = 4, 16, 16
+        rng = np.random.default_rng(7)
+        healthy = (10.0 + rng.standard_normal(
+            (B, nf, nt))).astype(np.float32)
+        poisoned = healthy.copy()
+        poisoned[2, ::3, ::2] = np.nan
+
+        fn = make_scint_params_serve(B, nf, nt, 1.0, 1.0, n_iter=8)
+        out_h = {k: np.asarray(v) for k, v in fn(healthy).items()}
+        out_p = {k: np.asarray(v) for k, v in fn(poisoned).items()}
+        assert out_p["ok"][2] != 0
+        assert all(np.isnan(out_p[k][2]) for k in out_p
+                   if k != "ok")
+        assert not out_h["ok"].any()
+        for k in out_h:
+            for lane in (0, 1, 3):
+                assert out_h[k][lane].tobytes() \
+                    == out_p[k][lane].tobytes(), (k, lane)
+
+
+class TestStreamingMerge:
+    """fleet/merge.py:iter_merged — the external-sort streaming path
+    must be byte- and stats-identical to the in-memory oracle."""
+
+    def _journals(self, tmp_path, n_epochs=25, n_workers=3):
+        from scintools_tpu.fleet.merge import merge_records
+
+        rng = np.random.default_rng(5)
+        paths = []
+        for w in range(n_workers):
+            j = EpochJournal(tmp_path / f"w{w}.jsonl")
+            paths.append(os.fspath(j.path))
+            for e in rng.permutation(n_epochs)[: n_epochs - w]:
+                j.append(f"e{e:03d}", status="ok",
+                         result={"v": float(e)}, worker=f"w{w}",
+                         t_commit=round(10.0 + w + e / 100, 4))
+        order = [f"e{i:03d}" for i in range(n_epochs)]
+        return paths, order, merge_records(paths, order=order)
+
+    def test_spilled_merge_matches_oracle(self, tmp_path):
+        from scintools_tpu.fleet.merge import iter_merged
+
+        paths, order, (want_lines, want_stats) = \
+            self._journals(tmp_path)
+        stats = {}
+        # chunk_records=2 forces dozens of spill runs through the
+        # k-way heap — the smallest possible memory footprint
+        lines = list(iter_merged(paths, order=order, stats=stats,
+                                 chunk_records=2,
+                                 tmp_dir=os.fspath(tmp_path)))
+        assert lines == want_lines
+        assert stats == want_stats
+        # no spill-run litter left behind
+        assert not [p for p in os.listdir(tmp_path)
+                    if p.endswith(".run")]
+
+    def test_merge_journals_streams_with_tiny_chunks(self, tmp_path):
+        from scintools_tpu.fleet.merge import merge_journals
+
+        paths, order, (want_lines, want_stats) = \
+            self._journals(tmp_path, n_epochs=12)
+        out = tmp_path / "merged.jsonl"
+        stats = merge_journals(paths, out, order=order,
+                               chunk_records=3)
+        assert stats == want_stats
+        got = out.read_text().splitlines()
+        assert got == want_lines
+        assert [json.loads(ln)["epoch"] for ln in got] \
+            == order[:12]
+
+    def test_unlisted_epochs_sort_at_the_end(self, tmp_path):
+        from scintools_tpu.fleet.merge import (iter_merged,
+                                               merge_records)
+
+        j = EpochJournal(tmp_path / "w.jsonl")
+        for e in ("zz", "aa", "mm"):
+            j.append(e, status="ok", result={}, worker="w",
+                     t_commit=1.0)
+        path = os.fspath(j.path)
+        want, _ = merge_records([path], order=["mm"])
+        got = list(iter_merged([path], order=["mm"],
+                               chunk_records=1,
+                               tmp_dir=os.fspath(tmp_path)))
+        assert got == want
+        assert [json.loads(ln)["epoch"] for ln in got] \
+            == ["mm", "aa", "zz"]
