@@ -1702,6 +1702,96 @@ def bench_fleet_plane(jax, jnp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_fleet_chaos(jax, jnp):
+    """Config (ISSUE 17): the chaos soak — the SAME 3-worker scenario
+    pod as `fleet_survey`, run under a seeded fault schedule
+    (fleet/chaos.py: transient EIO + delayed ops at the fsops seam,
+    one worker's clock skewed) with the backlog autoscaler attached,
+    so the run exercises retry/backoff, skew-tolerant leases, and at
+    least one scale-down as the queue drains.
+
+    The gate generalises the PR-11 scheduler gate to the chaos era:
+    queue operations + fsop retry WAIT + the journal merge must stay
+    under 10% of worker busy time — injected faults are absorbed by
+    bounded backoff, not by stalling the survey. Recorded: retry
+    counts (total and per worker), retry wait seconds, steal/release
+    tallies, degraded parks (expected 0 at these rates), merge
+    conflicts (must be 0 — chaos must not break the determinism
+    contract), and the overhead fraction. Byte-identity of the
+    merged journal against an unfaulted oracle is pinned at test
+    scale in tests/test_chaos.py; the bench gates cost, not bytes.
+    Workers on CPU for the same reason as `fleet_survey`."""
+    import shutil
+    import tempfile
+
+    from scintools_tpu.obs.report import validate_run_report
+    from scintools_tpu.sim.scenario import run_scenario_fleet
+
+    kw = dict(epochs_per_regime=48, seed=11, numsteps=1000,
+              n_iter=40)
+    n_epochs = 3 * kw["epochs_per_regime"]
+    batch = 18                              # 8 tasks for 3 workers
+    chaos = {"seed": 17,
+             "rates": {"eio": 0.01, "delay": 0.01},
+             "delay_s": 0.01,
+             # w1 runs 2 s fast — covered by skew_s below
+             "clock_offsets": {"w1": 2.0}}
+    autoscale = {"min_workers": 1, "max_workers": 3,
+                 "tasks_per_worker": 2.0, "cooldown_polls": 2}
+    root = tempfile.mkdtemp(prefix="bench_chaos_")
+    record = {"epochs": n_epochs, "batch_size": batch,
+              "chaos": chaos, "worker_platform": "cpu"}
+    try:
+        wd = os.path.join(root, "pod")
+        t0 = time.perf_counter()
+        out = run_scenario_fleet(
+            wd, n_workers=3, batch_size=batch, timeout=900.0,
+            pod_options={"lease_s": 30.0, "skew_s": 5.0,
+                         "chaos": chaos, "autoscale": autoscale,
+                         "worker_env": {"JAX_PLATFORMS": "cpu"}},
+            **kw)
+        wall = time.perf_counter() - t0
+        with open(os.path.join(wd, "run_report.json")) as fh:
+            validate_run_report(json.load(fh))
+        fleet = out["fleet"]
+        busy = sum(float(st.get("busy_s") or 0.0)
+                   for st in fleet["workers"].values())
+        qops = sum(float(st.get("queue_op_s") or 0.0)
+                   for st in fleet["workers"].values())
+        retry_s = float(fleet.get("fsop_retry_s") or 0.0)
+        merge_s = fleet["merge"]["merge_s"]
+        overhead = ((qops + retry_s + merge_s) / busy
+                    if busy else None)
+        record.update({
+            "wall_s": round(wall, 2),
+            "epochs_per_sec": round(n_epochs / wall, 2),
+            "ok": out["summary"]["n_ok"],
+            "quarantined": out["summary"]["n_quarantined"],
+            "fsop_retries": fleet.get("fsop_retries"),
+            "fsop_retry_s": round(retry_s, 4),
+            "retries_by_worker": {
+                w: st.get("fsop_retries")
+                for w, st in fleet["workers"].items()},
+            "steals": fleet["steals"],
+            "released": fleet.get("released"),
+            "degraded": fleet.get("degraded"),
+            "drained_workers": fleet.get("drained_workers"),
+            "merge_s": round(merge_s, 4),
+            "merge_conflicts": fleet["merge"]["conflicts"],
+            "sched_overhead_frac": round(overhead, 4)
+            if overhead is not None else None,
+            # the chaos-era gate: scheduler + retry backoff < 10%
+            # of busy time (docs/fleet.md "Failure model")
+            "sched_overhead_ok": bool(overhead is not None
+                                      and overhead < 0.10),
+            "merge_conflicts_zero": fleet["merge"]["conflicts"] == 0,
+            "all_epochs_ok": out["summary"]["n_ok"] == n_epochs,
+        })
+        return record
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_survey(jax, jnp):
     """Config #5: survey epochs/sec — sspec + full acf1d LM fit per
     epoch, sharded/batched (ref survey loop dynspec.py:4357 + per-epoch
@@ -2715,6 +2805,7 @@ _EST_S = {
     # host-side quantity; N processes must not share one tunnel)
     "fleet_survey":  {"acc": 240, "cpu": 240},
     "fleet_plane":   {"acc": 200, "cpu": 200},
+    "fleet_chaos":   {"acc": 150, "cpu": 150},
     "robust":        {"acc": 60,  "cpu": 60},
     "acf_fit":       {"acc": 60,  "cpu": 60},
     "acf2d":         {"acc": 150, "cpu": 60},
@@ -2858,6 +2949,7 @@ def main():
         ("scenario_loop", bench_scenario_loop),
         ("fleet_survey", bench_fleet_survey),
         ("fleet_plane", bench_fleet_plane),
+        ("fleet_chaos", bench_fleet_chaos),
         ("robust", bench_robust_survey),
         ("acf_fit", bench_acf_fit),
         ("acf2d", bench_acf2d_fit),

@@ -26,13 +26,27 @@ survivable and any host sharing the queue directory can join.
   (ISSUE 13): incremental journal tails, the cross-worker /state
   union with live conflict detection, and the one-port merged
   ``/metrics``/``/state``/``/report``/``/workers`` surface
-  (obs/plane.py) started via ``Pod(plane_port=...)``.
+  (obs/plane.py) started via ``Pod(plane_port=...)``;
+- :mod:`.fsops` — the ONE seam every fleet filesystem operation goes
+  through (ISSUE 17 tentpole): bounded-retry/backoff on transient
+  errors, per-op deadlines, the degraded-park escape hatch
+  (:class:`FsOpDegradedError`), and the injectable clock;
+- :mod:`.chaos` — deterministic seeded fault injection at that seam
+  (EIO/ESTALE/torn-write/delay/hang, per-worker clock offsets,
+  crash/dead-disk schedules) — ``Pod(chaos=...)`` faults a whole
+  fleet reproducibly;
+- :mod:`.elastic` — the backlog-driven :class:`Autoscaler`;
+  ``Pod(autoscale=...)`` acts on it with graceful drain-file
+  scale-down (zero loss, zero steals on a clean drain).
 
 The proving workload is the closed-loop scenario survey
 (``sim/scenario.py:run_scenario_fleet``). Operator docs:
 docs/fleet.md.
 """
 
+from .chaos import ChaosEngine, ChaosSchedule
+from .elastic import Autoscaler, as_autoscaler
+from .fsops import FsOpDegradedError, FsOps, RetryPolicy
 from .merge import (ATTRIBUTION_FIELDS, iter_merged, merge_journals,
                     merge_records)
 from .pod import Pod, run_pod
@@ -43,6 +57,9 @@ from .worker import (FleetWorker, demo_workload, resolve_workload,
                      run_worker)
 
 __all__ = [
+    "ChaosEngine", "ChaosSchedule",
+    "Autoscaler", "as_autoscaler",
+    "FsOpDegradedError", "FsOps", "RetryPolicy",
     "ATTRIBUTION_FIELDS", "iter_merged", "merge_journals",
     "merge_records",
     "Pod", "run_pod",

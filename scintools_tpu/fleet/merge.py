@@ -50,6 +50,7 @@ import tempfile
 from ..obs import metrics as _metrics
 from ..parallel.checkpoint import EpochJournal
 from ..utils import slog
+from . import fsops as _fsops
 
 #: the worker-attribution columns stripped from merged lines — the
 #: documented "modulo" of the byte-identity contract (docs/fleet.md).
@@ -158,13 +159,14 @@ def _stream_key(rec, rank_of, pi, li):
     return (rank_of.get(key, len(rank_of)), key, t, worker, pi, li)
 
 
-def _spill_run(buf, tmp_dir):
+def _spill_run(buf, tmp_dir, fs=None):
     """Sort one in-memory chunk and spill it as a JSON-lines run
     file (``[key, record]`` per line; json round-trips the inf
     commit stamps of unstamped records)."""
+    fs = fs or _fsops.DEFAULT
     buf.sort(key=lambda e: e[0])
     fd, path = tempfile.mkstemp(dir=tmp_dir, suffix=".run")
-    with os.fdopen(fd, "w", encoding="utf-8") as fh:
+    with fs.fdopen(fd, "w", encoding="utf-8") as fh:
         for k, rec in buf:
             fh.write(json.dumps([list(k), rec]) + "\n")
     return path
@@ -179,7 +181,8 @@ def _iter_run(path):
 
 
 def iter_merged(journal_paths, order=None, strip=ATTRIBUTION_FIELDS,
-                chunk_records=100_000, stats=None, tmp_dir=None):
+                chunk_records=100_000, stats=None, tmp_dir=None,
+                fs=None):
     """Stream the canonical merged journal lines (sans newline, in
     epoch total order) holding at most ``chunk_records`` records in
     memory: chunks external-sort into spill runs, a ``heapq.merge``
@@ -206,7 +209,8 @@ def iter_merged(journal_paths, order=None, strip=ATTRIBUTION_FIELDS,
                     if own_tmp is None and tmp_dir is None:
                         own_tmp = tempfile.mkdtemp(
                             prefix="fleet-merge-")
-                    runs.append(_spill_run(buf, tmp_dir or own_tmp))
+                    runs.append(_spill_run(buf, tmp_dir or own_tmp,
+                                           fs=fs))
                     buf = []
         buf.sort(key=lambda e: e[0])
         merged = heapq.merge(*([_iter_run(p) for p in runs]
@@ -239,6 +243,8 @@ def iter_merged(journal_paths, order=None, strip=ATTRIBUTION_FIELDS,
     finally:
         for p in runs:
             try:
+                # lint-ok: fsops-seam: best-effort spill cleanup —
+                # retrying/degrading here would mask the real error
                 os.unlink(p)
             except OSError:
                 pass
@@ -256,29 +262,35 @@ def _format_line(rec, strip):
 
 
 def merge_journals(journal_paths, out_path, order=None,
-                   strip=ATTRIBUTION_FIELDS, chunk_records=100_000):
+                   strip=ATTRIBUTION_FIELDS, chunk_records=100_000,
+                   fs=None):
     """Merge per-worker journals into the canonical survey journal at
     ``out_path`` (written atomically: temp + fsync + rename, so a
     reader — or a re-merge after a crash — never sees a torn merge).
     The merge STREAMS (:func:`iter_merged`): memory is bounded by
-    ``chunk_records``, not the journal size. Returns the merge stats
-    dict; the merged file re-verifies line-for-line through the
-    normal :class:`EpochJournal` reader."""
+    ``chunk_records``, not the journal size. Writes go through the
+    retrying fsops seam (``fs``); returns the merge stats dict; the
+    merged file re-verifies line-for-line through the normal
+    :class:`EpochJournal` reader."""
+    fs = fs or _fsops.DEFAULT
     out_path = os.fspath(out_path)
     stats = {}
     out_dir = os.path.dirname(out_path) or "."
     fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".merge.tmp")
     try:
-        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+        with fs.fdopen(fd, "w", encoding="utf-8") as fh:
             for line in iter_merged(journal_paths, order=order,
                                     strip=strip, stats=stats,
-                                    chunk_records=chunk_records):
+                                    chunk_records=chunk_records,
+                                    fs=fs):
                 fh.write(line + "\n")
             fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, out_path)
+            fs.fsync(fh)
+        fs.replace(tmp, out_path)
     except BaseException:
         try:
+            # lint-ok: fsops-seam: best-effort temp cleanup on the
+            # failure path — must not retry or mask the raise
             os.unlink(tmp)
         except OSError:
             pass

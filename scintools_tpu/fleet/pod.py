@@ -43,9 +43,11 @@ from ..obs import heartbeat as _hb
 from ..obs import metrics as _metrics
 from ..obs import report as _report
 from ..obs import trace as _trace
-from ..parallel.checkpoint import atomic_write_json
 from ..robust.runner import EpochOutcome
 from ..utils import slog
+from . import fsops as _fsops
+from .chaos import ChaosSchedule
+from .elastic import as_autoscaler
 from .merge import merge_journals
 from .queue import WorkQueue
 from .worker import resolve_workload, run_worker
@@ -60,9 +62,9 @@ _PKG_ROOT = os.path.abspath(
 class _ProcessWorker:
     """Handle on one spawned worker subprocess."""
 
-    def __init__(self, worker_id, cmd, env, log_path):
+    def __init__(self, worker_id, cmd, env, log_path, fs=None):
         self.worker_id = worker_id
-        self._log = open(log_path, "ab")
+        self._log = (fs or _fsops.DEFAULT).open_write(log_path, "ab")
         self.proc = subprocess.Popen(cmd, env=env, stdout=self._log,
                                      stderr=subprocess.STDOUT)
         self.pid = self.proc.pid
@@ -134,7 +136,8 @@ class Pod:
                  poll_s=0.25, monitor_s=0.2, mode="process",
                  worker_env=None, worker_options=None,
                  max_recoveries=2, journal_name="journal.merged.jsonl",
-                 plane_port=None, plane_host="127.0.0.1"):
+                 plane_port=None, plane_host="127.0.0.1",
+                 autoscale=None, chaos=None):
         self.workdir = os.fspath(workdir)
         self.workload_spec = workload
         self.n_workers = int(n_workers)
@@ -148,10 +151,18 @@ class Pod:
         self.worker_options = dict(worker_options or {})
         self.max_recoveries = int(max_recoveries)
         self.journal_name = journal_name
+        # the coordinator's own (unfaulted) filesystem seam; the
+        # chaos spec — when set — ships to WORKERS via worker_spec
+        self._fs = _fsops.FsOps(worker="pod")
+        self.autoscaler = as_autoscaler(autoscale)
+        self.chaos_spec = None if chaos is None \
+            else ChaosSchedule.from_spec(chaos).to_spec()
 
         self.queue_root = os.path.join(self.workdir, "queue")
         self.out_root = self.workdir
         os.makedirs(self.workdir, exist_ok=True)
+        self.drain_dir = os.path.join(self.out_root, "drain")
+        self._fs.makedirs(self.drain_dir)
         if epochs is None:
             # resolving builds the epoch table (cheap — no device
             # program runs until a worker processes a task)
@@ -165,15 +176,20 @@ class Pod:
         self.workers = []
         self._dead = set()
         self._recoveries = 0
+        self._spawned = 0           # next scale-up/initial worker id
+        self._draining = set()      # drain-signalled worker ids
+        self._target = self.n_workers
         self._t0 = None
         self._queue = WorkQueue(self.queue_root, worker="pod",
                                 lease_s=self.lease_s,
-                                skew_s=self.skew_s)
+                                skew_s=self.skew_s, fs=self._fs)
         # incremental heartbeat reads (ISSUE 13): one mtime-gated
         # scanner shared by the monitor loop and the telemetry-plane
-        # handler threads — a tick over unchanged files is stat-only
+        # handler threads — a tick over unchanged files is stat-only;
+        # staleness forgives the same skew the lease stealer does
         self.heartbeat_scanner = _hb.HeartbeatScanner(
-            os.path.join(self.out_root, "heartbeats"))
+            os.path.join(self.out_root, "heartbeats"),
+            skew_s=self.skew_s)
         self.plane_port = plane_port
         self.plane_host = plane_host
         self.telemetry = None
@@ -186,6 +202,13 @@ class Pod:
                  self.epochs[i:i + self.batch_size])
                 for i in range(0, len(self.epochs), self.batch_size)]
 
+    def _worker_options(self):
+        opts = {"lease_s": self.lease_s, "skew_s": self.skew_s,
+                "poll_s": self.poll_s, **self.worker_options}
+        if self.chaos_spec is not None:
+            opts["chaos"] = self.chaos_spec
+        return opts
+
     def start(self):
         self._t0 = time.perf_counter()
         tasks = self.tasks()
@@ -193,17 +216,15 @@ class Pod:
         slog.log_event("fleet.pod_start", workdir=self.workdir,
                        n_workers=self.n_workers, n_tasks=len(tasks),
                        seeded=seeded, n_epochs=len(self.epochs),
-                       mode=self.mode)
+                       mode=self.mode,
+                       chaos=self.chaos_spec is not None)
         spec = {"workload": self.workload_spec,
-                "options": {"lease_s": self.lease_s,
-                            "skew_s": self.skew_s,
-                            "poll_s": self.poll_s,
-                            **self.worker_options}}
+                "options": self._worker_options()}
         self._spec_path = os.path.join(self.workdir,
                                        "worker_spec.json")
-        atomic_write_json(self._spec_path, spec)
-        for i in range(self.n_workers):
-            self.workers.append(self._spawn(f"w{i}"))
+        self._fs.write_json(self._spec_path, spec)
+        for _ in range(self.n_workers):
+            self.workers.append(self._spawn(self._next_id()))
         if self.plane_port is not None:
             from .telemetry import PodTelemetry
 
@@ -211,7 +232,7 @@ class Pod:
                 host=self.plane_host, port=int(self.plane_port))
             # discovery file: an ephemeral port (plane_port=0) must
             # be findable by scrapers that only know the workdir
-            atomic_write_json(
+            self._fs.write_json(
                 os.path.join(self.workdir, "plane.json"),
                 {"url": self.telemetry.url,
                  "host": self.plane_host,
@@ -221,13 +242,15 @@ class Pod:
                            workdir=self.workdir)
         return self
 
+    def _next_id(self):
+        wid = f"w{self._spawned}"
+        self._spawned += 1
+        return wid
+
     def _spawn(self, worker_id):
         if self.mode == "thread":
             spec = {"workload": self.workload_spec,
-                    "options": {"lease_s": self.lease_s,
-                                "skew_s": self.skew_s,
-                                "poll_s": self.poll_s,
-                                **self.worker_options}}
+                    "options": self._worker_options()}
             return _ThreadWorker(
                 worker_id,
                 lambda: run_worker(self.queue_root, self.out_root,
@@ -244,7 +267,48 @@ class Pod:
         log_path = os.path.join(self.workdir, "workers", worker_id)
         os.makedirs(log_path, exist_ok=True)
         return _ProcessWorker(worker_id, cmd, env,
-                              os.path.join(log_path, "worker.log"))
+                              os.path.join(log_path, "worker.log"),
+                              fs=self._fs)
+
+    # ---- elastic scaling (ISSUE 17, fleet/elastic.py) ---------------
+    def active_workers(self):
+        """Workers that are alive and NOT drain-signalled — the
+        population the autoscaler's target is compared against."""
+        return [w for w in self.workers
+                if w.alive() and w.worker_id not in self._draining]
+
+    def scale_to(self, n):
+        """Move the fleet toward ``n`` active workers: spawn the
+        shortfall, or drain the excess (most-recently-spawned first)
+        via per-worker drain signal files — the graceful hand-off
+        documented in fleet/elastic.py. Returns the new target."""
+        n = max(0, int(n))
+        active = self.active_workers()
+        if n > len(active):
+            added = [self._next_id() for _ in range(n - len(active))]
+            for wid in added:
+                self.workers.append(self._spawn(wid))
+            _metrics.counter(
+                "fleet_scale_ups_total",
+                help="workers spawned by scale-up decisions"
+            ).inc(len(added))
+            slog.log_event("fleet.scale_up", added=added, target=n)
+        elif n < len(active):
+            victims = [w.worker_id for w in
+                       reversed(active)][:len(active) - n]
+            for wid in victims:
+                self._fs.write_json(
+                    os.path.join(self.drain_dir, wid + ".drain"),
+                    {"t": round(self._fs.now(), 3), "by": "pod"})
+                self._draining.add(wid)
+            _metrics.counter(
+                "fleet_scale_downs_total",
+                help="workers drain-signalled by scale-down "
+                     "decisions").inc(len(victims))
+            slog.log_event("fleet.scale_down", drained=victims,
+                           target=n)
+        self._target = n
+        return n
 
     # ---- monitoring -------------------------------------------------
     def heartbeats(self):
@@ -264,13 +328,26 @@ class Pod:
         return 0.0 if self._t0 is None \
             else time.perf_counter() - self._t0
 
+    def degraded_workers(self):
+        """Worker ids whose last heartbeat declared the degraded
+        park (fleet/worker.py:_park_degraded) — alive, but no longer
+        claiming or renewing."""
+        beats = self.heartbeat_scanner.scan()
+        return sorted(
+            w.worker_id for w in self.workers
+            if w.alive() and (beats.get(w.worker_id) or {}
+                              ).get("phase") == "degraded")
+
     def poll(self):
         """One monitor pass: pod-level gauges from the queue and the
-        heartbeat files, dead-worker detection, recovery spawn when
-        the whole fleet is gone with work outstanding. Returns the
-        queue counts."""
+        heartbeat files, dead-worker detection, the autoscaler step,
+        recovery spawn when no worker can make progress with work
+        outstanding. Returns the queue counts."""
         counts = self._queue.counts()
         beats = self.heartbeats()
+        degraded = {w.worker_id for w in self.workers
+                    if w.alive() and (beats.get(w.worker_id) or {}
+                                      ).get("phase") == "degraded"}
         _metrics.gauge("fleet_queue_pending",
                        help="tasks waiting in the fleet queue"
                        ).set(counts["pending"])
@@ -285,6 +362,15 @@ class Pod:
                        ).set(sum(1 for w in self.workers
                                  if w.alive()))
         _metrics.gauge(
+            "fleet_workers_degraded",
+            help="live workers parked in fsop-degraded mode"
+        ).set(len(degraded))
+        _metrics.gauge(
+            "fleet_workers_draining",
+            help="workers drain-signalled and not yet exited"
+        ).set(sum(1 for w in self.workers
+                  if w.alive() and w.worker_id in self._draining))
+        _metrics.gauge(
             "fleet_pod_epochs_done",
             help="epochs completed across the pod (heartbeat view)"
         ).set(sum(int(b.get("epochs", 0)) for b in beats.values()))
@@ -292,7 +378,8 @@ class Pod:
             if w.alive() or w.worker_id in self._dead:
                 continue
             beat = beats.get(w.worker_id) or {}
-            if w.returncode() == 0 and beat.get("phase") == "done":
+            if w.returncode() == 0 and beat.get("phase") in (
+                    "done", "draining", "degraded"):
                 continue                 # clean exit, not a death
             self._dead.add(w.worker_id)
             _metrics.counter("fleet_workers_dead_total",
@@ -302,10 +389,33 @@ class Pod:
                 error=f"exit code {w.returncode()}",
                 epoch=w.worker_id,
                 last_phase=beat.get("phase"),
-                heartbeat_age_s=round(_hb.heartbeat_age_s(beat), 3)
-                if beat else None)
-        if not any(w.alive() for w in self.workers) \
-                and not self._queue.drained():
+                heartbeat_age_s=round(
+                    _hb.heartbeat_age_s(beat, skew_s=self.skew_s),
+                    3) if beat else None)
+        drained = counts["pending"] == 0 and counts["claimed"] == 0
+        if drained and degraded:
+            # the run is over: send parked-degraded workers home (a
+            # dead disk may keep them from ever observing drained())
+            for wid in degraded:
+                if wid not in self._draining:
+                    self._fs.write_json(
+                        os.path.join(self.drain_dir,
+                                     wid + ".drain"),
+                        {"t": round(self._fs.now(), 3),
+                         "by": "pod", "reason": "drained"})
+                    self._draining.add(wid)
+        if self.autoscaler is not None and not drained:
+            target = self.autoscaler.target(counts)
+            if target != len(self.active_workers()):
+                self.scale_to(target)
+        _metrics.gauge(
+            "fleet_workers_target",
+            help="autoscaler/scale_to worker-count target"
+        ).set(self._target)
+        # a degraded worker is alive but cannot make progress — the
+        # recovery condition counts only workers that still can
+        if not any(w.alive() and w.worker_id not in degraded
+                   for w in self.workers) and not drained:
             if self._recoveries >= self.max_recoveries:
                 raise RuntimeError(
                     "fleet stalled: all workers dead, queue not "
@@ -313,22 +423,30 @@ class Pod:
                     "workers")
             self._recoveries += 1
             wid = f"r{self._recoveries}"
+            _metrics.counter(
+                "fleet_recovery_spawns_total",
+                help="recovery workers spawned after fleet-wide "
+                     "death/degradation").inc()
             slog.log_event("fleet.recovery_spawn", worker=wid,
                            pending=counts["pending"],
                            claimed=counts["claimed"])
             self.workers.append(self._spawn(wid))
         return counts
 
-    def wait(self, timeout=600.0):
+    def wait(self, timeout=600.0, on_poll=None):
         """Monitor until the queue drains and every worker exits,
         then merge and report. Raises :class:`TimeoutError` when the
         run exceeds ``timeout`` (workers are killed first so the
-        caller does not leak processes)."""
+        caller does not leak processes). ``on_poll(pod, counts)``
+        runs after every monitor pass — the chaos soak drives its
+        scripted scale-down/up cycles from there."""
         deadline = time.monotonic() + float(timeout)
         try:
             try:
                 while True:
                     counts = self.poll()
+                    if on_poll is not None:
+                        on_poll(self, counts)
                     if counts["pending"] == 0 \
                             and counts["claimed"] == 0 \
                             and not any(w.alive()
@@ -421,11 +539,24 @@ class Pod:
                               for b in beats.values()),
             "dead_workers": sorted(self._dead),
             "recoveries": self._recoveries,
+            "released": sum(int(b.get("released", 0))
+                            for b in beats.values()),
+            "degraded": sum(int(b.get("degraded", 0))
+                            for b in beats.values()),
+            "fsop_retries": sum(int(b.get("fsop_retries", 0))
+                                for b in beats.values()),
+            "fsop_retry_s": round(
+                sum(float(b.get("fsop_retry_s", 0.0))
+                    for b in beats.values()), 4),
+            "drained_workers": sorted(self._draining),
+            "workers_target": self._target,
             "merge": {**merge_stats, "merge_s": round(merge_s, 4)},
             "trace": trace_stats,
             "workers": {w: {k: b.get(k) for k in
                             ("tasks", "stolen", "epochs", "n_ok",
                              "n_quarantined", "lease_lost",
+                             "released", "degraded",
+                             "fsop_retries", "fsop_retry_s",
                              "queue_op_s", "idle_wait_s", "busy_s",
                              "phase")}
                         for w, b in beats.items()},

@@ -63,26 +63,28 @@ See docs/fleet.md for the operator view of the protocol.
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from dataclasses import dataclass, field
 
 from ..obs import metrics as _metrics
-from ..parallel.checkpoint import atomic_write_json
 from ..utils import slog
+from . import fsops as _fsops
 
 
-def claim_by_rename(src_path, dst_dir):
+def claim_by_rename(src_path, dst_dir, fs=None):
     """THE claim primitive: atomically move ``src_path`` into
     ``dst_dir``; returns the new path when this caller won the race,
     None when another claimer got there first (the source vanished).
     Both paths must be on the same filesystem (the shared queue/spool
-    directory always is)."""
-    os.makedirs(dst_dir, exist_ok=True)
+    directory always is). ``fs`` is the retryable filesystem seam
+    (fleet/fsops.py; transient faults retried, lost races passed
+    through)."""
+    fs = fs or _fsops.DEFAULT
+    fs.makedirs(dst_dir)
     dst = os.path.join(dst_dir, os.path.basename(os.fspath(src_path)))
     try:
-        os.rename(os.fspath(src_path), dst)
+        fs.rename(os.fspath(src_path), dst)
     except FileNotFoundError:
         return None
     return dst
@@ -111,11 +113,16 @@ class WorkQueue:
     with) and the lease/skew policy.
     """
 
-    def __init__(self, root, worker="w0", lease_s=30.0, skew_s=2.0):
+    def __init__(self, root, worker="w0", lease_s=30.0, skew_s=2.0,
+                 fs=None):
         self.root = os.fspath(root)
         self.worker = str(worker)
         self.lease_s = float(lease_s)
         self.skew_s = float(skew_s)
+        # the filesystem seam (fleet/fsops.py): every op below routes
+        # through it — retry/backoff on transient faults, chaos
+        # injection, and the worker's (possibly skewed) clock
+        self.fs = fs or _fsops.DEFAULT
         self.tasks_dir = os.path.join(self.root, "tasks")
         self.claims_dir = os.path.join(self.root, "claims")
         self.my_claims = os.path.join(self.claims_dir, self.worker)
@@ -123,7 +130,7 @@ class WorkQueue:
         self.done_dir = os.path.join(self.root, "done")
         for d in (self.tasks_dir, self.my_claims, self.leases_dir,
                   self.done_dir):
-            os.makedirs(d, exist_ok=True)
+            self.fs.makedirs(d)
         # (holder, task_id) -> first time observed claimed with NO
         # lease (the _steal_leaseless persistence gate)
         self._leaseless_seen = {}
@@ -141,7 +148,7 @@ class WorkQueue:
             tid = str(task_id)
             if tid in existing:
                 continue
-            atomic_write_json(
+            self.fs.write_json(
                 os.path.join(self.tasks_dir, tid + ".json"),
                 {"task": tid,
                  "epochs": [[str(e), p] for e, p in epochs]})
@@ -153,19 +160,19 @@ class WorkQueue:
     def _known_task_ids(self):
         ids = set()
         for d in (self.tasks_dir, self.done_dir):
-            ids |= {f[:-5] for f in os.listdir(d)
+            ids |= {f[:-5] for f in self.fs.listdir(d)
                     if f.endswith(".json")}
         for w in self._workers():
             ids |= {f[:-5]
-                    for f in os.listdir(os.path.join(self.claims_dir,
-                                                     w))
+                    for f in self.fs.listdir(
+                        os.path.join(self.claims_dir, w))
                     if f.endswith(".json")}
         return ids
 
     def _workers(self):
         try:
             return sorted(
-                w for w in os.listdir(self.claims_dir)
+                w for w in self.fs.listdir(self.claims_dir)
                 if os.path.isdir(os.path.join(self.claims_dir, w)))
         except FileNotFoundError:
             return []
@@ -191,8 +198,7 @@ class WorkQueue:
 
     def _load_task(self, path, stolen=False, stolen_from=""):
         try:
-            with open(path) as fh:
-                doc = json.load(fh)
+            doc = self.fs.read_json(path)
         except FileNotFoundError:
             # vanished between listing and open — another claimer
             # renamed it away; theirs now, and their (possibly fresh)
@@ -204,7 +210,8 @@ class WorkQueue:
             # condition (the pod reports bad tasks at merge time)
             slog.log_failure("fleet.task_error", stage="load", error=e,
                              epoch=os.path.basename(path))
-            claim_by_rename(path, os.path.join(self.root, "bad"))
+            claim_by_rename(path, os.path.join(self.root, "bad"),
+                            fs=self.fs)
             self._drop_lease(os.path.basename(path)[:-5])
             return None
         return Task(task_id=str(doc["task"]),
@@ -214,7 +221,8 @@ class WorkQueue:
     def _claim_fresh(self):
         for name in self._listing(self.tasks_dir):
             won = claim_by_rename(
-                os.path.join(self.tasks_dir, name), self.my_claims)
+                os.path.join(self.tasks_dir, name), self.my_claims,
+                fs=self.fs)
             if won is None:
                 continue               # another worker beat us to it
             task = self._load_task(won)
@@ -249,7 +257,7 @@ class WorkQueue:
         return None
 
     def _steal_expired(self):
-        now = time.time()
+        now = self.fs.now()
         for name in self._listing(self.leases_dir):
             tid = name[:-5]
             lease = self.read_lease(tid)
@@ -259,7 +267,7 @@ class WorkQueue:
             if holder == self.worker:
                 continue               # covered by _reclaim_own
             src = os.path.join(self.claims_dir, holder, name)
-            won = claim_by_rename(src, self.my_claims)
+            won = claim_by_rename(src, self.my_claims, fs=self.fs)
             if won is None:
                 # not under the lease holder's dir: a previous stealer
                 # may have renamed it and died before renewing the
@@ -269,7 +277,7 @@ class WorkQueue:
                         continue
                     won = claim_by_rename(
                         os.path.join(self.claims_dir, w, name),
-                        self.my_claims)
+                        self.my_claims, fs=self.fs)
                     if won is not None:
                         break
             if won is None:
@@ -321,7 +329,7 @@ class WorkQueue:
                     continue           # maybe mid-first-renew
                 won = claim_by_rename(
                     os.path.join(self.claims_dir, holder, name),
-                    self.my_claims)
+                    self.my_claims, fs=self.fs)
                 if won is None:
                     continue           # racer got it first
                 self._leaseless_seen.pop(key, None)
@@ -345,7 +353,7 @@ class WorkQueue:
 
     def _listing(self, d):
         try:
-            return sorted(f for f in os.listdir(d)
+            return sorted(f for f in self.fs.listdir(d)
                           if f.endswith(".json"))
         except FileNotFoundError:
             return []
@@ -357,18 +365,22 @@ class WorkQueue:
     def read_lease(self, task_id):
         """The current lease record for ``task_id`` (or None). A
         torn/corrupt lease reads as None — i.e. immediately
-        reclaimable, which errs on the side of re-running work."""
+        reclaimable, which errs on the side of re-running work.
+        (A DEGRADED filesystem does not: FsOpDegradedError is not an
+        OSError precisely so it escapes this handler and parks the
+        worker instead of reading as an empty lease.)"""
         try:
-            with open(self._lease_path(task_id)) as fh:
-                return json.load(fh)
+            return self.fs.read_json(self._lease_path(task_id))
         except (OSError, ValueError):
             return None
 
     def _expired(self, lease, now=None):
         """True once ``now`` is ``skew_s`` past the lease's stamped
         expiry — the stealer's clock vs the holder's clock, so hosts
-        disagreeing by less than ``skew_s`` never steal live work."""
-        now = time.time() if now is None else now
+        disagreeing by less than ``skew_s`` never steal live work.
+        ``now`` defaults to the seam's clock (:meth:`FsOps.now` —
+        wall time plus this process's injected offset)."""
+        now = self.fs.now() if now is None else now
         try:
             expires = float(lease.get("expires_t", 0.0))
         except (TypeError, ValueError):
@@ -392,10 +404,11 @@ class WorkQueue:
                            task=task.task_id,
                            holder=lease.get("worker"))
             return False
-        atomic_write_json(self._lease_path(task.task_id), {
+        now = self.fs.now()
+        self.fs.write_json(self._lease_path(task.task_id), {
             "task": task.task_id, "worker": self.worker,
-            "stamped_t": round(time.time(), 3),
-            "expires_t": round(time.time() + self.lease_s, 3)})
+            "stamped_t": round(now, 3),
+            "expires_t": round(now + self.lease_s, 3)})
         return True
 
     # ---- completion -------------------------------------------------
@@ -411,7 +424,7 @@ class WorkQueue:
         claim whose lease vanishes while its holder is mid-crash is
         unstealable by the expiry scan (the ISSUE-13 wedge; the
         lease-less steal path below is the backstop)."""
-        won = claim_by_rename(task.path, self.done_dir)
+        won = claim_by_rename(task.path, self.done_dir, fs=self.fs)
         if won is not None:
             self._drop_lease(task.task_id)
         else:
@@ -434,16 +447,32 @@ class WorkQueue:
         return True
 
     def release(self, task):
-        """Put a claimed task back on the queue untouched (graceful
-        shutdown mid-claim)."""
-        claim_by_rename(task.path, self.tasks_dir)
+        """Put a claimed task back on the queue untouched — the
+        inverse of claim-by-rename (graceful shutdown / drain
+        mid-claim). Survivors re-claim it through the FRESH path;
+        no lease has to expire first."""
+        claim_by_rename(task.path, self.tasks_dir, fs=self.fs)
         lease = self.read_lease(task.task_id)
         if lease is None or lease.get("worker") == self.worker:
             self._drop_lease(task.task_id)
+        slog.log_event("fleet.release", worker=self.worker,
+                       task=task.task_id)
+
+    def release_own(self):
+        """Release EVERY claim this worker still holds back to
+        pending (the drain protocol's hand-off step, fleet/elastic.
+        py); returns the number released."""
+        n = 0
+        for name in self._listing(self.my_claims):
+            self.release(Task(task_id=name[:-5], epochs=[],
+                              path=os.path.join(self.my_claims,
+                                                name)))
+            n += 1
+        return n
 
     def _drop_lease(self, task_id):
         try:
-            os.unlink(self._lease_path(task_id))
+            self.fs.unlink(self._lease_path(task_id))
         except FileNotFoundError:
             pass
 

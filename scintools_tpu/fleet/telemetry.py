@@ -326,34 +326,54 @@ class PodTelemetry:
     def workers_snapshot(self):
         """``/workers``: per-worker liveness/lag from the heartbeat
         files, plus the scan accounting that witnesses the
-        incremental (mtime-gated) read path."""
+        incremental (mtime-gated) read path. Ages apply the pod's
+        ``skew_s`` allowance (the lease-stealer convention — a
+        skewed-but-beating worker is not reported stale), and the
+        degraded/draining lifecycle states ride along both
+        per-worker and as fleet-level counts (ISSUE 17 satellite)."""
         beats = self.refresh()
         now = time.time()
         alive = {w.worker_id: bool(w.alive())
                  for w in list(self.pod.workers)}
+        draining = set(getattr(self.pod, "_draining", ()))
         stale_after = max(self.pod.lease_s, 1.0)
+        skew_s = float(getattr(self.pod, "skew_s", 0.0))
         workers = {}
         for wid in sorted(set(beats) | set(alive)):
             b = beats.get(wid)
-            age = round(_hb.heartbeat_age_s(b, now=now), 3) \
+            age = round(_hb.heartbeat_age_s(b, now=now,
+                                            skew_s=skew_s), 3) \
                 if b is not None else None
+            phase = (b or {}).get("phase")
             workers[wid] = {
-                "phase": (b or {}).get("phase"),
+                "phase": phase,
                 "epochs": (b or {}).get("epochs"),
                 "tasks": (b or {}).get("tasks"),
                 "stolen": (b or {}).get("stolen"),
                 "n_ok": (b or {}).get("n_ok"),
                 "n_quarantined": (b or {}).get("n_quarantined"),
                 "lease_lost": (b or {}).get("lease_lost"),
+                "released": (b or {}).get("released"),
+                "fsop_retries": (b or {}).get("fsop_retries"),
                 "pid": (b or {}).get("pid"),
                 "heartbeat_age_s": age,
                 "stale": bool(age is None or age > stale_after),
                 "alive": alive.get(wid),
+                "degraded": phase == "degraded",
+                "draining": bool(
+                    wid in draining or phase == "draining"),
             }
         scanner = self.pod.heartbeat_scanner
         return {"workers": workers,
                 "n_alive": sum(1 for v in alive.values() if v),
+                "n_degraded": sum(1 for v in workers.values()
+                                  if v["degraded"]),
+                "n_draining": sum(1 for v in workers.values()
+                                  if v["draining"]),
+                "workers_target": getattr(self.pod, "_target",
+                                          None),
                 "stale_after_s": stale_after,
+                "skew_s": skew_s,
                 "scan": {"scans": scanner.scans,
                          "files_read": scanner.reads,
                          "last": dict(scanner.last_stats)}}

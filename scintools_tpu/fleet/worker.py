@@ -47,6 +47,8 @@ import time
 from ..obs import heartbeat as _hb
 from ..obs import metrics as _metrics
 from ..utils import slog
+from . import chaos as _chaos
+from . import fsops as _fsops
 from .queue import WorkQueue
 
 
@@ -148,11 +150,26 @@ class FleetWorker:
     def __init__(self, queue_root, out_root, workload, worker_id="w0",
                  lease_s=15.0, skew_s=2.0, poll_s=0.25,
                  heartbeat_s=None, retries=1, max_wall_s=None,
-                 trace_spool=True):
+                 trace_spool=True, chaos=None, clock_offset_s=0.0,
+                 fs=None):
         self.worker_id = str(worker_id)
         self.out_root = os.fspath(out_root)
+        # the filesystem seam (ISSUE 17): chaos — a ChaosSchedule /
+        # spec dict / ChaosEngine — injects faults at it; the
+        # (possibly skewed) clock it owns stamps the leases,
+        # heartbeats, and journal commits below
+        engine = None
+        if chaos is not None:
+            engine = chaos if isinstance(chaos, _chaos.ChaosEngine) \
+                else _chaos.ChaosEngine(chaos, self.worker_id)
+        offset = float(clock_offset_s) \
+            + (engine.clock_offset() if engine is not None else 0.0)
+        self.fs = fs or _fsops.FsOps(chaos=engine,
+                                     clock_offset_s=offset,
+                                     worker=self.worker_id)
         self.queue = WorkQueue(queue_root, worker=self.worker_id,
-                               lease_s=lease_s, skew_s=skew_s)
+                               lease_s=lease_s, skew_s=skew_s,
+                               fs=self.fs)
         self.workload = resolve_workload(workload)
         self.poll_s = float(poll_s)
         self.retries = int(retries)
@@ -163,14 +180,20 @@ class FleetWorker:
                                     self.worker_id)
         self.hb_path = os.path.join(self.out_root, "heartbeats",
                                     self.worker_id + ".json")
-        os.makedirs(self.workdir, exist_ok=True)
-        os.makedirs(os.path.dirname(self.hb_path), exist_ok=True)
+        # the drain signal (fleet/elastic.py): the pod writes this
+        # file to request a graceful scale-down exit
+        self.drain_path = os.path.join(self.out_root, "drain",
+                                       self.worker_id + ".drain")
+        self.fs.makedirs(self.workdir)
+        self.fs.makedirs(os.path.dirname(self.hb_path))
         self.stats = {"worker": self.worker_id, "tasks": 0,
                       "stolen": 0, "epochs": 0, "n_ok": 0,
                       "n_quarantined": 0, "lease_lost": 0,
                       "queue_op_s": 0.0, "idle_wait_s": 0.0,
-                      "busy_s": 0.0}
+                      "busy_s": 0.0, "released": 0, "degraded": 0,
+                      "fsop_retries": 0, "fsop_retry_s": 0.0}
         self._task = None
+        self._exit_phase = None
         self._beat = _LeaseBeat(self, self.heartbeat_s)
         # per-worker trace fragment spool (ISSUE 13): every stage
         # span the runner records is flushed journal-adjacently (on
@@ -193,23 +216,29 @@ class FleetWorker:
     # worker id + per-record commit instant, appended at line end
     def _journal_extra(self):
         return {"worker": self.worker_id,
-                "t_commit": round(time.time(), 3)}
+                "t_commit": round(self.fs.now(), 3)}
 
-    def _heartbeat(self, done=None, final=False, **stats):
+    def _heartbeat(self, done=None, final=False, phase=None, **stats):
         if self._task is not None:
             t0 = time.perf_counter()
             if not self.queue.renew(self._task):
                 self.stats["lease_lost"] += 1
             self.stats["queue_op_s"] += time.perf_counter() - t0
+        self.stats["fsop_retries"] = self.fs.retries
+        self.stats["fsop_retry_s"] = round(self.fs.retry_wait_s, 4)
         rec = dict(self.stats)
-        rec["phase"] = "done" if final else (
-            "task" if self._task is not None else "idle")
+        rec["phase"] = phase or ("done" if final else (
+            "task" if self._task is not None else "idle"))
         if done is not None:
             rec["task_done"] = int(done)
         rec.update(stats)
         rec["metrics"] = _metrics.REGISTRY.snapshot() \
             if _metrics.REGISTRY.enabled else None
-        _hb.write_heartbeat_file(self.hb_path, **rec)
+        # stamped with the seam's clock and written through it: a
+        # skewed worker's heartbeats carry its OWN time (the scanner
+        # compensates via skew_s), and a faulty write is retried
+        _hb.write_heartbeat_file(self.hb_path, now=self.fs.now(),
+                                 writer=self.fs.write_json, **rec)
         self._flush_trace()
 
     def _flush_trace(self):
@@ -240,8 +269,7 @@ class FleetWorker:
                  "epoch": str(epoch),
                  "t0": round(t0 + self._trace_anchor, 6),
                  "t1": round(t1 + self._trace_anchor, 6)}))
-        with open(self.trace_path, "a") as fh:
-            fh.write("\n".join(lines) + "\n")
+        self.fs.append_text(self.trace_path, "\n".join(lines) + "\n")
         self._trace_flushed += len(new)
         self._trace_ids_flushed.update(new_ids)
         return len(lines)
@@ -285,38 +313,116 @@ class FleetWorker:
         self.stats["queue_op_s"] += time.perf_counter() - t0
         self._heartbeat()
 
+    def _drain_requested(self):
+        """Plain stat probe of the drain signal file (never faulted
+        — the pod must be able to drain a degraded worker)."""
+        return self.fs.exists(self.drain_path)
+
+    def _drain(self):
+        """The graceful scale-down hand-off (fleet/elastic.py): the
+        in-flight task already completed (the drain check sits
+        between tasks); release every remaining claim back to
+        pending so survivors re-claim through the fresh path — zero
+        tasks transit lease-expiry stealing on a clean drain."""
+        t0 = time.perf_counter()
+        released = self.queue.release_own()
+        self.stats["queue_op_s"] += time.perf_counter() - t0
+        self.stats["released"] += released
+        self._exit_phase = "draining"
+        slog.log_event("fleet.drain", worker=self.worker_id,
+                       released=released)
+        return "drain"
+
+    def _park_degraded(self, err):
+        """Degraded-mode park (ISSUE 17): this worker's filesystem
+        exhausted its retry budget. Stop claiming, stop renewing
+        (``self._task`` is cleared, so leases expire HONESTLY and a
+        survivor steals the in-flight work — no half-renewed
+        leases), keep best-effort ``degraded`` heartbeats so the pod
+        and ``/workers`` see a parked-not-dead worker. Leaves the
+        park when the queue drains, a drain signal arrives, or
+        ``max_wall_s`` runs out."""
+        self.stats["degraded"] = 1
+        self._task = None
+        self._exit_phase = "degraded"
+        slog.log_event("fleet.worker_degraded",
+                       worker=self.worker_id, op=err.op,
+                       path=err.path, attempts=err.attempts)
+        while True:
+            try:
+                self._heartbeat(phase="degraded")
+            except (OSError, _fsops.FsOpDegradedError):
+                # last-gasp channel: the park status must not depend
+                # on the dead data plane — fall back to the plain
+                # atomic writer so the pod still SEES the park (and
+                # can drain-signal this worker home once the queue
+                # empties; without this a dead disk wedges wait())
+                try:
+                    rec = dict(self.stats)
+                    rec["phase"] = "degraded"
+                    _hb.write_heartbeat_file(
+                        self.hb_path, now=self.fs.now(), **rec)
+                except OSError:
+                    pass
+            if self.max_wall_s is not None and time.monotonic() \
+                    - self._t_start > self.max_wall_s:
+                return "max_wall_s"
+            if self._drain_requested():
+                return "drain"
+            try:
+                if self.queue.drained():
+                    return "degraded"
+            except (OSError, _fsops.FsOpDegradedError):
+                pass  # a dead disk must not crash the park loop
+            time.sleep(self.poll_s)
+
     def run(self):
         """The worker loop; returns the stats dict (also written as
         the final heartbeat record)."""
         slog.log_event("fleet.worker_start", worker=self.worker_id,
                        queue=self.queue.root)
-        t_start = time.monotonic()
-        self._heartbeat()
-        while True:
-            if self.max_wall_s is not None \
-                    and time.monotonic() - t_start > self.max_wall_s:
-                slog.log_event("fleet.worker_exit",
-                               worker=self.worker_id,
-                               reason="max_wall_s")
+        self._t_start = time.monotonic()
+        reason = None
+        while reason is None:
+            if self.max_wall_s is not None and time.monotonic() \
+                    - self._t_start > self.max_wall_s:
+                reason = "max_wall_s"
                 break
-            t0 = time.perf_counter()
-            task = self.queue.claim()
-            self.stats["queue_op_s"] += time.perf_counter() - t0
-            if task is not None:
-                self._run_task(task)
-                continue
-            if self.queue.drained():
-                slog.log_event("fleet.worker_exit",
-                               worker=self.worker_id,
-                               reason="drained")
+            if self._drain_requested():
+                try:
+                    reason = self._drain()
+                except _fsops.FsOpDegradedError as e:
+                    reason = self._park_degraded(e)
                 break
-            # the queue is not drained but nothing is claimable: some
-            # other worker holds a live lease — poll until it
-            # completes or its lease expires and becomes stealable
-            self.stats["idle_wait_s"] += self.poll_s
-            self._heartbeat()
+            try:
+                if self.stats["tasks"] == 0 \
+                        and self.stats["idle_wait_s"] == 0:
+                    self._heartbeat()   # announce before first claim
+                t0 = time.perf_counter()
+                task = self.queue.claim()
+                self.stats["queue_op_s"] += time.perf_counter() - t0
+                if task is not None:
+                    self._run_task(task)
+                    continue
+                if self.queue.drained():
+                    reason = "drained"
+                    break
+                # the queue is not drained but nothing is claimable:
+                # some other worker holds a live lease — poll until
+                # it completes or its lease expires and becomes
+                # stealable
+                self.stats["idle_wait_s"] += self.poll_s
+                self._heartbeat()
+            except _fsops.FsOpDegradedError as e:
+                reason = self._park_degraded(e)
+                break
             time.sleep(self.poll_s)
-        self._heartbeat(final=True)
+        slog.log_event("fleet.worker_exit", worker=self.worker_id,
+                       reason=reason)
+        try:
+            self._heartbeat(final=True, phase=self._exit_phase)
+        except _fsops.FsOpDegradedError:
+            pass                       # parked worker, still-dead fs
         return dict(self.stats)
 
 

@@ -99,13 +99,19 @@ class Heartbeat:
 # staleness is judged against the reader's clock with the caller's
 # skew allowance.
 
-def write_heartbeat_file(path, **fields):
+def write_heartbeat_file(path, now=None, writer=None, **fields):
     """Atomically (re)write a heartbeat file: ``fields`` plus a ``t``
-    wall-clock stamp and the writing ``pid``. Returns the record."""
+    wall-clock stamp and the writing ``pid``. Returns the record.
+
+    ``now`` overrides the stamp clock (a fleet worker stamps with
+    its fsops clock, so injected skew is visible to the scanner) and
+    ``writer`` overrides the atomic-write call (the fleet routes it
+    through the retrying fsops seam)."""
     from ..parallel.checkpoint import atomic_write_json
 
-    rec = {"t": round(time.time(), 3), "pid": os.getpid(), **fields}
-    atomic_write_json(os.fspath(path), rec)
+    t = time.time() if now is None else float(now)
+    rec = {"t": round(t, 3), "pid": os.getpid(), **fields}
+    (writer or atomic_write_json)(os.fspath(path), rec)
     return rec
 
 
@@ -122,16 +128,26 @@ def read_heartbeat_file(path):
         return None
 
 
-def heartbeat_age_s(rec, now=None):
+def heartbeat_age_s(rec, now=None, skew_s=0.0):
     """Seconds since the heartbeat was stamped (``inf`` for a missing
-    record) — the staleness input for dead-worker detection."""
+    record) — the staleness input for dead-worker detection.
+
+    ``skew_s`` is the reader's clock-skew allowance, the SAME
+    convention the lease stealer uses (fleet/queue.py:_expired): the
+    stamp was written by the *worker's* clock and is compared
+    against the *reader's*, so up to ``skew_s`` of the raw age is
+    forgiven (floored at 0) — a skewed-but-alive worker is not
+    reported stale (ISSUE 17 satellite)."""
     if rec is None:
         return float("inf")
     now = time.time() if now is None else now
     try:
-        return now - float(rec.get("t", 0.0))
+        age = now - float(rec.get("t", 0.0))
     except (TypeError, ValueError):
         return float("inf")
+    if skew_s:
+        age = max(0.0, age - float(skew_s))
+    return age
 
 
 def scan_heartbeat_dir(hb_dir, cache=None):
@@ -197,11 +213,18 @@ class HeartbeatScanner:
     incrementality witness) plus the age-distribution gauges
     ``fleet_heartbeat_age_max_seconds`` /
     ``fleet_heartbeat_age_p50_seconds`` (a dead worker shows up as a
-    runaway max while the median stays at the beat cadence)."""
+    runaway max while the median stays at the beat cadence).
 
-    def __init__(self, hb_dir, export_metrics=True):
+    ``skew_s`` forgives that much reader-vs-writer clock
+    disagreement in every age (see :func:`heartbeat_age_s`) — the
+    pod passes its lease ``skew_s`` so the staleness gauges and the
+    ``/workers`` stale flags apply the same tolerance the lease
+    stealer does."""
+
+    def __init__(self, hb_dir, export_metrics=True, skew_s=0.0):
         self.hb_dir = os.fspath(hb_dir)
         self.export_metrics = bool(export_metrics)
+        self.skew_s = float(skew_s)
         self._lock = threading.Lock()
         self._cache = {}
         self.scans = 0
@@ -221,7 +244,8 @@ class HeartbeatScanner:
                 "fleet_heartbeat_files_read_total",
                 help="heartbeat files actually (re)read by "
                      "mtime-gated scans").inc(stats["read"])
-            ages = sorted(heartbeat_age_s(r, now=now)
+            ages = sorted(heartbeat_age_s(r, now=now,
+                                          skew_s=self.skew_s)
                           for r in records.values())
             if ages:
                 _metrics.gauge(

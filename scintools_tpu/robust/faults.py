@@ -15,7 +15,16 @@ bench's robustness config can reproduce them bit-for-bit:
   :func:`maybe_fail`, a monkeypatchable process-wide hook the
   fallback ladder consults before running each tier, so a compile or
   OOM ``RuntimeError`` can be simulated per (tier, epoch, stage)
-  without a real accelerator failure.
+  without a real accelerator failure;
+- **filesystem faults** (ISSUE 17 satellite) —
+  :func:`torn_write` (a partially visible write that a crashed or
+  EIO'd writer left), :func:`delayed_visibility` /
+  :func:`reveal` (a file hidden from readers until "the rename
+  becomes visible" — NFS-style close-to-open laxity), and
+  :func:`eio_reads` (an ``open()`` patch raising ``EIO`` on matching
+  paths for the first N attempts). tests/test_serve.py drives the
+  spool watcher through these; tests/test_chaos.py uses the same
+  shapes via the fleet's seeded :class:`~..fleet.chaos.ChaosEngine`.
 
 All randomised injectors take an explicit ``seed`` and never touch
 global RNG state.
@@ -113,3 +122,76 @@ def corrupt_file_tail(path, drop_bytes=16):
     with open(path, "rb+") as fh:
         fh.truncate(new)
     return new
+
+
+# ---------------------------------------------------------------------
+# filesystem-fault injectors (ISSUE 17 satellite) — the test-side
+# twins of the faults fleet/chaos.py injects beneath the fsops seam
+# ---------------------------------------------------------------------
+
+def torn_write(path, data, frac=0.5):
+    """Write only the first ``frac`` of ``data`` to ``path``,
+    NON-atomically — the visible-but-incomplete file a writer that
+    died (or hit EIO) mid-``write()`` leaves behind. At least one
+    byte is written so the file exists and is non-empty (the
+    hard-to-detect shape; a zero-byte file is trivially torn).
+    Returns the number of bytes written."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    n = max(1, int(len(data) * float(frac))) if data else 0
+    with open(os.fspath(path), "wb") as fh:
+        fh.write(data[:n])
+    return n
+
+
+def delayed_visibility(path, suffix=".invisible"):
+    """Hide ``path`` from readers by renaming it aside — the
+    NFS-style window where a completed rename is not yet visible to
+    another client. Returns the hidden path to hand to
+    :func:`reveal`. The pair is atomic at each end, so a watcher
+    never sees a torn file — only a late one."""
+    path = os.fspath(path)
+    hidden = path + suffix
+    os.replace(path, hidden)
+    return hidden
+
+
+def reveal(hidden, suffix=".invisible"):
+    """Complete a :func:`delayed_visibility` window: rename the
+    hidden file back into place and return the visible path."""
+    hidden = os.fspath(hidden)
+    if not hidden.endswith(suffix):
+        raise ValueError(f"not a hidden path: {hidden!r}")
+    path = hidden[:-len(suffix)]
+    os.replace(hidden, path)
+    return path
+
+
+@contextlib.contextmanager
+def eio_reads(match, times=1):
+    """Patch ``builtins.open`` so the first ``times`` opens of a
+    path containing ``match`` raise ``OSError(EIO)`` — a flaky disk
+    under a reader. Yields the mutable list of faulted paths; other
+    opens pass through untouched."""
+    import builtins
+    import errno
+
+    real_open = builtins.open
+    faulted = []
+
+    def flaky_open(file, *args, **kwargs):
+        try:
+            name = os.fspath(file)
+        except TypeError:
+            name = ""
+        if (isinstance(name, str) and match in name
+                and len(faulted) < int(times)):
+            faulted.append(name)
+            raise OSError(errno.EIO, "injected EIO", name)
+        return real_open(file, *args, **kwargs)
+
+    builtins.open = flaky_open
+    try:
+        yield faulted
+    finally:
+        builtins.open = real_open

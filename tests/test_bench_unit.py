@@ -323,8 +323,9 @@ class TestBudgetFallback:
         # ISSUE 10: +sim_factory +scenario_loop (sim_batch kept as the
         # legacy-entry continuity measurement); ISSUE 12: +fft_layer;
         # ISSUE 13: +fleet_plane; ISSUE 14: +arc_detect;
-        # ISSUE 15: +mcmc_batch; ISSUE 16: +serve_batched
-        assert len(d["configs"]) == 22
+        # ISSUE 15: +mcmc_batch; ISSUE 16: +serve_batched;
+        # ISSUE 17: +fleet_chaos
+        assert len(d["configs"]) == 23
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9

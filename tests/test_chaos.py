@@ -1,0 +1,584 @@
+"""Chaos-hardened elastic fleet tests (ISSUE 17): the fsops
+retry/degrade seam, the deterministic chaos harness, REAL injected
+clock skew against the lease protocol, backlog autoscaling, the
+graceful scale-down drain, degraded-mode parking, and the acceptance
+soak — a faulted, elastically-scaled multi-process pod whose merged
+journal is byte-identical to the unfaulted single-worker oracle's.
+
+The load-bearing contracts pinned here:
+
+- transient fs faults (EIO/ESTALE/...) are retried under bounded
+  jittered backoff; exhaustion raises :class:`FsOpDegradedError`
+  (NOT an OSError) and the worker parks instead of crashing;
+- every chaos fault draw is a pure function of (seed, worker,
+  op-index) — a soak replays bit-for-bit;
+- ``skew_s`` really is the clock-skew allowance: a stealer whose
+  clock runs ahead steals live work exactly when the allowance is
+  smaller than the skew, in both skew directions;
+- a clean scale-down drain moves ZERO tasks through lease-expiry
+  stealing — released claims return via the fresh-claim path;
+- no schedule of kills, hangs, skew, fs faults, and scale cycles
+  changes a single byte of the merged journal.
+"""
+
+import errno
+import json
+import os
+import random
+import time
+
+import pytest
+
+from scintools_tpu.fleet import (Autoscaler, ChaosEngine,
+                                 ChaosSchedule, FsOpDegradedError,
+                                 FsOps, Pod, RetryPolicy, WorkQueue,
+                                 as_autoscaler, demo_workload)
+from scintools_tpu.obs import heartbeat as hb
+from scintools_tpu.obs.report import validate_run_report
+from scintools_tpu.parallel.checkpoint import EpochJournal
+from scintools_tpu.robust import run_survey_batched
+from scintools_tpu.utils import slog
+
+DEMO_SPEC = {"target": "scintools_tpu.fleet.worker:demo_workload"}
+
+
+def _spec(**params):
+    return {**DEMO_SPEC, "params": params}
+
+
+def _oracle_journal(tmp_path, name="oracle", **params):
+    """Unfaulted single-process runner journal for the same demo
+    workload — the byte-identity reference."""
+    wl = demo_workload(**params)
+    run_survey_batched(wl["epochs"], wl["process_batch"],
+                       tmp_path / name, process=wl["process"],
+                       batch_size=5, report=False)
+    return EpochJournal(tmp_path / name / "journal.jsonl"
+                        ).valid_lines()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("retries", 4)
+    kw.setdefault("base_s", 0.001)
+    kw.setdefault("max_s", 0.002)
+    return RetryPolicy(**kw)
+
+
+class TestRetryPolicy:
+    def test_classify(self):
+        p = RetryPolicy()
+        assert p.classify(FileNotFoundError("gone")) == "semantic"
+        for eno in (errno.EIO, errno.ETIMEDOUT, errno.ENOSPC,
+                    getattr(errno, "ESTALE", 116)):
+            assert p.classify(OSError(eno, "x")) == "transient"
+        assert p.classify(PermissionError(errno.EACCES, "x")) \
+            == "permanent"
+        assert p.classify(ValueError("torn")) == "permanent"
+
+    def test_backoff_is_bounded_and_jittered(self):
+        p = RetryPolicy(base_s=0.01, max_s=0.04, jitter=0.5)
+        rng = random.Random(0)
+        for k in range(1, 8):
+            b = p.backoff_s(k, rng)
+            cap = min(p.max_s, p.base_s * 2 ** (k - 1))
+            assert 0.0 < b <= cap
+        # jitter only ever shrinks the wait (desync, never slower)
+        assert p.backoff_s(10, rng) <= p.max_s
+
+
+class TestFsOps:
+    def test_transient_retry_then_success(self):
+        fs = FsOps(policy=_fast_policy())
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError(errno.EIO, "flaky")
+            return "ok"
+
+        assert fs._call("read", "/x", flaky) == "ok"
+        assert fs.retries == 2
+        assert not fs.degraded
+
+    def test_retry_exhaustion_degrades_not_oserror(self):
+        fs = FsOps(policy=_fast_policy(retries=2), worker="wX")
+
+        def dead():
+            raise OSError(errno.EIO, "dead disk")
+
+        with pytest.raises(FsOpDegradedError) as ei:
+            fs._call("write", "/q/lease.json", dead)
+        # deliberately NOT an OSError: the queue's torn-file handlers
+        # must not read a degraded filesystem as an empty queue
+        assert not isinstance(ei.value, OSError)
+        assert isinstance(ei.value, RuntimeError)
+        assert fs.degraded
+        assert ei.value.op == "write"
+        assert ei.value.attempts == 3
+        evs = slog.recent(event="fleet.fsop_degraded")
+        assert evs and evs[-1]["worker"] == "wX"
+
+    def test_per_op_deadline_degrades(self):
+        fs = FsOps(policy=RetryPolicy(retries=10_000, base_s=0.05,
+                                      max_s=0.05, deadline_s=0.12))
+
+        def dead():
+            raise OSError(errno.EIO, "dead disk")
+
+        t0 = time.monotonic()
+        with pytest.raises(FsOpDegradedError) as ei:
+            fs._call("read", "/x", dead)
+        assert ei.value.deadline
+        assert time.monotonic() - t0 < 2.0   # deadline, not budget
+
+    def test_file_not_found_is_semantic_never_retried(self, tmp_path):
+        fs = FsOps()
+        with pytest.raises(FileNotFoundError):
+            fs.rename(tmp_path / "missing", tmp_path / "dst")
+        assert fs.retries == 0
+
+    def test_permanent_error_raises_immediately(self):
+        fs = FsOps()
+
+        def denied():
+            raise PermissionError(errno.EACCES, "nope")
+
+        with pytest.raises(PermissionError):
+            fs._call("write", "/x", denied)
+        assert fs.retries == 0
+
+    def test_write_json_atomic_roundtrip_no_temp_litter(self,
+                                                       tmp_path):
+        fs = FsOps()
+        p = tmp_path / "doc.json"
+        fs.write_json(p, {"a": 1})
+        assert fs.read_json(p) == {"a": 1}
+        assert os.listdir(tmp_path) == ["doc.json"]
+
+    def test_torn_json_raises_valueerror_unretried(self, tmp_path):
+        fs = FsOps(policy=_fast_policy())
+        p = tmp_path / "torn.json"
+        p.write_text('{"a": 1')           # a torn lease
+        with pytest.raises(ValueError):
+            fs.read_json(p)
+        assert fs.retries == 0            # a state, not a fault
+
+    def test_now_carries_injected_offset(self):
+        fs = FsOps(clock_offset_s=123.0)
+        assert abs(fs.now() - time.time() - 123.0) < 1.0
+
+    def test_exists_is_never_faulted(self, tmp_path):
+        """The drain-signal probe must reach a worker whose data
+        plane is dead — exists() bypasses chaos and retry."""
+        eng = ChaosEngine(ChaosSchedule(fail_after_ops={"w0": 1}),
+                          "w0")
+        fs = FsOps(policy=_fast_policy(retries=1), chaos=eng,
+                   worker="w0")
+        p = tmp_path / "w0.drain"
+        p.write_text("{}")
+        with pytest.raises(FsOpDegradedError):
+            fs.read_bytes(p)
+        assert fs.exists(p)
+
+
+class TestChaosEngine:
+    def test_fault_stream_is_deterministic(self):
+        sched = ChaosSchedule(seed=7, rates={"eio": 0.3,
+                                             "estale": 0.2})
+
+        def stream(worker, n=60):
+            eng = ChaosEngine(sched, worker)
+            out = []
+            for _ in range(n):
+                try:
+                    eng.before("read", "/x")
+                    out.append(None)
+                except OSError as e:
+                    out.append(e.errno)
+            return out
+
+        a = stream("w0")
+        assert a == stream("w0")          # replayable from the seed
+        assert a != stream("w1")          # independent per worker
+        assert any(e is not None for e in a)
+
+    def test_spec_round_trip_is_json_able(self):
+        sched = ChaosSchedule(seed=3, rates={"torn_write": 0.1},
+                              torn_frac=0.25,
+                              clock_offsets={"w1": -4.0},
+                              crash_after_ops={"w2": 9},
+                              fail_after_ops={"w0": 5}, max_faults=7)
+        spec = sched.to_spec()
+        json.dumps(spec)                  # the worker_spec transport
+        assert ChaosSchedule.from_spec(spec).to_spec() == spec
+        assert ChaosSchedule.from_spec(sched) is sched
+
+    def test_unknown_fault_kind_is_loud(self):
+        with pytest.raises(ValueError):
+            ChaosSchedule(rates={"eoi": 0.1})  # typo must not pass
+
+    def test_torn_write_leaves_visible_prefix(self, tmp_path):
+        eng = ChaosEngine(ChaosSchedule(rates={"torn_write": 1.0},
+                                        torn_frac=0.5), "w0")
+        p = tmp_path / "lease.json"
+        with pytest.raises(OSError) as ei:
+            eng.before("write", p, data=b"0123456789")
+        assert ei.value.errno == errno.EIO
+        assert p.read_bytes() == b"01234"  # torn file IS visible
+
+    def test_fail_after_ops_dead_disk(self):
+        eng = ChaosEngine(ChaosSchedule(fail_after_ops={"w0": 3}),
+                          "w0")
+        eng.before("read", "/x")
+        eng.before("read", "/x")
+        for _ in range(4):                # from op 3 on: every op
+            with pytest.raises(OSError):
+                eng.before("read", "/x")
+
+    def test_max_faults_caps_error_injection(self):
+        eng = ChaosEngine(ChaosSchedule(seed=1, rates={"eio": 1.0},
+                                        max_faults=2), "w0")
+        errs = 0
+        for _ in range(10):
+            try:
+                eng.before("read", "/x")
+            except OSError:
+                errs += 1
+        assert errs == 2
+
+    def test_clock_offset_is_per_worker(self):
+        sched = ChaosSchedule(clock_offsets={"w1": 2.5})
+        assert ChaosEngine(sched, "w1").clock_offset() == 2.5
+        assert ChaosEngine(sched, "w0").clock_offset() == 0.0
+
+    def test_fsops_retry_overwrites_torn_write(self, tmp_path):
+        """The integration the protocol rests on: a chaos torn-write
+        lands a visible truncated file, fails the op, and the seam's
+        retry replaces it with the complete content."""
+        sched = ChaosSchedule(seed=0, rates={"torn_write": 1.0},
+                              torn_frac=0.3, max_faults=2)
+        fs = FsOps(policy=_fast_policy(),
+                   chaos=ChaosEngine(sched, "w0"), worker="w0")
+        p = tmp_path / "doc.json"
+        fs.write_json(p, {"payload": "x" * 64})
+        assert fs.read_json(p) == {"payload": "x" * 64}
+        assert fs.retries == 2
+
+
+class TestSkewedLeases:
+    """ISSUE 17 satellite: the clock-skew lease tests run with REAL
+    injected per-process clock offsets (FsOps owns the clock the
+    lease stamps and expiry comparisons use), not monkeypatched
+    time — both skew directions, both the too-eager and the
+    protected case."""
+
+    def _queues(self, tmp_path, holder_off=0.0, stealer_off=0.0,
+                lease_s=2.0, skew_s=1.0):
+        holder = WorkQueue(
+            tmp_path / "q", worker="holder", lease_s=lease_s,
+            skew_s=skew_s,
+            fs=FsOps(clock_offset_s=holder_off, worker="holder"))
+        stealer = WorkQueue(
+            tmp_path / "q", worker="stealer", lease_s=lease_s,
+            skew_s=skew_s,
+            fs=FsOps(clock_offset_s=stealer_off, worker="stealer"))
+        holder.seed([("t0", [("e0", {"seed": 0})])])
+        task = holder.claim()
+        assert task is not None
+        return holder, stealer, task
+
+    def test_fast_clock_stealer_too_eager_when_skew_small(self,
+                                                          tmp_path):
+        # stealer's clock runs 4 s ahead; the live lease expires 2 s
+        # out by the holder's clock — a 1 s allowance cannot cover
+        # the skew and the stealer takes LIVE work
+        holder, stealer, task = self._queues(tmp_path,
+                                             stealer_off=4.0,
+                                             lease_s=2.0, skew_s=1.0)
+        assert holder.renew(task)          # holder is alive and well
+        stolen = stealer.claim()
+        assert stolen is not None and stolen.stolen
+        assert stolen.stolen_from == "holder"
+
+    def test_adequate_skew_allowance_protects_live_lease(self,
+                                                         tmp_path):
+        # same 4 s-fast stealer; a 6 s allowance absorbs the skew
+        holder, stealer, task = self._queues(tmp_path,
+                                             stealer_off=4.0,
+                                             lease_s=2.0, skew_s=6.0)
+        assert holder.renew(task)
+        assert stealer.claim() is None
+        assert holder.complete(task)       # run ends normally
+
+    def test_slow_clock_holder_renewing_is_protected(self, tmp_path):
+        # holder's clock runs 4 s BEHIND: its fresh lease stamps are
+        # already ~2 s expired on the stealer's clock — an adequate
+        # allowance keeps the renewing holder safe
+        holder, stealer, task = self._queues(tmp_path,
+                                             holder_off=-4.0,
+                                             lease_s=2.0, skew_s=6.5)
+        assert holder.renew(task)
+        assert stealer.claim() is None
+        assert holder.complete(task)
+
+    def test_slow_clock_holder_loses_lease_when_skew_small(
+            self, tmp_path):
+        holder, stealer, task = self._queues(tmp_path,
+                                             holder_off=-4.0,
+                                             lease_s=2.0, skew_s=0.5)
+        assert holder.renew(task)
+        stolen = stealer.claim()
+        assert stolen is not None
+        assert stolen.stolen_from == "holder"
+        # the holder discovers the loss at its next heartbeat and
+        # stops investing (the documented err direction: re-run work
+        # the merge dedupes, never lost work)
+        assert holder.renew(task) is False
+
+    def test_heartbeat_staleness_forgives_the_same_skew(self,
+                                                        tmp_path):
+        """Satellite: HeartbeatScanner applies the lease stealer's
+        skew_s convention — a skewed-but-beating worker is not
+        reported stale."""
+        fs = FsOps(clock_offset_s=-5.0, worker="wslow")
+        hb_dir = tmp_path / "heartbeats"
+        os.makedirs(hb_dir)
+        rec = hb.write_heartbeat_file(hb_dir / "wslow.json",
+                                      now=fs.now(),
+                                      writer=fs.write_json,
+                                      worker="wslow")
+        assert time.time() - rec["t"] > 4.0   # raw age ≈ the skew
+        assert hb.heartbeat_age_s(rec, skew_s=5.5) < 1.0
+        scanner = hb.HeartbeatScanner(hb_dir, export_metrics=False,
+                                      skew_s=5.5)
+        assert "wslow" in scanner.scan()
+
+
+class TestAutoscaler:
+    def test_backlog_law_and_clamps(self):
+        a = Autoscaler(min_workers=1, max_workers=4,
+                       tasks_per_worker=2.0, cooldown_polls=0)
+        assert a.raw_target({"pending": 0, "claimed": 0}) == 1
+        assert a.raw_target({"pending": 3, "claimed": 1}) == 2
+        assert a.raw_target({"pending": 5, "claimed": 0}) == 3
+        assert a.raw_target({"pending": 100, "claimed": 7}) == 4
+
+    def test_cooldown_damps_thrash(self):
+        a = Autoscaler(min_workers=1, max_workers=8,
+                       tasks_per_worker=1.0, cooldown_polls=3)
+        assert a.target({"pending": 6, "claimed": 0}) == 6  # free
+        assert a.target({"pending": 2, "claimed": 0}) == 6  # damped
+        assert a.target({"pending": 2, "claimed": 0}) == 6  # damped
+        assert a.target({"pending": 2, "claimed": 0}) == 2  # moves
+        assert a.target({"pending": 5, "claimed": 0}) == 2  # damped
+
+    def test_as_autoscaler_normalises(self):
+        assert as_autoscaler(None) is None
+        a = Autoscaler()
+        assert as_autoscaler(a) is a
+        d = as_autoscaler({"min_workers": 2, "max_workers": 5})
+        assert isinstance(d, Autoscaler) and d.min_workers == 2
+        with pytest.raises(TypeError):
+            as_autoscaler(7)
+
+
+class TestReleaseOwn:
+    def test_release_hands_claims_back_to_fresh_path(self, tmp_path):
+        q = WorkQueue(tmp_path / "q", worker="leaver", lease_s=30.0)
+        q.seed([(f"t{i}", [(f"e{i}", {"seed": i})])
+                for i in range(3)])
+        t0, t1 = q.claim(), q.claim()
+        assert t0 is not None and t1 is not None
+        assert q.counts() == {"pending": 1, "claimed": 2, "done": 0}
+        assert q.release_own() == 2
+        assert q.counts() == {"pending": 3, "claimed": 0, "done": 0}
+        # a survivor re-claims through the FRESH path — not a steal,
+        # and without waiting out any lease
+        survivor = WorkQueue(tmp_path / "q", worker="survivor",
+                             lease_s=30.0)
+        got = [survivor.claim() for _ in range(3)]
+        assert all(t is not None and not t.stolen for t in got)
+        assert {t.task_id for t in got} == {"t0", "t1", "t2"}
+        assert slog.recent(event="fleet.release")
+
+
+class TestGracefulDrain:
+    """Scale-down via the drain protocol, thread mode: the drained
+    workers finish in-flight work, hand unstarted claims back, and
+    exit on a 'draining' heartbeat — zero tasks transit lease-expiry
+    stealing, zero epochs lost."""
+
+    def test_scale_down_is_zero_loss_without_steals(self, tmp_path):
+        pod = Pod(tmp_path / "pod", _spec(n_epochs=24, slow_s=0.05),
+                  n_workers=3, batch_size=2, mode="thread",
+                  lease_s=10.0, skew_s=0.5, poll_s=0.05,
+                  monitor_s=0.05).start()
+        state = {"downed": False}
+
+        def drive(p, counts):
+            if not state["downed"] and counts["done"] >= 2:
+                p.scale_to(1)
+                state["downed"] = True
+
+        out = pod.wait(timeout=120.0, on_poll=drive)
+        assert state["downed"]
+        assert out["summary"]["n_ok"] == 24
+        fleet = out["fleet"]
+        assert fleet["steals"] == 0       # the zero-loss bar: a
+        # clean drain never waits out a lease
+        assert len(fleet["drained_workers"]) == 2
+        assert fleet["workers_target"] == 1
+        assert fleet["dead_workers"] == []
+        assert fleet["merge"]["conflicts"] == 0
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=24)
+        assert slog.recent(event="fleet.scale_down")
+        beats = pod.heartbeats()
+        for wid in fleet["drained_workers"]:
+            assert beats[wid]["phase"] == "draining"
+
+    def test_autoscaler_grows_fleet_for_backlog(self, tmp_path):
+        pod = Pod(tmp_path / "pod", _spec(n_epochs=16, slow_s=0.05),
+                  n_workers=1, batch_size=2, mode="thread",
+                  lease_s=10.0, poll_s=0.05, monitor_s=0.05,
+                  autoscale={"min_workers": 1, "max_workers": 3,
+                             "tasks_per_worker": 2.0,
+                             "cooldown_polls": 0}).start()
+        out = pod.wait(timeout=120.0)
+        assert out["summary"]["n_ok"] == 16
+        # 8 tasks / 2 per worker → the autoscaler grew the fleet
+        assert {w.worker_id for w in pod.workers} \
+            >= {"w0", "w1", "w2"}
+        assert slog.recent(event="fleet.scale_up")
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=16)
+
+
+class TestDegradedPark:
+    def test_dead_disk_worker_parks_pod_finishes(self, tmp_path):
+        """A dead disk (every fs op EIO from op N) exhausts w1's
+        retry budget: w1 parks degraded — visible in heartbeats and
+        /workers — while w0 steals its abandoned work; the pod
+        neither crashes nor loses an epoch, and drain-signals the
+        parked worker home once the queue empties."""
+        from scintools_tpu.fleet.telemetry import PodTelemetry
+
+        pod = Pod(tmp_path / "pod", _spec(n_epochs=12, slow_s=0.02),
+                  n_workers=2, batch_size=2, mode="thread",
+                  lease_s=1.0, skew_s=0.2, poll_s=0.05,
+                  monitor_s=0.05,
+                  chaos={"seed": 5,
+                         "fail_after_ops": {"w1": 40}}).start()
+        tele = PodTelemetry(pod)
+        seen = {"degraded": False, "snapshot": None}
+
+        def watch(p, counts):
+            if not seen["degraded"] and p.degraded_workers():
+                seen["degraded"] = True
+                seen["snapshot"] = tele.workers_snapshot()
+
+        out = pod.wait(timeout=120.0, on_poll=watch)
+        assert out["summary"]["n_ok"] == 12
+        fleet = out["fleet"]
+        assert fleet["degraded"] >= 1
+        assert "w1" not in fleet["dead_workers"]   # parked ≠ dead
+        assert fleet["merge"]["conflicts"] == 0
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=12)
+        assert slog.recent(event="fleet.worker_degraded")
+        assert slog.recent(event="fleet.fsop_degraded")
+        # the /workers view saw the park live (ISSUE 17 satellite)
+        assert seen["degraded"]
+        snap = seen["snapshot"]
+        assert snap["workers"]["w1"]["degraded"]
+        assert snap["n_degraded"] >= 1
+
+
+def _scale_driver(stages):
+    """on_poll callback factory: fire ``scale_to(n)`` as the done
+    count crosses each ``(done_at_least, n)`` threshold, in order —
+    the scripted scale cycles of the chaos soak."""
+    state = {"i": 0}
+
+    def drive(pod, counts):
+        if state["i"] < len(stages) \
+                and counts["done"] >= stages[state["i"]][0]:
+            pod.scale_to(stages[state["i"]][1])
+            state["i"] += 1
+
+    drive.state = state
+    return drive
+
+
+class TestChaosSoak:
+    """ISSUE 17 acceptance (tier-1 scale): a multi-process pod under
+    a seeded chaos schedule — injected EIO/ESTALE/torn-write/delay,
+    a deterministic mid-run crash, real clock skew, and two
+    scale-down/scale-up cycles — drains a 96-epoch queue with the
+    merged journal byte-identical to the unfaulted single-worker
+    oracle: zero epochs lost, zero double-published."""
+
+    def test_96_epoch_faulted_elastic_run_byte_identical(self,
+                                                         tmp_path):
+        chaos = {"seed": 17,
+                 "rates": {"eio": 0.02, "estale": 0.01,
+                           "torn_write": 0.01, "delay": 0.02},
+                 "delay_s": 0.005,
+                 "clock_offsets": {"w1": 1.5},
+                 # w0 dies at its 30th fs op — mid-protocol, the
+                 # deterministic stand-in for SIGKILL
+                 "crash_after_ops": {"w0": 30}}
+        pod = Pod(tmp_path / "pod", _spec(n_epochs=96, slow_s=0.08),
+                  n_workers=3, batch_size=4, lease_s=2.5, skew_s=2.0,
+                  poll_s=0.1, monitor_s=0.1, chaos=chaos).start()
+        drive = _scale_driver([(3, 1), (8, 3), (13, 1), (18, 2)])
+        out = pod.wait(timeout=240.0, on_poll=drive)
+        assert drive.state["i"] == 4       # both cycles fired
+        s = out["summary"]
+        assert s["n_epochs"] == 96
+        assert s["n_ok"] == 96             # zero epochs lost
+        fleet = out["fleet"]
+        assert fleet["dead_workers"] == ["w0"]      # the chaos crash
+        assert fleet["merge"]["conflicts"] == 0
+        assert len(fleet["drained_workers"]) >= 3   # two scale-downs
+        assert fleet["fsop_retries"] >= 1  # faults really landed
+        assert slog.recent(event="fleet.scale_down")
+        assert slog.recent(event="fleet.scale_up")
+        rep = validate_run_report(out["report"])
+        assert rep["fleet"]["workers_target"] == 2
+        # the acceptance bar: byte-identical to the unfaulted
+        # single-worker oracle — zero lost, zero double-published
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=96)
+
+
+@pytest.mark.slow
+class TestChaosSoakSlow:
+    """The full-size soak (registered in bench as ``fleet_chaos``):
+    a larger queue, a richer fault schedule (hangs, slow ops, skew in
+    both directions), and the same byte-identity bar."""
+
+    def test_384_epoch_soak(self, tmp_path):
+        chaos = {"seed": 23,
+                 "rates": {"eio": 0.03, "estale": 0.01,
+                           "torn_write": 0.01, "delay": 0.05,
+                           "hang": 0.002},
+                 "delay_s": 0.01, "hang_s": 0.3,
+                 "clock_offsets": {"w1": 2.0, "w3": -1.5},
+                 "slow_ops_s": {"w2": 0.002},
+                 "crash_after_ops": {"w0": 80}}
+        pod = Pod(tmp_path / "pod", _spec(n_epochs=384, slow_s=0.04),
+                  n_workers=4, batch_size=8, lease_s=4.0, skew_s=2.5,
+                  poll_s=0.1, monitor_s=0.15, chaos=chaos).start()
+        drive = _scale_driver([(6, 2), (16, 5), (28, 2), (38, 4)])
+        out = pod.wait(timeout=900.0, on_poll=drive)
+        assert drive.state["i"] == 4
+        s = out["summary"]
+        assert s["n_epochs"] == 384 and s["n_ok"] == 384
+        fleet = out["fleet"]
+        assert fleet["dead_workers"] == ["w0"]
+        assert fleet["merge"]["conflicts"] == 0
+        assert fleet["fsop_retries"] >= 1
+        merged = EpochJournal(out["journal"]).valid_lines()
+        assert merged == _oracle_journal(tmp_path, n_epochs=384)

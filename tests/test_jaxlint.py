@@ -309,6 +309,66 @@ class TestMetricHygiene:
 
 
 # =====================================================================
+# JL006 fsops-seam (ISSUE 17)
+# =====================================================================
+
+class TestFsopsSeam:
+    def test_flags_raw_directory_mutators(self):
+        src = ("import os\n"
+               "os.rename(a, b)\n"
+               "os.replace(a, b)\n"
+               "os.unlink(a)\n"
+               "os.remove(a)\n")
+        out = scan("fsops-seam", src)
+        assert lines(out) == [2, 3, 4, 5]
+        assert all("fsops seam" in f.message for f in out)
+
+    def test_flags_write_mode_opens(self):
+        src = ("with open(p, 'wb') as fh:\n    fh.write(b'x')\n"
+               "open(p, mode='a')\n"
+               "open(p, 'r+')\n"
+               "import os\n"
+               "os.fdopen(fd, 'w')\n")
+        assert lines(scan("fsops-seam", src)) == [1, 3, 4, 6]
+
+    def test_nonliteral_mode_is_conservatively_flagged(self):
+        out = scan("fsops-seam", "open(p, mode)\n")
+        assert len(out) == 1
+        assert "<non-literal>" in out[0].message
+
+    def test_read_mode_opens_pass(self):
+        src = ("open(p)\n"
+               "open(p, 'r')\n"
+               "open(p, 'rb')\n"
+               "import os\n"
+               "os.fdopen(fd)\n"
+               "os.fdopen(fd, 'r')\n"
+               "os.stat(p)\n"
+               "os.listdir(d)\n")
+        assert scan("fsops-seam", src) == []
+
+    def test_marker_escape(self):
+        src = ("import os\n"
+               "os.unlink(p)  # lint-ok: fsops-seam: best-effort "
+               "cleanup\n")
+        assert scan("fsops-seam", src) == []
+
+    def test_scope_is_fleet_with_seam_excluded(self):
+        rule = RULES["fsops-seam"]
+        assert rule.applies("fleet/pod.py")
+        assert not rule.applies("fleet/fsops.py")
+        assert not rule.applies("fleet/chaos.py")
+        assert not rule.applies("serve/daemon.py")
+        assert not rule.applies("parallel/checkpoint.py")
+
+    def test_fleet_tree_is_clean_zero_grandfathers(self):
+        fleet = os.path.join(REPO, "scintools_tpu", "fleet")
+        rep = run([fleet])
+        assert [f for f in rep.findings
+                if f.rule == "fsops-seam"] == []
+
+
+# =====================================================================
 # JL101 retrace-hazard
 # =====================================================================
 

@@ -153,6 +153,46 @@ class TestSpoolWatcher:
             w.close()
         assert not w.alive()
 
+    def test_delayed_visibility_admits_late_and_whole(self, tmp_path):
+        """ISSUE 17 satellite: an NFS-style late rename — the file is
+        complete but invisible to the watcher until the rename
+        lands; once revealed it is admitted whole, with the full
+        content hash, never as a partial."""
+        target = tmp_path / "late.epoch"
+        target.write_text("complete payload")
+        hidden = faults.delayed_visibility(target)
+        w = SpoolWatcher(tmp_path, pattern="*.epoch", poll_s=0.02)
+        try:
+            assert w.get(timeout=0.2) is None    # invisible → nothing
+            faults.reveal(hidden)
+            item = w.get(timeout=3.0)
+            assert item is not None and item.epoch == "late.epoch"
+            assert item.sha == content_hash(target.read_bytes())
+        finally:
+            w.close()
+
+    def test_eio_spool_file_retried_not_admitted(self, tmp_path):
+        """ISSUE 17 satellite: a flaky disk under the watcher's
+        content-hash read — the EIO'd file is NOT admitted (no
+        half-hashed arrivals), the failure is surfaced as
+        ``serve.watch_error``, and the same file is retried and
+        admitted cleanly on a later poll once the fault clears."""
+        flaky = tmp_path / "flaky.epoch"
+        flaky.write_text("payload behind a flaky disk")
+        with faults.eio_reads("flaky.epoch", times=1) as faulted:
+            w = SpoolWatcher(tmp_path, pattern="*.epoch", poll_s=0.02)
+            try:
+                item = w.get(timeout=5.0)
+                assert faulted == [str(flaky)]   # the injector fired
+                assert item is not None          # ...and was survived
+                assert item.epoch == "flaky.epoch"
+                assert item.sha == content_hash(flaky.read_bytes())
+                errs = slog.recent(event="serve.watch_error")
+                assert any(e.get("epoch") == "flaky.epoch"
+                           for e in errs)
+            finally:
+                w.close()
+
 
 class TestDaemonQueue:
     """Daemon semantics over the in-process source (no spool, no

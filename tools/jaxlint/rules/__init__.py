@@ -1,8 +1,9 @@
 """Rule plugins. Importing this package registers every rule with the
 framework registry (``framework.RULES``), in catalog order: the four
-ported legacy lints first, the metric-hygiene rule (ISSUE 13), then
-the three analyzers new in ISSUE 8.
+ported legacy lints first, the metric-hygiene rule (ISSUE 13), the
+fsops-seam rule (ISSUE 17), then the three analyzers new in ISSUE 8.
 """
 
 from . import (excepts, import_jit, syncpoints, obs_events,  # noqa: F401
-               metrics_hygiene, retrace, locks, jit_boundary)
+               metrics_hygiene, fsops_seam, retrace, locks,
+               jit_boundary)

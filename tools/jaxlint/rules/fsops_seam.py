@@ -1,0 +1,132 @@
+"""JL006 ``fsops-seam`` — raw filesystem mutation in ``fleet/``
+outside the retrying seam.
+
+ISSUE 17 routed every fleet queue/lease/heartbeat/journal filesystem
+operation through ONE seam (``fleet/fsops.py:FsOps``): bounded
+retry/backoff on transient errors (EIO/ESTALE/ETIMEDOUT/ENOSPC),
+per-op deadlines, chaos injection, the injectable clock, and the
+degraded-park escape hatch. A raw ``os.rename`` / ``os.replace`` /
+open-for-write added anywhere else in ``fleet/`` silently bypasses
+all of that — it neither retries, nor degrades, nor faults under the
+chaos harness, so the byte-identity soak stops covering it. This
+rule makes the seam structural: zero grandfathers.
+
+Flagged, in ``fleet/`` only:
+
+- ``os.rename(...)`` / ``os.replace(...)`` calls — route through
+  ``fs.rename`` / ``fs.replace`` (or ``claim_by_rename(...,
+  fs=...)``);
+- ``open(...)`` / ``os.fdopen(...)`` with a write-capable mode —
+  a string-literal mode containing ``w``/``a``/``x``/``+``, or a
+  NON-literal mode (conservative: an unreadable mode in ``fleet/``
+  is a seam question, not a pass) — route through
+  ``fs.write_bytes`` / ``fs.write_json`` / ``fs.append_text`` /
+  ``fs.open_write`` / ``fs.fdopen``;
+- ``os.unlink`` / ``os.remove`` calls — route through
+  ``fs.unlink`` (lease drops must see the same retry/deadline
+  policy as the renames that created the lease).
+
+Not flagged: read-mode opens (the default ``open(p)`` included),
+everything in ``fleet/fsops.py`` (the seam IS the raw-op site) and
+``fleet/chaos.py`` (the injector tears bytes beneath the seam by
+design — its job is the flagged behavior).
+
+Escape hatch: ``# lint-ok: fsops-seam: <reason>`` — for ops that
+must deliberately bypass retry/injection; the reason should say why
+a fault there cannot lose queue state.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Rule, register
+
+#: os.<attr> calls that mutate directory entries
+_OS_MUTATORS = {"rename", "replace", "unlink", "remove"}
+#: characters in an ``open`` mode string that make it write-capable
+_WRITE_CHARS = set("wax+")
+
+
+def _os_attr(func):
+    """``os.<attr>`` attribute callee → attr name, else None."""
+    if isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id == "os":
+        return func.attr
+    return None
+
+
+def _mode_arg(call, pos):
+    """The ``mode`` argument of an open-like call: positional index
+    ``pos`` or the ``mode=`` keyword; None when absent."""
+    if len(call.args) > pos:
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            return kw.value
+    return None
+
+
+def _mode_verdict(mode):
+    """(is_write, shown) for one mode argument: a missing mode is
+    read-only, a literal decides by its characters, anything else is
+    conservatively write-capable."""
+    if mode is None:
+        return False, "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(_WRITE_CHARS & set(mode.value)), repr(mode.value)
+    return True, "<non-literal>"
+
+
+@register
+class FsopsSeamRule(Rule):
+    id = "JL006"
+    name = "fsops-seam"
+    short = ("raw filesystem mutation in fleet/ bypassing the "
+             "retrying fsops seam")
+    scope = ("fleet/",)
+    # the seam itself and the fault injector beneath it are the only
+    # legitimate raw-op sites
+    exclude = ("fleet/fsops.py", "fleet/chaos.py")
+
+    def check(self, ctx, config):
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _os_attr(node.func)
+            if attr in _OS_MUTATORS:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"`os.{attr}()` in fleet/ bypasses the fsops "
+                    f"seam (no retry/backoff, no chaos injection, "
+                    f"no degraded-park) — use `fs.{attr}()` "
+                    "(fleet/fsops.py) or mark `# lint-ok: "
+                    "fsops-seam: <why a fault here is safe>`",
+                    data={"call": f"os.{attr}"})
+                continue
+            if attr == "fdopen":
+                is_write, shown = _mode_verdict(_mode_arg(node, 1))
+                if is_write:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"`os.fdopen(..., {shown})` opens for write "
+                        "in fleet/ outside the fsops seam — use "
+                        "`fs.fdopen()` so the write path retries "
+                        "and faults under chaos, or mark "
+                        "`# lint-ok: fsops-seam: <reason>`",
+                        data={"call": "os.fdopen", "mode": shown})
+                continue
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id == "open":
+                is_write, shown = _mode_verdict(_mode_arg(node, 1))
+                if is_write:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"`open(..., {shown})` opens for write in "
+                        "fleet/ outside the fsops seam — use "
+                        "`fs.write_bytes`/`fs.write_json`/"
+                        "`fs.append_text`/`fs.open_write` "
+                        "(fleet/fsops.py), or mark `# lint-ok: "
+                        "fsops-seam: <reason>`",
+                        data={"call": "open", "mode": shown})
