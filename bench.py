@@ -2485,6 +2485,193 @@ def bench_arc_detect(jax, jnp):
     }
 
 
+def bench_zoom_fft(jax, jnp):
+    """Config #24 (ISSUE 18): the zoom-FFT formulation family
+    (ops/xfft.py ``zoom_power_program``/``offgrid_program``,
+    detect/refine.py — docs/performance.md "Zoom-FFT formulation
+    family") — band-limited transforms that compute only the pixels
+    a consumer reads.
+
+    Four measurements:
+
+    1. **zoomed sspec band** — a 16×-denser Doppler–delay band inside
+       the arc region, computed as the band-only chirp-Z program vs
+       the dense lowering that materialises the 16×-padded frame and
+       crops the same pixels. compile/steady split; the steady calls
+       re-plan per call, vary the (traced) band edges AND the input
+       buffers, and run under ``retrace_guard`` — zero rebuilds is
+       part of the measurement. In-bench parity: the czt band is
+       rtol-pinned against the dense padded-crop oracle. Gate: ≥3×.
+    2. **detect sub-grid η refinement** — ``refine_eta`` (zoom the
+       conjugate spectrum around the hit, rescore a 16×-per-step
+       denser local η grid) vs buying the same η resolution by BANK
+       WIDENING (a 16×-denser 768-template bank through the same
+       correlation program). Per-trigger steady time, refinement
+       under ``retrace_guard`` on ``detect.refine`` + ``xfft.zoom``.
+       Gate: ≥4×.
+    3. **formulation tables** — ``measure_formulation`` for the new
+       ``xfft.zoom`` (czt|dense) and ``xfft.offgrid`` (taylor|dense)
+       ops on this host, and a re-stamp of ``detect.correlate``
+       (half|dense). The measured winners+timings ride in the record;
+       the installed override is CLEARED after measuring so the
+       REGISTERED defaults stay active (performance.md: every TPU
+       column remains the registered default, unverified on
+       hardware).
+    """
+    from scintools_tpu.backend import (formulation, measure_formulation,
+                                       set_formulation)
+    from scintools_tpu.detect.bank import build_bank
+    from scintools_tpu.detect.correlate import correlate_program
+    from scintools_tpu.detect.refine import refine_eta
+    from scintools_tpu.obs import retrace
+    from scintools_tpu.ops import xfft
+    from scintools_tpu.ops.sspec import fft_shapes
+
+    full = jax.default_backend() != "cpu"
+    rng = np.random.default_rng(31)
+
+    # ---- 1. zoomed sspec band vs dense 16×-padded-crop ---------------
+    nf, nt = 64, 128
+    B = 8 if full else 4
+    z = 16
+    nrfft, ncfft = fft_shapes(nf, nt)           # (128, 256)
+    n_r, n_c = 128, 256                         # 8 × 16 native bins,
+    r0, c0 = 0.0, -8.0                          # 16× denser each axis
+    band_r = (r0, r0 + n_r / z)
+    band_c = (c0, c0 + n_c / z)
+    stacks = [rng.standard_normal((B, nf, nt)).astype(np.float32)
+              for _ in range(4)]
+    dev = [jnp.asarray(s) for s in stacks]
+
+    def zoom_run(d, dr0, dc0):
+        # per-call re-plan + traced band edges: the keyed cache must
+        # serve one compiled program for EVERY band at this geometry
+        fn = xfft.zoom_power_program(nf, nt, (nrfft, ncfft), n_r, n_c)
+        return np.asarray(fn(
+            d, jnp.asarray([band_r[0] + dr0, band_r[1] + dr0],
+                           dtype=jnp.float32),
+            jnp.asarray([band_c[0] + dc0, band_c[1] + dc0],
+                        dtype=jnp.float32)))
+
+    t0 = time.perf_counter()
+    got_zoom = zoom_run(dev[0], 0.0, 0.0)
+    compile_zoom_s = time.perf_counter() - t0
+    with retrace.retrace_guard(sites=("xfft.zoom",)):
+        steady_zoom = _time_variants(
+            zoom_run, [(d, 0.125 * (i + 1), -0.25 * (i + 1))
+                       for i, d in enumerate(dev[1:])], repeats=3)
+
+    rows = (int(round(r0 * z)) + np.arange(n_r)) % (z * nrfft)
+    cols = (int(round(c0 * z)) + np.arange(n_c)) % (z * ncfft)
+
+    @jax.jit
+    def dense_crop(d):
+        F = jnp.fft.fft2(d, s=(z * nrfft, z * ncfft))
+        Fb = F[:, jnp.asarray(rows)][:, :, jnp.asarray(cols)]
+        return jnp.real(Fb * jnp.conj(Fb))
+
+    t0 = time.perf_counter()
+    got_dense = np.asarray(dense_crop(dev[0]))
+    compile_dense_s = time.perf_counter() - t0
+    steady_dense = _time_variants(
+        lambda d: np.asarray(dense_crop(d)), [(d,) for d in dev[1:]],
+        repeats=3)
+    # in-bench parity: the czt band IS the 16×-padded frame's crop
+    rel = np.max(np.abs(got_zoom - got_dense)) / np.max(got_dense)
+    speedup_zoom = steady_dense / steady_zoom
+
+    # ---- 2. refine_eta vs 16×-widened bank ---------------------------
+    dns, dnf = 128, 64                          # detect epoch geometry
+    ddt, dfreq, ddlam = 30.0, 1400.0, 0.05
+    ddf = dfreq * ddlam / (dnf - 1)
+    K, widen = 48, 16
+    bank = build_bank(dnf, dns, ddt, ddf, 1e-3, 3e-2, n_templates=K)
+    epochs = [rng.standard_normal((dnf, dns)).astype(np.float32)
+              for _ in range(4)]
+    seeds = [float(bank.etas[i]) for i in (20, 24, 28, 32)]
+    refine_eta(epochs[0], bank, seeds[0])       # warm
+    with retrace.retrace_guard(sites=("detect.refine", "xfft.zoom")):
+        steady_refine = _time_variants(
+            lambda d, s: refine_eta(d, bank, s),
+            list(zip(epochs[1:], seeds[1:])), repeats=3)
+
+    wide = build_bank(dnf, dns, ddt, ddf, 1e-3, 3e-2,
+                      n_templates=K * widen)
+    cfn = correlate_program(dnf, dns, 1, K * widen)
+
+    def wide_scan(d):
+        s, ok = cfn(d[None], wide.templates, wide.valid)
+        return np.asarray(s)
+
+    wide_scan(epochs[0])                        # warm
+    steady_wide = _time_variants(
+        wide_scan, [(d,) for d in epochs[1:]], repeats=3)
+    speedup_refine = steady_wide / steady_refine
+
+    # ---- 3. measured formulation tables (cleared after) --------------
+    pts = jnp.asarray(rng.uniform(-nf / 2, nf / 2, 256)
+                      .astype(np.float32))
+    og_x = jnp.asarray(rng.standard_normal((B, 512))
+                       .astype(np.float32))
+    tables = {}
+    measure = {
+        "xfft.zoom": {
+            v: (lambda _v=v: np.asarray(
+                xfft.zoom_power_program(
+                    nf, nt, (nrfft, ncfft), n_r, n_c, variant=_v)(
+                    dev[0], jnp.asarray(band_r, dtype=jnp.float32),
+                    jnp.asarray(band_c, dtype=jnp.float32))))
+            for v in ("czt", "dense")},
+        "xfft.offgrid": {
+            v: (lambda _v=v: np.asarray(
+                xfft.offgrid_program(512, 256, variant=_v)(og_x, pts)))
+            for v in ("taylor", "dense")},
+        "detect.correlate": {
+            v: (lambda _v=v: np.asarray(
+                correlate_program(dnf, dns, 1, K, variant=_v)(
+                    epochs[0][None], bank.templates, bank.valid)[0]))
+            for v in ("half", "dense")},
+    }
+    for op, candidates in measure.items():
+        registered = formulation(op)
+        winner, timings = measure_formulation(op, candidates)
+        set_formulation(op, None)               # registered default
+        tables[op] = {                          # stays active
+            "winner_measured": winner,
+            "registered_default": registered,
+            "timings_s": {k: round(v, 5) for k, v in timings.items()},
+        }
+
+    return {
+        "zoom": {
+            "shape": f"{B}x{nf}x{nt}", "zoom_factor": z,
+            "band_pixels": f"{n_r}x{n_c}",
+            "padded_frame": f"{z * nrfft}x{z * ncfft}",
+            "compile_zoom_s": round(compile_zoom_s, 3),
+            "compile_dense_s": round(compile_dense_s, 3),
+            "steady_zoom_s": round(steady_zoom, 4),
+            "steady_dense_crop_s": round(steady_dense, 4),
+            "speedup_zoom_vs_dense_crop": round(speedup_zoom, 1),
+            "speedup_gate_3x_ok": bool(speedup_zoom >= 3.0),
+            "parity_rel_err": float(rel),
+            "parity_ok": bool(rel < 2e-4),
+            "steady_retraces": 0,               # retrace_guard raised
+        },                                      # otherwise
+        "refine": {
+            "epoch": f"{dnf}x{dns}", "bank_templates": K,
+            "widened_templates": K * widen,
+            "steady_refine_s": round(steady_refine, 4),
+            "steady_widened_bank_s": round(steady_wide, 4),
+            "speedup_refine_vs_widened": round(speedup_refine, 1),
+            "speedup_gate_4x_ok": bool(speedup_refine >= 4.0),
+            "steady_retraces": 0,
+        },
+        "formulations_measured": tables,
+        "refinement_quality_gate": "tests/test_detect.py::"
+                                   "TestSubGridRefinement",
+    }
+
+
 def bench_fft_layer(jax, jnp):
     """Config #18 (ISSUE 12): the structure-aware transform layer
     (ops/xfft.py) — dense vs declared formulations for the two newly
@@ -2814,6 +3001,7 @@ _EST_S = {
     "scatim":        {"acc": 60,  "cpu": 60},
     "fft_layer":     {"acc": 60,  "cpu": 60},
     "arc_detect":    {"acc": 120, "cpu": 120},
+    "zoom_fft":      {"acc": 90,  "cpu": 90},
     "mcmc_batch":    {"acc": 90,  "cpu": 60},
 }
 
@@ -2956,6 +3144,7 @@ def main():
         ("scatim", bench_scattered_image),
         ("fft_layer", bench_fft_layer),
         ("arc_detect", bench_arc_detect),
+        ("zoom_fft", bench_zoom_fft),
         ("mcmc_batch", bench_mcmc_batch),
     ]
     # The tunneled TPU can WEDGE mid-run (observed live: after a

@@ -18,6 +18,13 @@ scintillation-arc analog, run ONLINE inside the serve daemon:
   (real-input forward, halved-row crop folded — ``detect.correlate``
   formulation, dense oracle kept) and matched against the WHOLE bank
   as one batched FFT + matmul program;
+- :mod:`~scintools_tpu.detect.refine` — sub-grid η refinement
+  (ISSUE 18): on a trigger, the conjugate spectrum is band-limited
+  to the hit template's (f_D, τ) region through the shared
+  ``xfft.zoom`` chirp-Z lowering and rescored on a ~16× denser
+  LOCAL η grid as one cached program (``detect.refine``) — looking
+  harder where the hit is instead of widening the device-resident
+  bank; the refined η seeds the θ-θ confirmation window;
 - :mod:`~scintools_tpu.detect.trigger` — peak extraction with
   per-template noise-floor normalisation, a significance threshold,
   the guards-pattern per-lane health mask, and the θ-θ confirmation
@@ -35,5 +42,7 @@ from .bank import TemplateBank, build_bank, eta_grid  # noqa: F401
 from .correlate import (correlate_bank, correlate_program,  # noqa: F401
                         extract_blocks, time_blocks)
 from .online import ArcDetector  # noqa: F401
+from .refine import (refine_band, refine_eta,  # noqa: F401
+                     refine_program, refine_window)
 from .trigger import (calibrate_noise_floor, confirm_eta,  # noqa: F401
                       extract_triggers, trigger_program)

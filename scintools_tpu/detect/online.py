@@ -36,6 +36,7 @@ from ..obs import metrics as _metrics
 from ..utils import slog
 from .bank import DEFAULT_N_TEMPLATES, build_bank
 from .correlate import correlate_bank, extract_blocks
+from .refine import DEFAULT_N_ETA, refine_eta
 from .trigger import (calibrate_noise_floor, confirm_eta,
                       extract_triggers)
 
@@ -60,8 +61,11 @@ class ArcDetector:
                  n_templates=DEFAULT_N_TEMPLATES, threshold=None,
                  score_min=None, variant=None, window="hanning",
                  window_frac=0.1, confirm=True, confirm_window=2.25,
-                 confirm_n_eta=31, confirm_npad=1, confirm_fw=0.2,
-                 confirm_edges=96, f0=1400.0, hop=None,
+                 confirm_window_refined=1.8, confirm_n_eta=31,
+                 confirm_npad=1, confirm_fw=0.2,
+                 confirm_edges=96, refine=True,
+                 refine_n_eta=DEFAULT_N_ETA, refine_span=None,
+                 refine_variant=None, f0=1400.0, hop=None,
                  cal_frames=None, cal_seed=0):
         self.nf, self.nt = int(nf), int(nt)
         self.dt, self.df = float(dt), float(df)
@@ -73,10 +77,27 @@ class ArcDetector:
         self.window_frac = float(window_frac)
         self.confirm = bool(confirm)
         self.confirm_window = float(confirm_window)
+        # a SUB-GRID refined seed deserves a tighter θ-θ window than
+        # the bank-grid 2.25×: 1.8× covers the refined-η error
+        # distribution (median ~0.11 on the factory recall set) while
+        # keeping the 2η harmonic OUTSIDE the searched grid whenever
+        # the refined seed is within ~10 % of truth — the PR-14 "~2×
+        # bias near the harmonic" fix (tests/test_detect.py pins the
+        # live harmonic-capture epoch re-confirming near truth).
+        self.confirm_window_refined = float(confirm_window_refined)
         self.confirm_n_eta = int(confirm_n_eta)
         self.confirm_npad = int(confirm_npad)
         self.confirm_fw = float(confirm_fw)
         self.confirm_edges = int(confirm_edges)
+        # sub-grid η refinement between trigger and θ-θ confirm
+        # (detect/refine.py): zoom the conjugate spectrum around the
+        # hit instead of widening the bank; the refined η seeds the
+        # confirmation window. refine_variant routes 'xfft.zoom'
+        # (czt|dense).
+        self.refine = bool(refine)
+        self.refine_n_eta = int(refine_n_eta)
+        self.refine_span = refine_span
+        self.refine_variant = refine_variant
         self.hop = hop
         self.bank = build_bank(self.nf, self.nt, self.dt, self.df,
                                self.eta_range[0], self.eta_range[1],
@@ -100,6 +121,15 @@ class ArcDetector:
         ``/readyz`` covers detection too."""
         blank = np.zeros((self.nf, self.nt), dtype=np.float32)
         self.examine("<warmup>", blank, _quiet=True)
+        if self.refine:
+            eta_mid = float(np.sqrt(self.eta_range[0]
+                                    * self.eta_range[1]))
+            refine_eta(blank, self.bank, eta_mid,
+                       n_eta=self.refine_n_eta,
+                       span=self.refine_span,
+                       variant=self.refine_variant,
+                       window=self.window,
+                       window_frac=self.window_frac)
         if self.confirm:
             eta_mid = float(np.sqrt(self.eta_range[0]
                                     * self.eta_range[1]))
@@ -140,7 +170,7 @@ class ArcDetector:
         best = lanes[bi]
         rec = dict(best, n_blocks=len(lanes),
                    triggered=bool(best["hit"]), confirmed=False,
-                   eta=None, eta_sig=None)
+                   eta=None, eta_sig=None, eta_refined=None)
         del rec["hit"]
         _metrics.counter(
             "detect_epochs_scanned_total",
@@ -165,6 +195,8 @@ class ArcDetector:
                                z=round(rec["z"], 2),
                                score=round(rec["score"], 2),
                                n_blocks=rec["n_blocks"])
+            if self.refine:
+                self._refine(epoch_id, blocks[bi], rec, _quiet)
             if self.confirm:
                 self._confirm(epoch_id, blocks[bi], rec, _quiet)
         _metrics.histogram(
@@ -188,7 +220,8 @@ class ArcDetector:
         out = {}
         for epoch_id, lane, dyn in zip(epoch_ids, lanes, dyns):
             rec = dict(lane, n_blocks=1, triggered=bool(lane["hit"]),
-                       confirmed=False, eta=None, eta_sig=None)
+                       confirmed=False, eta=None, eta_sig=None,
+                       eta_refined=None)
             del rec["hit"]
             _metrics.counter(
                 "detect_epochs_scanned_total",
@@ -214,6 +247,8 @@ class ArcDetector:
                                    z=round(rec["z"], 2),
                                    score=round(rec["score"], 2),
                                    n_blocks=1)
+                if self.refine:
+                    self._refine(epoch_id, dyn, rec, _quiet)
                 if self.confirm:
                     self._confirm(epoch_id, dyn, rec, _quiet)
             out[str(epoch_id)] = rec
@@ -223,17 +258,58 @@ class ArcDetector:
         ).observe(time.perf_counter() - t0)
         return out
 
-    def _confirm(self, epoch_id, frame, rec, _quiet):
-        """θ-θ confirmation of a hit, on the best block's frame."""
+    def _refine(self, epoch_id, frame, rec, _quiet):
+        """Sub-grid η refinement of a hit (detect/refine.py): rescore
+        the best block on a ~16× denser LOCAL η grid through the
+        zoomed conjugate spectrum. Advisory like the θ-θ stage — a
+        failed refinement leaves ``eta_refined`` None and the
+        confirmation seeds from the bank η."""
         frame = np.asarray(frame)
         try:
+            res = refine_eta(frame, self.bank, rec["eta_bank"],
+                             n_eta=self.refine_n_eta,
+                             span=self.refine_span,
+                             variant=self.refine_variant,
+                             window=self.window,
+                             window_frac=self.window_frac)
+        except Exception as e:  # noqa: BLE001 — refinement is
+            # advisory: a crashed zoom rescoring must not take the
+            # daemon loop down; confirm falls back to the bank η
+            slog.log_failure("detect.error", stage="refine",
+                             error=e, epoch=str(epoch_id))
+            return
+        rec["eta_refined"] = float(res["eta_refined"])
+        rec["refine_score"] = float(res["score"])
+        _metrics.counter(
+            "detect_refined_total",
+            help="bank hits rescored on the zoomed sub-grid η "
+                 "stage").inc()
+        if not _quiet:
+            slog.log_event("detect.refine", epoch=str(epoch_id),
+                           eta_refined=rec["eta_refined"],
+                           eta_bank=rec["eta_bank"],
+                           score=round(rec["refine_score"], 2))
+
+    def _confirm(self, epoch_id, frame, rec, _quiet):
+        """θ-θ confirmation of a hit, on the best block's frame.
+        Seeds the pruned η window from the SUB-GRID refined η when
+        the refinement stage produced one (the bank-grid seed is ~2×
+        biased near the 2η harmonic — detect/trigger.py:confirm_eta);
+        the θ-edge sizing stays pinned to the discrete bank η so the
+        geometry-keyed θ-θ program cache stays bounded."""
+        frame = np.asarray(frame)
+        seed = rec.get("eta_refined") or rec["eta_bank"]
+        window = self.confirm_window_refined \
+            if rec.get("eta_refined") else self.confirm_window
+        try:
             res = confirm_eta(frame, self._freqs, self._times,
-                              rec["eta_bank"],
-                              window=self.confirm_window,
+                              seed,
+                              window=window,
                               n_eta=self.confirm_n_eta,
                               npad=self.confirm_npad,
                               fw=self.confirm_fw,
-                              n_edges=self.confirm_edges)
+                              n_edges=self.confirm_edges,
+                              eta_edges=rec["eta_bank"])
         except Exception as e:  # noqa: BLE001 — confirmation is
             # advisory: a crashed θ-θ stage must not take the daemon
             # loop down; the hit stays unconfirmed and is surfaced
@@ -244,8 +320,8 @@ class ArcDetector:
         # eigen curve still rising at the grid edge — e.g. the 2η
         # harmonic just beyond it), not a measurement: refuse, leave
         # the trigger standing as a follow-up candidate
-        lo = rec["eta_bank"] / self.confirm_window
-        hi = rec["eta_bank"] * self.confirm_window
+        lo = seed / window
+        hi = seed * window
         in_window = (res.healthy and np.isfinite(res.eta)
                      and lo <= res.eta <= hi)
         if in_window:
@@ -259,7 +335,8 @@ class ArcDetector:
                                epoch=str(epoch_id),
                                eta=float(res.eta),
                                eta_sig=float(res.eta_sig),
-                               eta_bank=rec["eta_bank"])
+                               eta_bank=rec["eta_bank"],
+                               eta_refined=rec.get("eta_refined"))
         else:
             rec.update(confirmed=False, eta=None, eta_sig=None,
                        confirm_ok=int(res.ok))
@@ -377,4 +454,9 @@ class ArcDetector:
             "variant": self.variant,
             "confirm": self.confirm,
             "confirm_window": self.confirm_window,
+            "confirm_window_refined": self.confirm_window_refined,
+            "refine": self.refine,
+            "refine_n_eta": self.refine_n_eta,
+            "refine_span": self.refine_span,
+            "refine_variant": self.refine_variant,
         }

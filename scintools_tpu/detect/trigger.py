@@ -169,22 +169,33 @@ def extract_triggers(scores, ok, etas, *, noise_floor=None,
     return out
 
 
-def confirm_eta(dyn, freqs, times, eta_bank, *, window=2.5,
+def confirm_eta(dyn, freqs, times, eta_seed, *, window=2.5,
                 n_eta=31, npad=1, n_edges=96, fw=0.2,
-                backend="jax"):
+                backend="jax", eta_edges=None):
     """High-precision confirmation of one bank hit: a θ-θ eigenvalue
     search (thth/search.py:single_search — the ``fit_thetatheta``
-    engine) over the PRUNED η window ``[η_bank/window,
-    η_bank·window]``.
+    engine) over the PRUNED η window ``[η_seed/window,
+    η_seed·window]``.
 
-    The θ edges are sized for the pruned window's largest curvature
+    ``eta_seed`` centres the η search window. Seed with the
+    SUB-GRID refined η (detect/refine.py) when available: windows
+    sized from the bank-grid η are ~2× biased near the 2η harmonic —
+    an off-centre window whose upper edge grazes 2η lets the
+    harmonic's rising eigen curve drag the parabola vertex
+    (regression-pinned in tests/test_detect.py); a refined-centred
+    window starts tight on truth.
+
+    The θ edges are sized for the window's largest curvature
     (``η·θ² < τ_max`` and ``|θ| < f_D,max/2`` — the
     ``thth.search.chunk_geometry`` rule): sizing them for the whole
     BANK range instead measurably biases the peak (the θ-θ map then
-    under-resolves small-η arcs). Distinct bank templates therefore
-    compile distinct (geometry-keyed, cached) θ-θ programs — bounded
-    by the bank size, and in steady state a source's hits cluster on
-    one template and reuse one program.
+    under-resolves small-η arcs). ``eta_edges`` (default: the seed)
+    pins the edge sizing to a DISCRETE η — pass the hit's bank
+    template η when seeding with a continuous refined value, so the
+    geometry-keyed θ-θ program cache stays bounded by the bank size
+    (the η grid itself is traced and free to move per hit; in steady
+    state a source's hits cluster on one template and reuse one
+    program).
 
     Returns the :class:`~scintools_tpu.thth.search.ChunkSearchResult`
     — its ``eta``/``eta_sig`` are the confirmed measurement, its
@@ -199,11 +210,13 @@ def confirm_eta(dyn, freqs, times, eta_bank, *, window=2.5,
 
     freqs = np.asarray(freqs, dtype=float)
     times = np.asarray(times, dtype=float)
-    etas = np.geomspace(float(eta_bank) / window,
-                        float(eta_bank) * window, int(n_eta))
+    etas = np.geomspace(float(eta_seed) / window,
+                        float(eta_seed) * window, int(n_eta))
     fd = fft_axis(times, pad=npad, scale=1e3)
     tau = fft_axis(freqs, pad=npad, scale=1.0)
-    th_lim = 0.95 * min(np.sqrt(tau.max() / etas.max()),
+    eta_edge_max = float(eta_edges) * window \
+        if eta_edges is not None else etas.max()
+    th_lim = 0.95 * min(np.sqrt(tau.max() / eta_edge_max),
                         fd.max() / 2)
     edges = np.linspace(-th_lim, th_lim, int(n_edges))
     return single_search(np.asarray(dyn), freqs, times, etas, edges,

@@ -160,11 +160,17 @@ def scint_acf_model_2d_values(params, shape, backend=None):
 # --------------------------------------------------------------------------
 
 def _sspec_1d(model, xdata, xp):
+    """Mirrored-profile spectrum. The mirrored length-(2L−1) profile
+    is real, so ``real(fft(·))[:L]`` is exactly the rfft half
+    spectrum — routed through the declared 'xfft.profile' lowering
+    (real half transform vs the retired inline full-complex fft;
+    bit-parity pinned in tests/test_xfft.py)."""
+    from ..ops.xfft import real_spectrum_1d
+
     model = model * (1 - xdata / xp.max(xdata))
     flipped = model[::-1]
     model = xp.concatenate((model, flipped))[: 2 * len(xdata) - 1]
-    model = xp.real(xp.fft.fft(model))[: len(xdata)]
-    return model
+    return real_spectrum_1d(model, len(xdata), xp=xp)
 
 
 def tau_sspec_model(params, xdata, ydata, backend=None):

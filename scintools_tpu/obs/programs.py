@@ -73,6 +73,7 @@ _LOADED = False
 PROBE_MODULES = (
     "scintools_tpu.detect.bank",
     "scintools_tpu.detect.correlate",
+    "scintools_tpu.detect.refine",
     "scintools_tpu.detect.trigger",
     "scintools_tpu.ops.normsspec",
     "scintools_tpu.ops.fitarc_device",

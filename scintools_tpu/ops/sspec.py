@@ -55,8 +55,25 @@ def _prewhite_diff(dyn):
     return (dyn[1:, 1:] - dyn[1:, :-1] - dyn[:-1, 1:] + dyn[:-1, :-1])
 
 
+def zoom_band(nf, nt, dt, df, tdel_band, fdop_band, n_tdel, n_fdop):
+    """Convert a physical sspec window into the ``zoom=`` band pair:
+    ``tdel_band`` (µs) and ``fdop_band`` (mHz, signed) become
+    ``((r0, r1, n_tdel), (c0, c1, n_fdop))`` in the (fractional,
+    signed) FFT-bin units of the padded frame that
+    :func:`secondary_spectrum_power` and the xfft zoom programs take
+    (tdel = td/(nrfft·df) → td = tdel·nrfft·df; fdop = fd·1e3/(ncfft·dt)
+    → fd = fdop·ncfft·dt/1e3, :func:`sspec_axes` inverted)."""
+    nrfft, ncfft = fft_shapes(nf, nt)
+    r = (float(tdel_band[0]) * nrfft * df,
+         float(tdel_band[1]) * nrfft * df, int(n_tdel))
+    c = (float(fdop_band[0]) * ncfft * dt / 1e3,
+         float(fdop_band[1]) * ncfft * dt / 1e3, int(n_fdop))
+    return r, c
+
+
 def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
-                             halve=True, backend=None, variant=None):
+                             halve=True, backend=None, variant=None,
+                             zoom=None):
     """Linear-power secondary spectrum of ``dyn[nf, nt]``.
 
     window_arrays: optional (chan_window[nt], subint_window[nf]) from
@@ -70,6 +87,18 @@ def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
     of the spectrum is ever computed (ops/xfft.py); ``'dense'`` is
     the full complex-fft2 oracle (parity rtol-pinned in
     tests/test_xfft.py).
+
+    ``zoom`` — an optional ``(band_rows, band_cols)`` pair of
+    ``(f0, f1, n_out)`` triples in (fractional, signed) bin units of
+    the padded frame (:func:`zoom_band` converts physical µs/mHz
+    windows): the transform computes ONLY those band pixels, at any
+    output density, through the band-limited 'xfft.zoom' lowering
+    (Bluestein chirp-Z; 'dense' = the DFT-matmul oracle). Low-η /
+    wide-arc regimes get full Doppler–delay resolution inside the
+    arc region at a fraction of the frame FLOPs. The returned array
+    runs f0→f1 per axis (the band is its own layout — no fftshift,
+    and ``halve``/``prewhite`` don't apply). ``variant`` then means
+    czt|dense.
     """
     backend = resolve_backend(backend)
     xp = get_xp(backend)
@@ -77,10 +106,19 @@ def secondary_spectrum_power(dyn, window_arrays=None, prewhite=False,
     nf, nt = dyn.shape
     nrfft, ncfft = fft_shapes(nf, nt)
 
+    if zoom is not None and prewhite:
+        raise RuntimeError("prewhite post-darkening is defined on the "
+                           "native frame — not with zoom=")
+
     dyn = dyn - xp.mean(dyn)
     if window_arrays is not None:
         dyn = apply_window(dyn, window_arrays[0], window_arrays[1], xp)
     dyn = dyn - xp.mean(dyn)
+
+    if zoom is not None:
+        p = xfft.plan((nf, nt), (nrfft, ncfft), real_input=True,
+                      band=zoom, op="xfft.zoom")
+        return p.power(dyn, xp=xp, variant=variant)
 
     if prewhite:
         if not halve:

@@ -76,6 +76,26 @@ register_formulation(
     doc="sspec→ACF forward transform: real-input rfft2 + Hermitian "
         "completion vs the complex fft2 oracle")
 
+register_formulation(
+    "xfft.zoom", default="czt", choices=("czt", "dense"),
+    doc="band-limited (zoom) DFT: Bluestein chirp-Z — pre-chirp ⊙ → "
+        "one FFT-sized convolution → post-chirp, output grid fully "
+        "decoupled from the input grid, O((M+N)·log) per row at any "
+        "zoom factor — vs the dense plane-wave DFT matmul oracle")
+
+register_formulation(
+    "xfft.offgrid", default="taylor", choices=("taylor", "dense"),
+    doc="off-grid (scattered-point) DFT: on-grid oversampled FFT + "
+        "k-term Taylor derivative expansion from the nearest bin "
+        "(arXiv:physics/0610057) vs the dense point-DFT matmul oracle")
+
+register_formulation(
+    "xfft.profile", default="real", choices=("real", "dense"),
+    doc="1-D profile spectrum real(fft(x))[:keep] of a real profile "
+        "(the sspec 1-D fit models): rfft half spectrum (the "
+        "discarded imaginary/negative half never computed) vs the "
+        "full complex fft oracle")
+
 
 def _is_real(x):
     """Declared-structure guard: True when ``x`` carries a real dtype
@@ -258,6 +278,180 @@ def halfrow_power(x, pad_to, *, xp=np):
 
 
 # ---------------------------------------------------------------------
+# band-limited (zoom) and off-grid transforms — the chirp-Z /
+# Taylor-interpolation formulation family (ROADMAP item 4)
+# ---------------------------------------------------------------------
+
+def czt_fft_length(M, N):
+    """Static ``(fft_len, N)`` pair for :func:`czt_1d`: the smallest
+    power-of-two convolution length ≥ M+N−1."""
+    L = 1
+    while L < M + N - 1:
+        L *= 2
+    return (L, N)
+
+
+def czt_1d(u, a, phi0, L, xp=np):
+    """Bluestein chirp-Z evaluation of ``X[n] = Σ_m u[..., m] ·
+    exp(-i·(a·m·n + phi0·n))`` for n = 0..N-1 over the last axis,
+    with TRACED chirp rate ``a`` and per-output phase ``phi0``
+    (static shapes only: M = u.shape[-1] and N are baked via the
+    precomputed FFT length ``L`` ≥ M+N-1, :func:`czt_fft_length`).
+
+    m·n = (m² + n² − (n−m)²)/2 turns the sum into a convolution of
+    ``u·e^{-i·a·m²/2}`` with the conjugate chirp, done with
+    zero-padded FFTs — O((M+N)·log) per output row instead of the
+    O(M·N) plane-wave GEMM. This is the ONE chirp implementation in
+    the codebase: the zoom lowerings here and the acf2d
+    ``fresnel_method='czt'`` rows (sim/acf_model.py) both ride it."""
+    M = u.shape[-1]
+    N = L[1]
+    Lf = L[0]
+    m = xp.arange(M)
+    n = xp.arange(N)
+    k = xp.arange(-(M - 1), N)                 # conv kernel support
+    wm = xp.exp(-0.5j * a * m ** 2)
+    wn = xp.exp(-0.5j * a * n ** 2 - 1j * phi0 * n)
+    v = xp.exp(0.5j * a * k ** 2)              # conjugate chirp
+    uf = xp.fft.fft(u * wm, n=Lf, axis=-1)
+    vf = xp.fft.fft(v, n=Lf)
+    conv = xp.fft.ifft(uf * vf, axis=-1)
+    # conv index k0 + n with k0 = M-1 aligns (n-m) = k
+    return conv[..., M - 1:M - 1 + N] * wn
+
+
+def zoom_dft_1d(x, n_grid, f0, df, n_out, *, xp=np, variant=None,
+                fft_len=None):
+    """Band-limited DFT over the last axis: ``X[j] = Σ_m x[..., m] ·
+    exp(-2πi·m·(f0 + j·df)/n_grid)`` for j = 0..n_out-1.
+
+    ``f0``/``df`` are in (fractional) FFT-bin units of an
+    ``n_grid``-point transform and may be TRACED — the output band is
+    fully decoupled from the input grid, so one compiled program
+    serves any band at a given geometry. Integer ``f0``/``df=1``
+    reproduce the corresponding ``fft(x, n=n_grid)`` bins exactly;
+    ``df=1/z`` samples the z×-padded grid without ever building it.
+    Negative/aliased frequencies are fine (m is integer, so the
+    kernel is N-periodic in f).
+
+    ``variant='czt'`` lowers to :func:`czt_1d` with the band start
+    folded into the pre-chirp (pre ⊙ → one FFT-length convolution →
+    post-chirp); ``'dense'`` is the plane-wave DFT matmul oracle
+    (exact for arbitrary fractional bands, O(M·n_out))."""
+    if variant is None:
+        variant = formulation("xfft.zoom")
+    M = x.shape[-1]
+    w = 2.0 * np.pi / n_grid
+    m = xp.arange(M)
+    if variant == "czt":
+        if fft_len is None:
+            fft_len = czt_fft_length(M, n_out)
+        pre = xp.exp(-1j * w * f0 * m)
+        return czt_1d(x * pre, w * df, 0.0, fft_len, xp)
+    freqs = f0 + df * xp.arange(n_out)
+    E = xp.exp(-1j * w * m[:, None] * freqs[None, :])
+    return x @ E
+
+
+def zoom_power_2d(x, pad_to, band_r, band_c, *, xp=np, variant=None):
+    """Band-limited 2-D spectral power of ``x`` over the trailing
+    axes: ``out[..., j1, j2] = |F(r0 + j1·dr, c0 + j2·dc)|²`` where F
+    is the DFT on the ``pad_to = (N1, N2)`` grid and each band is a
+    ``(f0, f1, n_out)`` triple in (fractional, signed) bin units of
+    its axis — samples at ``f0 + j·(f1-f0)/n_out`` (endpoint-
+    exclusive, like fft bins). Band edges may be traced; ``n_out``
+    must be static.
+
+    Only the n_out_r × n_out_c band pixels are ever computed: the
+    row-axis zoom runs first, so the column transform sees n_out_r
+    rows instead of N1 (the crop is folded *between* the per-axis
+    transforms, like :func:`halfrow_power` — at any zoom factor)."""
+    if variant is None:
+        variant = formulation("xfft.zoom")
+    N1, N2 = pad_to
+    r0, r1, nr = band_r
+    c0, c1, nc = band_c
+    dr = (r1 - r0) / nr
+    dc = (c1 - c0) / nc
+    F = zoom_dft_1d(xp.swapaxes(x, -1, -2), N1, r0, dr, int(nr),
+                    xp=xp, variant=variant)
+    F = zoom_dft_1d(xp.swapaxes(F, -1, -2), N2, c0, dc, int(nc),
+                    xp=xp, variant=variant)
+    return (F * xp.conj(F)).real
+
+
+def offgrid_taylor_bound(order, oversample):
+    """Analytic remainder coefficient of :func:`offgrid_taylor`: the
+    truncation error is ≤ ``bound · Σ|x|`` with
+    ``bound = r^k/k! · 1/(1 − r/(k+1))``, r = π/oversample (the
+    worst-case |phase-derivative·δ| at δ = half an oversampled bin).
+    tests/test_xfft.py pins the measured error under it per order."""
+    import math
+    r = np.pi / oversample
+    k = int(order)
+    return float(r ** k / math.factorial(k) / (1.0 - r / (k + 1)))
+
+
+def offgrid_taylor(x, pts, n_grid, *, order=8, oversample=4, xp=np):
+    """Off-grid DFT samples ``X(p) = Σ_m x[..., m] ·
+    exp(-2πi·m·p/n_grid)`` at scattered (traced) frequency points
+    ``pts`` (fractional bin units), via the Taylor-interpolation-
+    through-FFT formulation (arXiv:physics/0610057): one on-grid FFT
+    per derivative order t on the ``oversample``×-oversampled grid
+    (``F_t = FFT(x·(-2πi·m/n_grid)^t)``), then a k-term Taylor
+    expansion from the nearest oversampled bin, Horner-evaluated in
+    the offset δ ∈ [-½, ½] oversampled bins. Error ≤
+    :func:`offgrid_taylor_bound```(order, oversample)·Σ|x|``."""
+    M = x.shape[-1]
+    Nq = int(oversample) * int(n_grid)
+    c = -2j * np.pi / n_grid
+    m = xp.arange(M)
+    pw = (c * m)[None, :] ** xp.arange(order)[:, None]    # (k, M)
+    F = xp.fft.fft(x[..., None, :] * pw, n=Nq, axis=-1)   # (k, Nq)
+    g = xp.round(pts * oversample)
+    delta = pts - g / oversample                          # grid bins
+    idx = (g % Nq).astype(xp.int32) if hasattr(xp, "int32") \
+        else np.asarray(g % Nq, dtype=np.int64)
+    Fp = F[..., idx]                                      # (k, P)
+    acc = Fp[..., order - 1, :]
+    for t in range(order - 1, 0, -1):                     # Horner:
+        acc = Fp[..., t - 1, :] + acc * (delta / t)       # δ^t/t!
+    return acc
+
+
+def offgrid_dft_1d(x, pts, n_grid, *, order=8, oversample=4, xp=np,
+                   variant=None):
+    """Scattered-point DFT over the last axis under the
+    ``xfft.offgrid`` formulation: ``'taylor'`` is
+    :func:`offgrid_taylor` (O(k·qN·log qN) + O(k·P) — independent of
+    where the points fall); ``'dense'`` is the exact point-DFT
+    matmul oracle (O(M·P))."""
+    if variant is None:
+        variant = formulation("xfft.offgrid")
+    if variant == "taylor":
+        return offgrid_taylor(x, pts, n_grid, order=order,
+                              oversample=oversample, xp=xp)
+    m = xp.arange(x.shape[-1])
+    E = xp.exp(-2j * np.pi / n_grid * m[:, None] * pts[None, :])
+    return x @ E
+
+
+def real_spectrum_1d(x, keep, *, xp=np, variant=None):
+    """``real(fft(x))[..., :keep]`` — the 1-D secondary-spectrum
+    profile transform (fit/models.py ``_sspec_1d``). Declared real
+    input with ``keep ≤ n//2+1`` lowers to the rfft half spectrum
+    (the discarded negative-frequency half is never computed — for
+    the mirrored length-(2L−1) profiles, keep = L = n//2+1 exactly);
+    ``'dense'`` is the full complex fft oracle."""
+    if variant is None:
+        variant = formulation("xfft.profile")
+    n = x.shape[-1]
+    if variant == "real" and _is_real(x) and keep <= n // 2 + 1:
+        return xp.real(xp.fft.rfft(x))[..., :keep]
+    return xp.real(xp.fft.fft(x))[..., :keep]
+
+
+# ---------------------------------------------------------------------
 # plan(): the declarative front door
 # ---------------------------------------------------------------------
 
@@ -273,10 +467,10 @@ class Plan:
     functions directly (the batched retrieval does)."""
 
     __slots__ = ("shape", "pad_to", "real_input", "mean_pad", "crop",
-                 "layout", "op")
+                 "layout", "op", "band")
 
     def __init__(self, shape, pad_to, real_input, mean_pad, crop,
-                 layout, op):
+                 layout, op, band=None):
         self.shape = tuple(int(n) for n in shape)
         self.pad_to = tuple(int(n) for n in (pad_to or shape))
         self.real_input = bool(real_input)
@@ -284,6 +478,7 @@ class Plan:
         self.crop = crop
         self.layout = layout
         self.op = op
+        self.band = band
 
     def variant(self, pinned=None):
         """The active formulation choice: an explicit ``pinned``
@@ -299,11 +494,19 @@ class Plan:
     def describe(self):
         """JSON-able view: declared properties + the variant that
         would resolve right now (run reports, docs, bench)."""
+        def _band(b):
+            try:
+                return [float(b[0]), float(b[1]), int(b[2])]
+            except TypeError:          # traced edges: shape-only view
+                return ["traced", "traced", int(b[2])]
+
         return {
             "shape": list(self.shape), "pad_to": list(self.pad_to),
             "real_input": self.real_input, "mean_pad": self.mean_pad,
             "crop": list(self.crop) if self.crop else None,
             "layout": self.layout, "op": self.op,
+            "band": ([_band(b) for b in self.band]
+                     if self.band else None),
             "variant": self.variant(),
         }
 
@@ -332,10 +535,16 @@ class Plan:
         return xp.fft.rfft2(x, s=self.pad_to)
 
     def power(self, x, *, xp=np, variant=None):
-        """Spectral power with the declared row crop. A half-row crop
-        on real input lowers to :func:`halfrow_power` (the discarded
-        half never computed); dense computes the full frame, shifts
-        and crops."""
+        """Spectral power with the declared row crop. A declared
+        ``band`` lowers to :func:`zoom_power_2d` — only the band
+        pixels are computed, at any zoom factor, under the
+        'xfft.zoom' czt|dense choice. A half-row crop on real input
+        lowers to :func:`halfrow_power` (the discarded half never
+        computed); dense computes the full frame, shifts and crops."""
+        if self.band is not None:
+            return zoom_power_2d(x, self.pad_to, self.band[0],
+                                 self.band[1], xp=xp,
+                                 variant=self.variant(variant))
         N1, N2 = self.pad_to
         halved = (self.crop is not None
                   and self.crop[0] == N1 // 2)
@@ -367,7 +576,7 @@ class Plan:
 
 
 def plan(shape, pad_to=None, *, real_input=False, mean_pad=False,
-         crop=None, layout="raw", op=None):
+         crop=None, layout="raw", op=None, band=None):
     """Declare the structure of a 2-D transform; returns a
     :class:`Plan` that lowers to the cheapest exact program.
 
@@ -379,14 +588,30 @@ def plan(shape, pad_to=None, *, real_input=False, mean_pad=False,
     scalar). ``crop`` — ``(rows, cols)`` output crop folded into the
     split transforms (``None`` entries keep the axis). ``layout`` —
     ``'raw'`` or ``'shifted'`` output frame; raw lets gather
-    consumers fold the shift into their index maps. ``op`` — the
+    consumers fold the shift into their index maps. ``band`` — a
+    ``(band_rows, band_cols)`` pair of ``(f0, f1, n_out)`` triples in
+    (fractional, signed) RAW bin units of the ``pad_to`` grid: power
+    lowers to the band-limited zoom transform
+    (:func:`zoom_power_2d`), computing ONLY the declared band at any
+    output density (edges may be traced; n_out is static; the band
+    is its own layout, so ``layout`` must stay 'raw'). ``op`` — the
     backend.py formulation-registry op that routes this plan's
     structured-vs-dense choice (override > env > platform table >
-    measured)."""
+    measured); band plans default to ``'xfft.zoom'``."""
     if layout not in ("raw", "shifted"):
         raise ValueError(f"unknown layout {layout!r} "
                          "(want 'raw' or 'shifted')")
-    return Plan(shape, pad_to, real_input, mean_pad, crop, layout, op)
+    if band is not None:
+        if layout != "raw":
+            raise ValueError("band plans are raw-layout (the band IS "
+                             "the output frame)")
+        if len(band) != 2 or any(len(b) != 3 for b in band):
+            raise ValueError("band wants ((f0, f1, n_out) rows, "
+                             "(f0, f1, n_out) cols)")
+        if op is None:
+            op = "xfft.zoom"
+    return Plan(shape, pad_to, real_input, mean_pad, crop, layout, op,
+                band)
 
 
 # ---------------------------------------------------------------------
@@ -463,6 +688,64 @@ def sspec_power_program(nf, nt, *, variant=None):
     return _cached_jit(key, build, site="xfft.sspec")
 
 
+def zoom_power_program(nf, nt, pad_to, n_r, n_c, *, variant=None):
+    """Cached jitted batched band-limited spectral power
+    ``fn(dyn[B, nf, nt], band_r[2], band_c[2]) → sec[B, n_r, n_c]``
+    where ``band_* = (f0, f1)`` edges in (fractional, signed) bin
+    units of the ``pad_to`` grid — TRACED, so one compiled program
+    serves every band at this geometry (a trigger stream zooming
+    into different arcs never retraces). One compile per
+    (shape, pad_to, n_out, variant), site ``xfft.zoom``."""
+    if variant is None:
+        variant = formulation("xfft.zoom")
+    pad_to = tuple(int(n) for n in pad_to)
+    key = ("zoom", int(nf), int(nt), pad_to, int(n_r), int(n_c),
+           variant)
+
+    def build():
+        from ..backend import get_jax
+
+        jnp = get_jax().numpy
+        nr, nc = int(n_r), int(n_c)
+
+        def fn(dyn, band_r, band_c):
+            return zoom_power_2d(
+                dyn, pad_to, (band_r[0], band_r[1], nr),
+                (band_c[0], band_c[1], nc), xp=jnp, variant=variant)
+
+        return fn
+
+    return _cached_jit(key, build, site="xfft.zoom")
+
+
+def offgrid_program(n, n_pts, *, n_grid=None, order=8, oversample=4,
+                    variant=None):
+    """Cached jitted batched scattered-point DFT
+    ``fn(x[B, n], pts[n_pts]) → X[B, n_pts]`` with TRACED sample
+    points (fractional bin units of the ``n_grid``-point transform,
+    default n) — one compile per (shape, order, oversample, variant),
+    site ``xfft.offgrid``."""
+    if variant is None:
+        variant = formulation("xfft.offgrid")
+    ng = int(n_grid if n_grid is not None else n)
+    key = ("offgrid", int(n), int(n_pts), ng, int(order),
+           int(oversample), variant)
+
+    def build():
+        from ..backend import get_jax
+
+        jnp = get_jax().numpy
+
+        def fn(x, pts):
+            return offgrid_dft_1d(x, pts, ng, order=order,
+                                  oversample=oversample, xp=jnp,
+                                  variant=variant)
+
+        return fn
+
+    return _cached_jit(key, build, site="xfft.offgrid")
+
+
 # ---------------------------------------------------------------------
 # abstract program probes (obs/programs.py) — audited by the jaxlint
 # JP2xx program pass; the 'xfft.*' formulations enter the
@@ -492,3 +775,28 @@ def _probe_sspec():
     fn = sspec_power_program(12, 10)
     S = jax.ShapeDtypeStruct
     return fn, (S((2, 12, 10), np.float32),)
+
+
+@_register_probe("xfft.zoom", formulations=("xfft.zoom",))
+def _probe_zoom():
+    """The batched band-limited zoom-power program (traced band
+    edges) at a fixed 12×10 → 6×8-pixel geometry under the active
+    'xfft.zoom' formulation."""
+    import jax
+
+    fn = zoom_power_program(12, 10, (16, 16), 6, 8)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 12, 10), np.float32),
+                S((2,), np.float32), S((2,), np.float32))
+
+
+@_register_probe("xfft.offgrid", formulations=("xfft.offgrid",))
+def _probe_offgrid():
+    """The batched scattered-point DFT program (traced sample
+    points) at a fixed 16-sample → 5-point geometry under the active
+    'xfft.offgrid' formulation."""
+    import jax
+
+    fn = offgrid_program(16, 5)
+    S = jax.ShapeDtypeStruct
+    return fn, (S((2, 16), np.float32), S((5,), np.float32))

@@ -101,7 +101,7 @@ def make_gs_sharded(mesh):
 
 
 def make_sspec_power_sharded(mesh, nf, nt, window_arrays=None,
-                             halve=True, variant=None):
+                             halve=True, variant=None, zoom=None):
     """Build the distributed secondary-spectrum kernel
     ``fn(dyns[B, nf, nt]) -> power``: the single-device pipeline of
     ops/sspec.py (mean-subtract → window → pad-to-pow2 → transform →
@@ -120,6 +120,18 @@ def make_sspec_power_sharded(mesh, nf, nt, window_arrays=None,
     a quarter of the dense path's bytes. ``'dense'`` keeps the
     complex-fft2 oracle (parity rtol-pinned in tests/test_parallel.py);
     ``halve=False`` always takes it (the full frame needs every row).
+
+    ``zoom`` — an optional ``((r0, r1, n_r), (c0, c1, n_c))`` band
+    pair in (fractional, signed) bin units of the padded frame
+    (ops/sspec.py:zoom_band; STATIC here — the band bakes into the
+    sharded program): the kernel computes only the band pixels
+    through the 'xfft.zoom' lowering, with the zoom crop folded
+    BEFORE the second collective — the transpose back moves
+    n_r × ncfft/k pixels instead of the dense path's nrfft × ncfft/k
+    (``variant`` then means czt|dense; ``halve`` doesn't apply; the
+    output is [B, n_r, n_c] row-sharded, band-ordered f0→f1 per
+    axis, parity-pinned against the single-device zoom in
+    tests/test_parallel.py).
     """
     jax = get_jax()
     import jax.numpy as jnp
@@ -132,6 +144,61 @@ def make_sspec_power_sharded(mesh, nf, nt, window_arrays=None,
     if nrfft % k or ncfft % k:
         raise ValueError(f"seq axis {k} must divide FFT shape "
                          f"({nrfft}, {ncfft})")
+    if zoom is not None:
+        from ..ops.xfft import zoom_dft_1d
+
+        if variant is None:
+            variant = formulation("xfft.zoom")
+        (r0, r1, n_r), (c0, c1, n_c) = zoom
+        n_r, n_c = int(n_r), int(n_c)
+        if n_r % k:
+            raise ValueError(f"seq axis {k} must divide the zoom row "
+                             f"count {n_r}")
+        sharded = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))
+
+        if window_arrays is not None:
+            zcw = jnp.asarray(np.asarray(window_arrays[0]))
+            zsw = jnp.asarray(np.asarray(window_arrays[1]))
+
+        def local_zoom(x):
+            # x: [b, nrfft/k, ncfft] REAL on this device. Transpose
+            # FIRST (real f32 — half the complex collective bytes) so
+            # the full delay axis is local …
+            x = jax.lax.all_to_all(x, SEQ_AXIS, split_axis=2,
+                                   concat_axis=1, tiled=True)
+            # … zoom the delay axis onto the n_r-row band — the zoom
+            # crop folds BEFORE the transpose back, so the second
+            # collective moves n_r rows instead of nrfft
+            F = zoom_dft_1d(jnp.swapaxes(x, 1, 2), nrfft, r0,
+                            (r1 - r0) / n_r, n_r, xp=jnp,
+                            variant=variant)
+            F = jnp.swapaxes(F, 1, 2)               # [b, n_r, ncfft/k]
+            F = jax.lax.all_to_all(F, SEQ_AXIS, split_axis=1,
+                                   concat_axis=2, tiled=True)
+            F = zoom_dft_1d(F, ncfft, c0, (c1 - c0) / n_c, n_c,
+                            xp=jnp, variant=variant)  # [b, n_r/k, n_c]
+            return jnp.real(F * jnp.conj(F))
+
+        zoom_local = _shard_map(local_zoom, mesh,
+                                (P(DATA_AXIS, SEQ_AXIS, None),),
+                                P(DATA_AXIS, SEQ_AXIS, None))
+
+        def zfn(dyns):
+            dyns = dyns - jnp.mean(dyns, axis=(1, 2), keepdims=True)
+            if window_arrays is not None:
+                dyns = dyns * zcw[None, None, :] * zsw[None, :, None]
+                dyns = dyns - jnp.mean(dyns, axis=(1, 2),
+                                       keepdims=True)
+            real_dtype = jnp.float32 \
+                if dyns.dtype != jnp.float64 else jnp.float64
+            dyns = jnp.pad(dyns.astype(real_dtype),
+                           ((0, 0), (0, nrfft - nf),
+                            (0, ncfft - nt)))
+            dyns = jax.lax.with_sharding_constraint(dyns, sharded)
+            return jax.lax.with_sharding_constraint(zoom_local(dyns),
+                                                    sharded)
+
+        return zfn
     if variant is None:
         variant = formulation("xfft.sspec")
     # the halved lowering needs the cropped row block divisible too;

@@ -23,6 +23,10 @@ import numpy as np
 
 from ..backend import get_xp, resolve_backend, get_jax
 from ..ops.windows import get_window
+# the Bluestein chirp-Z implementation lives in ops/xfft.py — the
+# 'xfft.zoom' formulation family shares ONE chirp kernel (+ cache +
+# probe) with the fresnel_method='czt' rows below
+from ..ops.xfft import czt_1d as _czt_1d, czt_fft_length  # noqa: F401
 
 
 def _efield_acf(snx, sny, sqrtar, alph2, xp):
@@ -103,39 +107,6 @@ def lowrank_gammes(snp, sqrtar, alph2, rank_tol=1e-5, dtype=None):
     return U, V
 
 
-def _czt_1d(u, a, phi0, L, xp):
-    """Bluestein chirp-Z evaluation of ``X[n] = Σ_m u[..., m] ·
-    exp(-i·(a·m·n + phi0·n))`` for n = 0..N-1 over the last axis,
-    with TRACED chirp rate ``a`` and per-output phase ``phi0``
-    (static shapes only: M = u.shape[-1] and N are baked via the
-    precomputed FFT length ``L`` ≥ M+N-1).
-
-    m·n = (m² + n² − (n−m)²)/2 turns the sum into a convolution of
-    ``u·e^{-i·a·m²/2}`` with the conjugate chirp, done with
-    zero-padded FFTs — O((M+N)·log) per output row instead of the
-    O(M·N) plane-wave GEMM."""
-    M = u.shape[-1]
-    N = L[1]
-    Lf = L[0]
-    m = xp.arange(M)
-    n = xp.arange(N)
-    k = xp.arange(-(M - 1), N)                 # conv kernel support
-    wm = xp.exp(-0.5j * a * m ** 2)
-    wn = xp.exp(-0.5j * a * n ** 2 - 1j * phi0 * n)
-    v = xp.exp(0.5j * a * k ** 2)              # conjugate chirp
-    uf = xp.fft.fft(u * wm, n=Lf, axis=-1)
-    vf = xp.fft.fft(v, n=Lf)
-    conv = xp.fft.ifft(uf * vf, axis=-1)
-    # conv index k0 + n with k0 = M-1 aligns (n-m) = k
-    return conv[..., M - 1:M - 1 + N] * wn
-
-
-def czt_fft_length(M, N):
-    """Static (fft_len, N) pair for :func:`_czt_1d`."""
-    L = 1
-    while L < M + N - 1:
-        L *= 2
-    return (L, N)
 
 
 def _fresnel_row_czt(gammes, snp, snx, sny, dnun, dsp_eff, xp,
@@ -353,16 +324,24 @@ class ACF:
         self.acf_efield = gammes
 
     def calc_sspec(self, window="hanning", window_frac=1):
-        """Secondary spectrum of the model ACF (scint_sim.py:728-742)."""
+        """Secondary spectrum of the model ACF (scint_sim.py:728-742).
+
+        The full-complex fftshift→fft2→fftshift sequence is a
+        declared real-input shifted-layout forward in ops/xfft.py
+        ('xfft.acf_sspec': rfft2 half spectrum + Hermitian
+        completion — the windowed ACF is real, so the imaginary half
+        was never information; rtol-pinned in tests/test_xfft.py)."""
+        from ..ops import xfft
+
         nf, nt = np.shape(self.acf)
         chan_window, subint_window = get_window(nt, nf, window=window,
                                                 frac=window_frac)
         arr = chan_window * self.acf
         arr = (subint_window * arr.T).T
-        arr = np.fft.fftshift(arr)
-        arr = np.fft.fft2(arr)
-        arr = np.fft.fftshift(arr)
-        arr = np.sqrt(np.real(arr * np.conj(arr)))
+        p = xfft.plan((nf, nt), real_input=True, layout="shifted",
+                      op="xfft.acf_sspec")
+        F = p.forward(np.fft.fftshift(arr), xp=np)
+        arr = np.sqrt(np.real(F * np.conj(F)))
         self.sspec = 10 * np.log10(arr)
         return self.sspec
 

@@ -324,8 +324,8 @@ class TestBudgetFallback:
         # legacy-entry continuity measurement); ISSUE 12: +fft_layer;
         # ISSUE 13: +fleet_plane; ISSUE 14: +arc_detect;
         # ISSUE 15: +mcmc_batch; ISSUE 16: +serve_batched;
-        # ISSUE 17: +fleet_chaos
-        assert len(d["configs"]) == 23
+        # ISSUE 17: +fleet_chaos; ISSUE 18: +zoom_fft
+        assert len(d["configs"]) == 24
         assert all("skipped" in v for v in d["configs"].values())
         # a JSON line was emitted after EVERY config, not just at exit
         assert len(lines) >= 9

@@ -103,6 +103,42 @@ def test_sspec_sharded_full_frame_keeps_dense(mesh, rng):
             got[b], want, rtol=1e-5, atol=1e-6 * np.abs(want).max())
 
 
+def test_sspec_sharded_zoom_matches_single(mesh, rng):
+    """ISSUE 18 tentpole: the sharded ``zoom=`` band program — zoom
+    crop folded BEFORE the second collective — is rtol-pinned against
+    the single-device zoom path of ops/sspec.py, czt and dense
+    variant alike."""
+    B, nf, nt = 4, 24, 12
+    dyns = rng.normal(size=(B, nf, nt))
+    wins = get_window(nt, nf, window="hanning", frac=0.1)
+    nrfft, ncfft = fft_shapes(nf, nt)
+    # 16 rows (divisible by the seq axis) over the low-delay band,
+    # signed Doppler columns around zero — the arc-zoom shape
+    band = ((0.0, 8.0, 16), (-4.0, 4.0, 10))
+    for variant in ("czt", "dense"):
+        fn = jax.jit(par.make_sspec_power_sharded(
+            mesh, nf, nt, window_arrays=wins, variant=variant,
+            zoom=band))
+        got = np.asarray(fn(jnp.asarray(dyns)))
+        assert got.shape == (B, 16, 10)
+        for b in range(B):
+            want = secondary_spectrum_power(
+                dyns[b], window_arrays=wins, zoom=band,
+                variant=variant)
+            np.testing.assert_allclose(
+                got[b], want, rtol=1e-5,
+                atol=1e-7 * np.abs(want).max(),
+                err_msg=f"variant={variant} epoch={b}")
+
+
+def test_sspec_sharded_zoom_rejects_indivisible_rows(mesh):
+    """The zoom row count must divide over the seq axis — the crop
+    folds before the collective, so a ragged split cannot ship."""
+    with pytest.raises(ValueError, match="zoom row"):
+        par.make_sspec_power_sharded(
+            mesh, 24, 12, zoom=((0.0, 8.0, 15), (-4.0, 4.0, 10)))
+
+
 def test_eta_search_sharded_matches_batch(mesh, rng):
     from scintools_tpu.thth.search import chunk_geometry
 

@@ -313,3 +313,265 @@ class TestProgramsRetrace:
             is not xfft.acf_program(8, 6, variant="dense")
         assert xfft.sspec_power_program(12, 10, variant="half") \
             is not xfft.sspec_power_program(12, 10, variant="dense")
+
+
+class TestZoomCzt:
+    """ISSUE 18 tentpole: the band-limited (zoom) DFT family — the
+    Bluestein chirp-Z lowering vs the dense plane-wave DFT oracle,
+    and both vs plain FFT wherever the band lands on-grid."""
+
+    def test_czt_on_grid_matches_fft(self, rng):
+        """a = 2π/N, phi0 = 0 reproduces the full N-point FFT."""
+        for M in (16, 13):
+            x = rng.standard_normal((3, M)) \
+                + 1j * rng.standard_normal((3, M))
+            L = xfft.czt_fft_length(M, M)
+            got = xfft.czt_1d(x, 2 * np.pi / M, 0.0, L)
+            np.testing.assert_allclose(got, np.fft.fft(x, axis=-1),
+                                       rtol=1e-12, atol=1e-12)
+
+    @pytest.mark.parametrize("dtype,rtol", [(np.float64, 1e-10),
+                                            (np.float32, 2e-4)])
+    @pytest.mark.parametrize("M,n_out", [(16, 8), (13, 11)])
+    def test_zoom_czt_vs_dense_oracle(self, rng, dtype, rtol, M,
+                                      n_out):
+        """czt vs the dense DFT matmul on fractional signed bands,
+        odd and even shapes, f32 and f64, batched."""
+        x = rng.standard_normal((2, 3, M)).astype(dtype)
+        for f0, df in [(-2.25, 0.125), (3.7, 0.03), (0.0, 1.0)]:
+            got = xfft.zoom_dft_1d(x, M, f0, df, n_out,
+                                   variant="czt")
+            want = xfft.zoom_dft_1d(x, M, f0, df, n_out,
+                                    variant="dense")
+            _rel_close(got, want, rtol)
+
+    def test_zoom_on_grid_band_is_fft_subset(self, rng):
+        """Integer f0, df = 1: the zoom band IS a contiguous run of
+        fft bins (including the aliased negative-frequency wrap)."""
+        M = 24
+        x = rng.standard_normal((M,))
+        F = np.fft.fft(x)
+        for f0, n_out in [(0, 8), (5, 10), (-4, 9)]:
+            got = xfft.zoom_dft_1d(x, M, float(f0), 1.0, n_out,
+                                   variant="czt")
+            want = F[(f0 + np.arange(n_out)) % M]
+            np.testing.assert_allclose(got, want, rtol=1e-10,
+                                       atol=1e-10 * np.abs(F).max())
+
+    def test_zoom_power_16x_matches_padded_fft_crop(self, rng):
+        """df = 1/16 samples the 16×-zero-padded grid without ever
+        building it: the 2-D zoom power equals the padded |fft2|²
+        crop bin-for-bin (the 'never compute what you discard'
+        acceptance shape at a 16× zoom factor)."""
+        nf, nt, z = 12, 10, 16
+        N1, N2 = 16, 16
+        x = rng.standard_normal((nf, nt))
+        big = np.abs(np.fft.fft2(x, s=(z * N1, z * N2))) ** 2
+        n_r, n_c = 24, 20
+        r0, c0 = 3.0, -2.5
+        got = xfft.zoom_power_2d(
+            x, (N1, N2), (r0, r0 + n_r / z, n_r),
+            (c0, c0 + n_c / z, n_c))
+        rows = (np.round(r0 * z).astype(int)
+                + np.arange(n_r)) % (z * N1)
+        cols = (np.round(c0 * z).astype(int)
+                + np.arange(n_c)) % (z * N2)
+        want = big[np.ix_(rows, cols)]
+        _rel_close(got, want, 1e-9)
+
+    def test_zoom_program_jitted_matches_numpy(self, rng):
+        """The cached jitted zoom program (traced band edges, f32)
+        against the eager f64 numpy lowering."""
+        import jax.numpy as jnp
+
+        d = rng.standard_normal((2, 12, 10)).astype(np.float32)
+        fn = xfft.zoom_power_program(12, 10, (16, 16), 6, 8)
+        got = np.asarray(fn(jnp.asarray(d),
+                            jnp.asarray([2.0, 5.0], jnp.float32),
+                            jnp.asarray([-3.0, 1.0], jnp.float32)))
+        want = xfft.zoom_power_2d(d.astype(np.float64), (16, 16),
+                                  (2.0, 5.0, 6), (-3.0, 1.0, 8))
+        _rel_close(got, want, 2e-4)
+
+
+class TestOffgridTaylor:
+    """The Taylor-interpolation-through-FFT scattered-point
+    evaluator (arXiv:physics/0610057) vs the exact point-DFT
+    oracle, with the analytic truncation bound pinned per order."""
+
+    def test_error_within_bound_and_decreasing_in_order(self, rng):
+        M = 48
+        x = rng.standard_normal((M,))
+        pts = np.sort(rng.uniform(0, M, 64))
+        exact = xfft.offgrid_dft_1d(x, pts, M, variant="dense")
+        scale = np.sum(np.abs(x))
+        last = np.inf
+        for order in (4, 6, 8):
+            got = xfft.offgrid_taylor(x, pts, M, order=order,
+                                      oversample=4)
+            err = np.max(np.abs(got - exact))
+            bound = xfft.offgrid_taylor_bound(order, 4) * scale
+            assert err <= bound
+            assert err < last
+            last = err
+
+    @pytest.mark.parametrize("dtype,rtol", [(np.float64, 1e-5),
+                                            (np.float32, 2e-4)])
+    def test_taylor_vs_dense_batched(self, rng, dtype, rtol):
+        # f64 floor is the order-8 Taylor truncation (~1e-6 of the
+        # spectrum scale at oversample=4), not arithmetic rounding
+        M = 33                                   # odd on purpose
+        x = rng.standard_normal((2, 3, M)).astype(dtype)
+        pts = rng.uniform(-M / 2, M / 2, 17)     # signed bins
+        got = xfft.offgrid_dft_1d(x, pts, M, variant="taylor")
+        want = xfft.offgrid_dft_1d(x, pts, M, variant="dense")
+        _rel_close(got, want, rtol)
+
+    def test_offgrid_program_jitted_matches_numpy(self, rng):
+        import jax.numpy as jnp
+
+        x = rng.standard_normal((2, 16)).astype(np.float32)
+        pts = np.array([0.0, 1.5, -3.25, 7.1, 2.0], np.float32)
+        fn = xfft.offgrid_program(16, 5)
+        got = np.asarray(fn(jnp.asarray(x), jnp.asarray(pts)))
+        want = xfft.offgrid_dft_1d(x.astype(np.float64),
+                                   pts.astype(np.float64), 16,
+                                   variant="dense")
+        _rel_close(got, want, 2e-4)
+
+
+class TestZoomRetraceAndKeys:
+    """Band edges and sample points are TRACED: a band/point sweep
+    through a warm program is steady-state retrace-free, and the
+    program cache keys pin geometry + variant."""
+
+    def test_band_sweep_retrace_free(self, rng):
+        from scintools_tpu.obs import retrace
+
+        import jax.numpy as jnp
+
+        d = jnp.asarray(rng.standard_normal((2, 12, 10))
+                        .astype(np.float32))
+        fn = xfft.zoom_power_program(12, 10, (16, 16), 6, 8)
+        np.asarray(fn(d, jnp.asarray([0.0, 4.0], jnp.float32),
+                      jnp.asarray([0.0, 4.0], jnp.float32)))  # warm
+        with retrace.retrace_guard(sites=["xfft.zoom"]):
+            for f0 in (0.5, -2.0, 3.25, 7.0):
+                fn2 = xfft.zoom_power_program(12, 10, (16, 16), 6, 8)
+                np.asarray(fn2(
+                    d, jnp.asarray([f0, f0 + 3.0], jnp.float32),
+                    jnp.asarray([-f0, f0], jnp.float32)))
+                assert fn2 is fn
+
+    def test_point_sweep_retrace_free(self, rng):
+        from scintools_tpu.obs import retrace
+
+        import jax.numpy as jnp
+
+        x = jnp.asarray(rng.standard_normal((2, 16))
+                        .astype(np.float32))
+        fn = xfft.offgrid_program(16, 5)
+        np.asarray(fn(x, jnp.arange(5, dtype=jnp.float32)))  # warm
+        with retrace.retrace_guard(sites=["xfft.offgrid"]):
+            for s in (0.1, 1.7, -2.3):
+                np.asarray(fn(x, jnp.arange(5, dtype=jnp.float32)
+                              + jnp.float32(s)))
+
+    def test_cache_keys_pin_frame_and_variant(self):
+        base = xfft.zoom_power_program(12, 10, (16, 16), 6, 8)
+        assert xfft.zoom_power_program(12, 10, (16, 16), 6, 8) \
+            is base
+        assert xfft.zoom_power_program(12, 10, (16, 16), 8, 8) \
+            is not base
+        assert xfft.zoom_power_program(12, 10, (16, 16), 6, 8,
+                                       variant="dense") is not base
+        og = xfft.offgrid_program(16, 5)
+        assert xfft.offgrid_program(16, 5, order=6) is not og
+        assert xfft.offgrid_program(16, 5, variant="dense") \
+            is not og
+
+
+class TestZoomPlanAndConsumers:
+    """The plan(band=...) front door and the migrated consumers:
+    the sspec zoom= path, the 1-D profile transform, the ACF-model
+    secondary spectrum."""
+
+    def test_plan_band_power_and_describe(self, rng):
+        x = rng.standard_normal((12, 10))
+        band = ((1.0, 4.0, 6), (-2.0, 2.0, 8))
+        p = xfft.plan((12, 10), (16, 16), real_input=True, band=band)
+        got = p.power(x)
+        want = xfft.zoom_power_2d(x, (16, 16), band[0], band[1])
+        np.testing.assert_array_equal(got, want)
+        d = p.describe()
+        assert d["band"] == [[1.0, 4.0, 6], [-2.0, 2.0, 8]]
+        assert d["op"] == "xfft.zoom"
+
+    def test_plan_band_validation(self):
+        with pytest.raises(ValueError):
+            xfft.plan((12, 10), (16, 16), layout="shifted",
+                      band=((0, 1, 2), (0, 1, 2)))
+        with pytest.raises(ValueError):
+            xfft.plan((12, 10), (16, 16), band=((0, 1), (0, 1)))
+
+    def test_sspec_zoom_on_grid_matches_half_frame(self, rng):
+        """A zoom band laid exactly on the halved raw frame's bins
+        reproduces the standard halved sspec power crop-for-crop —
+        same windowing, same mean subtraction, only the transform
+        lowering differs."""
+        from scintools_tpu.ops.windows import get_window
+
+        nf, nt = 12, 10
+        nrfft, ncfft = fft_shapes(nf, nt)
+        d = rng.standard_normal((nf, nt))
+        wins = get_window(nt, nf, window="hanning", frac=0.1)
+        want = secondary_spectrum_power(d, window_arrays=wins,
+                                        backend="numpy",
+                                        variant="half")
+        # the halved frame is fftshifted on the Doppler axis: its
+        # column j is signed fd bin j − ncfft/2
+        got = secondary_spectrum_power(
+            d, window_arrays=wins, backend="numpy",
+            zoom=((0.0, nrfft / 2, nrfft // 2),
+                  (-ncfft / 2, ncfft / 2, ncfft)))
+        _rel_close(got, want, 1e-9)
+
+    def test_sspec_zoom_rejects_prewhite(self, rng):
+        d = rng.standard_normal((12, 10))
+        with pytest.raises(RuntimeError):
+            secondary_spectrum_power(
+                d, prewhite=True, backend="numpy",
+                zoom=((0.0, 4.0, 4), (0.0, 4.0, 4)))
+
+    def test_profile_real_spectrum_matches_dense(self, rng):
+        """fit/models.py _sspec_1d's lowering: real(rfft)[:keep] ==
+        real(fft)[:keep] for the mirrored real profiles."""
+        L = 17
+        prof = rng.standard_normal((2 * L - 1,))
+        got = xfft.real_spectrum_1d(prof, L)
+        want = np.real(np.fft.fft(prof))[:L]
+        np.testing.assert_allclose(got, want, rtol=1e-10,
+                                   atol=1e-10 * np.abs(want).max())
+        np.testing.assert_array_equal(
+            xfft.real_spectrum_1d(prof, L, variant="dense"), want)
+
+    def test_acf_model_sspec_matches_inline_fft2(self, rng):
+        """sim/acf_model.py calc_sspec rides the declared
+        real-input shifted forward: pinned against the pre-layer
+        inline fftshift→fft2 magnitude sequence."""
+        from scintools_tpu.sim.acf_model import ACF
+
+        acf = ACF(psi=30.0, phasegrad=0.1, theta=0.5, ar=1.5,
+                  alpha=5 / 3, taumax=2.0, dnumax=2.0, nt=16, nf=14,
+                  amp=1.0)
+        acf.calc_acf()
+        got = acf.calc_sspec()
+        from scintools_tpu.ops.windows import get_window
+
+        nf, nt = np.shape(acf.acf)
+        cw, sw = get_window(nt, nf, window="hanning", frac=1)
+        arr = cw * acf.acf
+        arr = (sw * arr.T).T
+        want = 10 * np.log10(np.abs(
+            np.fft.fftshift(np.fft.fft2(np.fft.fftshift(arr)))))
+        _rel_close(got, want, 1e-8)
