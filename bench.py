@@ -2207,6 +2207,21 @@ def bench_serve_batched(jax, jnp):
        program site: the controller must widen B so the backlog
        drains batched, with ZERO steady-state retraces (bucket
        padding) and p95 within 1.5x of the low-cadence value.
+    5. **ledger overhead** (ISSUE 20) — a batched flood with the
+       obs switch on vs off, best-of-N each (the PR-5
+       ``obs_overhead_frac`` gate shape): the program cost ledger's
+       per-batch ``record``/``reschedule`` path must cost <3% wall
+       (the off run disables ALL obs, so the frac is an upper bound
+       on the ledger's own share).
+    6. **gain scheduling** (ISSUE 20) — a compute-bound synthetic
+       fit (``sleep(cost x lanes)``: zero amortisation, so
+       power-of-two padding burns real seconds) at a near-saturation
+       cadence, fixed law vs gain-scheduled: the scheduler reads the
+       ledger's ``serve.batch`` medians, sees rho ~= 1, drops the
+       gain toward ``min_gain``, and must HOLD p95 (<= 1.1x the
+       fixed law's — in practice it wins, because the fixed law
+       forms 3-lane groups padded to 4 and 5-lane groups padded to
+       8).
     """
     import tempfile
 
@@ -2247,7 +2262,9 @@ def bench_serve_batched(jax, jnp):
         b = min(b * 2, max_batch)
     compile_s = time.perf_counter() - t0
 
-    def stage(tag, batched, interarrival_s):
+    def stage(tag, batched, interarrival_s, frames_in=None):
+        fr = frames if frames_in is None else frames_in
+        n = len(fr)
         src = QueueSource()
         kw = dict(http=False, heartbeat=False, report=False,
                   prefetch=16)
@@ -2259,15 +2276,17 @@ def bench_serve_batched(jax, jnp):
                             **kw)
         with svc:
             t_first = time.perf_counter()
-            for i in range(n_epochs):
-                src.put(f"e{i:03d}", frames[i])
+            for i in range(n):
+                src.put(f"e{i:03d}", fr[i])
                 if interarrival_s:
                     time.sleep(interarrival_s)
             deadline = time.time() + 120
             while time.time() < deadline:
-                if len(svc.results()) >= n_epochs:
+                if len(svc.results()) >= n:
                     break
-                time.sleep(0.005)
+                # 1 ms poll: the completion check quantises the
+                # measured wall, and stage 5 resolves a <3% delta
+                time.sleep(0.001)
             wall = time.perf_counter() - t_first
             pct = svc.latency_percentiles()
             counts = svc.state_snapshot()["counts"]
@@ -2285,9 +2304,101 @@ def bench_serve_batched(jax, jnp):
         high = stage("high", batched=True,
                      interarrival_s=t_sat / 10.0)
 
+    from scintools_tpu.obs import ledger as _ledger
     from scintools_tpu.obs import metrics as _obs_metrics
 
     snap = _obs_metrics.snapshot()["counters"]
+
+    # ---- 5. ledger overhead: batched flood, obs on vs off ------------
+    # 4x-tiled flood: a 3% gate on a ~100 ms wall is scheduler noise,
+    # not measurement — the longer flood plus best-of-N makes the
+    # on/off delta resolvable
+    led_frames = np.concatenate([frames] * 4)
+
+    def flood_wall():
+        return stage("led", batched=True, interarrival_s=0.0,
+                     frames_in=led_frames)["wall_s"]
+
+    # interleaved on/off repeats (drift cancels), min per arm: the
+    # min approaches each arm's noise floor, and the floors' gap is
+    # the systematic cost
+    led_repeats = 5
+    on_walls, off_walls = [], []
+    try:
+        for _ in range(led_repeats):
+            _obs_metrics.set_enabled(True)
+            on_walls.append(flood_wall())
+            _obs_metrics.set_enabled(False)
+            off_walls.append(flood_wall())
+    finally:
+        _obs_metrics.set_enabled(True)
+    t_led_on = min(on_walls)
+    t_led_off = min(off_walls)
+    led_frac = (t_led_on - t_led_off) / t_led_off
+
+    # ---- 6. compute-bound synthetic: gain scheduling holds p95 -------
+    lane_cost_s = 0.005     # sleep-modelled marginal lane cost: a
+
+    def process_cb(payload, tier=None):        # batch of b lanes
+        time.sleep(lane_cost_s)                # costs b singles —
+        return {"ok": 1}                       # amortisation zero,
+
+    def process_batch_cb(payloads, tier=None):  # padding pure waste
+        time.sleep(lane_cost_s * len(payloads))
+        return [{"ok": 1} for _ in payloads]
+
+    def cb_stage(tag, gain_schedule):
+        src = QueueSource()
+        svc = SurveyService(
+            src, process_cb,
+            tempfile.mkdtemp(prefix=f"bench_cb_{tag}_"),
+            http=False, heartbeat=False, report=False, prefetch=16,
+            process_batch=process_batch_cb, max_batch=max_batch,
+            gain_schedule=gain_schedule)
+        with svc:
+            # ramp: the first epochs are fed SERIALLY (each waits for
+            # its result) so they dispatch as 1-lane programs and give
+            # the ledger its T(1) samples deterministically; the rest
+            # arrive just past saturation
+            n_ramp = 6
+            for i in range(n_ramp):
+                src.put(f"c{i:03d}", frames[i])
+                deadline = time.time() + 30
+                while time.time() < deadline \
+                        and len(svc.results()) < i + 1:
+                    time.sleep(0.002)
+            for i in range(n_ramp, n_epochs):
+                src.put(f"c{i:03d}", frames[i])
+                time.sleep(0.8 * lane_cost_s)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if len(svc.results()) >= n_epochs:
+                    break
+                time.sleep(0.005)
+            pct = svc.latency_percentiles()
+            gain = svc._controller.gain
+            buckets = sorted(svc._buckets_seen)
+        return pct, gain, buckets
+
+    # the synthetic must train the scheduler on ITS service times,
+    # not the real fit's from stages 2-4 — park the ledger and merge
+    # it back after (the fixed run doubles as the training data)
+    led_park = os.path.join(
+        tempfile.mkdtemp(prefix="bench_led_park_"), "parked.jsonl")
+    _ledger.save(led_park)
+    _ledger.reset()
+    try:
+        cb_fixed, gain_fixed, bk_fixed = cb_stage(
+            "fixed", gain_schedule=False)
+        cb_sched, gain_sched, bk_sched = cb_stage(
+            "sched", gain_schedule=True)
+    finally:
+        _ledger.load(led_park)
+    p95_cb_fixed = cb_fixed["p95_s"]
+    p95_cb_sched = cb_sched["p95_s"]
+    cb_ratio = (p95_cb_sched / p95_cb_fixed) if p95_cb_fixed \
+        else float("inf")
+
     p95_low = low["latency"]["p95_s"]
     p95_high = high["latency"]["p95_s"]
     ratio = (p95_high / p95_low) if p95_low else float("inf")
@@ -2311,6 +2422,24 @@ def bench_serve_batched(jax, jnp):
         "batches_dispatched": snap.get("serve_batches_total", 0),
         "batch_lanes": snap.get("serve_batch_lanes_total", 0),
         "padded_lanes": snap.get("serve_batch_padded_lanes_total", 0),
+        # ISSUE 20 stages 5-6
+        "ledger_flood_on_s": round(t_led_on, 3),
+        "ledger_flood_off_s": round(t_led_off, 3),
+        "ledger_overhead_frac": round(led_frac, 4),
+        "ledger_overhead_gate_3pct_ok": bool(led_frac < 0.03),
+        "ledger_repeats": led_repeats,
+        "cb_lane_cost_ms": lane_cost_s * 1e3,
+        "cb_p95_fixed_s": p95_cb_fixed,
+        "cb_p95_scheduled_s": p95_cb_sched,
+        "cb_p95_ratio": round(cb_ratio, 3),
+        "gain_schedule_gate_1p1x_ok": bool(cb_ratio <= 1.1),
+        "cb_gain_fixed": round(gain_fixed, 3),
+        "cb_gain_scheduled": round(gain_sched, 3),
+        "cb_buckets_fixed": bk_fixed,
+        "cb_buckets_scheduled": bk_sched,
+        "batch_service_median_s": {
+            str(b): _ledger.steady_median("serve.batch", shape=b)
+            for b in (1, max_batch)},
         "quota_gate": "tests/test_serve_batched.py::"
                       "TestBatchedDaemon",
         "quarantine_gate": "tests/test_serve_batched.py::"
@@ -2516,7 +2645,11 @@ def bench_zoom_fft(jax, jnp):
        the installed override is CLEARED after measuring so the
        REGISTERED defaults stay active (performance.md: every TPU
        column remains the registered default, unverified on
-       hardware).
+       hardware). ISSUE 20 addendum: one winner is then persisted to
+       a scratch table dir and resolved back through the measured-
+       table auto-load path after a registry reset — the committed-
+       table round-trip (``tools/formulation_tables/<platform>.json``)
+       exercised in-bench and recorded as ``table_roundtrip``.
     """
     from scintools_tpu.backend import (formulation, measure_formulation,
                                        set_formulation)
@@ -2642,6 +2775,40 @@ def bench_zoom_fft(jax, jnp):
             "timings_s": {k: round(v, 5) for k, v in timings.items()},
         }
 
+    # ---- 3b. measured-table round-trip (scratch dir, ISSUE 20) -------
+    import tempfile
+
+    from scintools_tpu.backend import (formulation_table_path,
+                                       record_measured_formulation,
+                                       reset_measured_formulations)
+
+    rt_op = "xfft.zoom"
+    rt_winner = tables[rt_op]["winner_measured"]
+    env_prev = os.environ.get("SCINTOOLS_FORMULATION_TABLES")
+    os.environ["SCINTOOLS_FORMULATION_TABLES"] = tempfile.mkdtemp(
+        prefix="bench_ftab_")
+    try:
+        reset_measured_formulations()          # point at the scratch
+        record_measured_formulation(           # dir before writing
+            rt_op, rt_winner,
+            seconds=tables[rt_op]["timings_s"], persist=True)
+        table_path = formulation_table_path(
+            jax.default_backend())
+        reset_measured_formulations()          # drop in-process state;
+        resolved = formulation(rt_op)          # must reload from file
+        roundtrip = {
+            "op": rt_op, "winner": rt_winner,
+            "resolved_after_reload": resolved,
+            "table_file": os.path.basename(table_path),
+            "ok": bool(resolved == rt_winner),
+        }
+    finally:
+        if env_prev is None:
+            os.environ.pop("SCINTOOLS_FORMULATION_TABLES", None)
+        else:
+            os.environ["SCINTOOLS_FORMULATION_TABLES"] = env_prev
+        reset_measured_formulations()          # back to the committed
+                                               # tables
     return {
         "zoom": {
             "shape": f"{B}x{nf}x{nt}", "zoom_factor": z,
@@ -2667,6 +2834,7 @@ def bench_zoom_fft(jax, jnp):
             "steady_retraces": 0,
         },
         "formulations_measured": tables,
+        "table_roundtrip": roundtrip,
         "refinement_quality_gate": "tests/test_detect.py::"
                                    "TestSubGridRefinement",
     }
@@ -2983,7 +3151,9 @@ _EST_S = {
     "survey":        {"acc": 150, "cpu": 120},
     "survey_pipeline": {"acc": 60, "cpu": 60},
     "survey_service": {"acc": 60, "cpu": 60},
-    "serve_batched":  {"acc": 60, "cpu": 60},
+    # +~40 s for the ISSUE 20 ledger-overhead floods and the
+    # compute-bound gain-scheduling stages
+    "serve_batched":  {"acc": 100, "cpu": 100},
     "survey_arc":    {"acc": 180, "cpu": 90},
     "sim_batch":     {"acc": 60,  "cpu": 90},
     "sim_factory":   {"acc": 60,  "cpu": 60},
@@ -3006,7 +3176,65 @@ _EST_S = {
 }
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="scintools_tpu benchmark driver: runs the config "
+                    "plan under a wall-clock budget and emits one "
+                    "JSON record per config plus a final record with "
+                    "the program cost ledger.")
+    parser.add_argument(
+        "--config", action="append", metavar="NAME", default=None,
+        help="run only this config (repeatable); default: the full "
+             "plan in priority order")
+    parser.add_argument(
+        "--list", action="store_true",
+        help="list config names with their budget estimates and exit")
+    ns = parser.parse_args(argv)
+
+    # priority order: the headline first, the most expendable last
+    plan = [
+        ("north_star", bench_north_star),
+        ("sspec_thth", bench_sspec_thth),
+        ("retrieval_batch", bench_retrieval_batch),
+        ("acf_fit_batch", bench_acf_fit_batch),
+        ("survey", bench_survey),
+        ("survey_pipeline", bench_survey_pipeline),
+        ("survey_service", bench_survey_service),
+        ("serve_batched", bench_serve_batched),
+        ("acf2d_batch", bench_acf2d_batch),
+        ("survey_arc", bench_survey_arc),
+        ("sim_batch", bench_sim_batch),
+        ("sim_factory", bench_sim_factory),
+        ("scenario_loop", bench_scenario_loop),
+        ("fleet_survey", bench_fleet_survey),
+        ("fleet_plane", bench_fleet_plane),
+        ("fleet_chaos", bench_fleet_chaos),
+        ("robust", bench_robust_survey),
+        ("acf_fit", bench_acf_fit),
+        ("acf2d", bench_acf2d_fit),
+        ("scatim", bench_scattered_image),
+        ("fft_layer", bench_fft_layer),
+        ("arc_detect", bench_arc_detect),
+        ("zoom_fft", bench_zoom_fft),
+        ("mcmc_batch", bench_mcmc_batch),
+    ]
+    if ns.list:
+        for name, _fn in plan:
+            est = _EST_S[name]
+            print(f"{name:<18} ~{est['acc']:>4}s accelerator / "
+                  f"~{est['cpu']:>4}s cpu")
+        return
+    if ns.config:
+        unknown = sorted(set(ns.config) - {n for n, _ in plan})
+        if unknown:
+            parser.error(f"unknown config(s) {unknown}; "
+                         "--list shows the plan")
+    # selection preserves plan (priority) order, not flag order
+    selected = [(n, fn) for n, fn in plan
+                if ns.config is None or n in ns.config]
+
     t_start = time.time()
     budget = float(os.environ.get(
         "SCINTOOLS_BENCH_BUDGET",
@@ -3040,6 +3268,16 @@ def main():
             "program_fingerprints": state.get("program_fingerprints"),
             "total_bench_s": round(time.time() - t_start, 1),
         }
+        # ISSUE 20: the program cost ledger rides in every bench
+        # record — per-site compile/steady wall time accumulated
+        # across all configs run so far (the durable counterpart of
+        # the per-config timing fields)
+        try:
+            from scintools_tpu.obs import ledger as _prog_ledger
+
+            record["program_ledger"] = _prog_ledger.snapshot()
+        except Exception as e:          # noqa: BLE001 — diagnostics
+            record["program_ledger"] = {"error": repr(e)[:200]}
         if state["platform"] == "cpu":
             # a CPU run is the dead-tunnel fallback, never the
             # measurement of record — point the durable artifact at
@@ -3120,33 +3358,6 @@ def main():
     state["platform"] = jax.default_backend()
     est_key = "cpu" if state["platform"] == "cpu" else "acc"
 
-    # priority order: the headline first, the most expendable last
-    plan = [
-        ("north_star", bench_north_star),
-        ("sspec_thth", bench_sspec_thth),
-        ("retrieval_batch", bench_retrieval_batch),
-        ("acf_fit_batch", bench_acf_fit_batch),
-        ("survey", bench_survey),
-        ("survey_pipeline", bench_survey_pipeline),
-        ("survey_service", bench_survey_service),
-        ("serve_batched", bench_serve_batched),
-        ("acf2d_batch", bench_acf2d_batch),
-        ("survey_arc", bench_survey_arc),
-        ("sim_batch", bench_sim_batch),
-        ("sim_factory", bench_sim_factory),
-        ("scenario_loop", bench_scenario_loop),
-        ("fleet_survey", bench_fleet_survey),
-        ("fleet_plane", bench_fleet_plane),
-        ("fleet_chaos", bench_fleet_chaos),
-        ("robust", bench_robust_survey),
-        ("acf_fit", bench_acf_fit),
-        ("acf2d", bench_acf2d_fit),
-        ("scatim", bench_scattered_image),
-        ("fft_layer", bench_fft_layer),
-        ("arc_detect", bench_arc_detect),
-        ("zoom_fft", bench_zoom_fft),
-        ("mcmc_batch", bench_mcmc_batch),
-    ]
     # The tunneled TPU can WEDGE mid-run (observed live: after a
     # healthy 4096² headline run, the next config's first device call
     # blocked >900 s and even `jnp.ones((256,256)).sum()` in a fresh
@@ -3156,7 +3367,7 @@ def main():
     # consecutive failures mark the remaining configs skipped and
     # leave the watchdog nothing to burn.
     wedge_fails = 0
-    for name, fn in plan:
+    for name, fn in selected:
         remaining = deadline - time.time()
         if remaining < _EST_S[name][est_key] + 30:
             configs[name] = {"skipped":

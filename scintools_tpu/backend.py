@@ -216,13 +216,23 @@ def eager_backend(backend=None):
 #   ``SCINTOOLS_FORMULATION_OPS_CS=fft2``), and
 #   :func:`measure_formulation` installs a MEASURED override by
 #   timing the candidate closures on the live platform (the bench's
-#   gather-vs-matmul splits, promoted to a mechanism).
+#   gather-vs-matmul splits, promoted to a mechanism);
+# - measured winners PERSIST (ISSUE 20): ``measure_formulation(...,
+#   persist=True)`` merges the winner into a committable per-platform
+#   table (``tools/formulation_tables/<platform>.json``,
+#   ``SCINTOOLS_FORMULATION_TABLES`` relocates the directory), which
+#   every later process auto-loads on its first resolution for that
+#   platform — a measurement run on a TPU host writes the table the
+#   fleet resolves from, no code change.
 #
 # Resolution order: measured/manual override > environment >
-# per-platform table > registered default.
+# measured per-platform table > registered per-platform table >
+# registered default.
 
 _FORMULATIONS = {}            # op -> {default, choices, platforms, doc}
 _FORMULATION_OVERRIDES = {}   # op -> choice (set_formulation/measured)
+_MEASURED_TABLES = {}         # platform -> op -> {choice, seconds}
+_MEASURED_LOADED = set()      # platforms whose table file was read
 
 
 def register_formulation(op, default, choices, platforms=None, doc=""):
@@ -260,15 +270,65 @@ def _env_formulation(op):
         "SCINTOOLS_FORMULATION_" + op.replace(".", "_").upper())
 
 
+def formulation_table_dir():
+    """Directory of the committable per-platform measured formulation
+    tables: ``SCINTOOLS_FORMULATION_TABLES`` when set (tests, scratch
+    measurement runs), else ``tools/formulation_tables`` next to the
+    package (the in-repo location the CPU table is committed at)."""
+    env = os.environ.get("SCINTOOLS_FORMULATION_TABLES")
+    if env:
+        return env
+    return os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "tools", "formulation_tables"))
+
+
+def formulation_table_path(platform):
+    """``<table_dir>/<platform>.json`` for a jax backend name."""
+    return os.path.join(formulation_table_dir(), f"{platform}.json")
+
+
+def _measured_table(platform):
+    """The measured table for ``platform``, auto-loading the
+    committed table file once per process on first use. In-process
+    measurements (:func:`record_measured_formulation`) shadow the
+    file's entries. A missing or unreadable file is an empty table —
+    a stale or foreign table must never brick a build."""
+    if platform not in _MEASURED_LOADED:
+        _MEASURED_LOADED.add(platform)
+        try:
+            import json
+
+            with open(formulation_table_path(platform)) as fh:
+                data = json.load(fh)
+            ops = data.get("ops") or {}
+        except (OSError, ValueError, AttributeError):
+            ops = {}
+        tbl = _MEASURED_TABLES.setdefault(platform, {})
+        for op, entry in ops.items():
+            if not isinstance(entry, dict):
+                entry = {"choice": entry}
+            choice = entry.get("choice")
+            if choice is not None:
+                tbl.setdefault(str(op), {
+                    "choice": str(choice),
+                    "seconds": entry.get("seconds")})
+    return _MEASURED_TABLES.get(platform, {})
+
+
 def formulation(op, platform=None):
     """Resolve the active formulation name for a registered ``op``.
 
     Order: :func:`set_formulation`/:func:`measure_formulation`
-    override > ``SCINTOOLS_FORMULATION_<OP>`` env var > per-platform
-    table entry for ``platform`` (default: the live jax backend) >
-    registered default. Unknown ops and invalid override values raise
-    — a typo'd formulation must be loud, not a silent fall-through to
-    the slow path."""
+    override > ``SCINTOOLS_FORMULATION_<OP>`` env var > measured
+    per-platform table (:func:`_measured_table`, auto-loaded from
+    ``tools/formulation_tables/<platform>.json``) > registered
+    per-platform table entry for ``platform`` (default: the live jax
+    backend) > registered default. Unknown ops and invalid override
+    values raise — a typo'd formulation must be loud, not a silent
+    fall-through to the slow path; an invalid MEASURED choice (a
+    stale committed table naming a renamed formulation) is skipped
+    instead, since the operator may not own the table."""
     rec = _FORMULATIONS.get(op)
     if rec is None:
         raise KeyError(f"unregistered formulation op {op!r} "
@@ -283,6 +343,9 @@ def formulation(op, platform=None):
             return choice
     if platform is None:
         platform = formulation_platform()
+    measured = _measured_table(platform).get(op)
+    if measured and measured.get("choice") in rec["choices"]:
+        return measured["choice"]
     return rec["platforms"].get(platform, rec["default"])
 
 
@@ -301,7 +364,62 @@ def set_formulation(op, choice=None):
     _FORMULATION_OVERRIDES[op] = choice
 
 
-def measure_formulation(op, candidates, repeats=2):
+def record_measured_formulation(op, choice, seconds=None,
+                                platform=None, persist=False):
+    """Install ``choice`` as the measured winner for ``op`` on
+    ``platform`` (default: live). ``seconds`` — the per-candidate
+    timing dict to keep alongside it. With ``persist=True`` the
+    winner is also merged into the platform's table file
+    (:func:`formulation_table_path`, atomic write) so the NEXT
+    process resolves it with no pinning — the mechanism ROADMAP item
+    4b asks for."""
+    rec = _FORMULATIONS.get(op)
+    if rec is None:
+        raise KeyError(f"unregistered formulation op {op!r}")
+    if choice not in rec["choices"]:
+        raise ValueError(f"{op}: {choice!r} not one of "
+                         f"{rec['choices']}")
+    if platform is None:
+        platform = formulation_platform()
+    _measured_table(platform)      # load the file before shadowing it
+    _MEASURED_TABLES.setdefault(platform, {})[op] = {
+        "choice": choice,
+        "seconds": {k: round(float(v), 6)
+                    for k, v in (seconds or {}).items()} or None}
+    if persist:
+        save_formulation_table(platform)
+
+
+def save_formulation_table(platform=None, path=None):
+    """Atomically write ``platform``'s measured table (file entries
+    merged with in-process measurements, in-process wins) to its
+    committable JSON file. Returns the path written."""
+    import json
+
+    from .parallel.checkpoint import atomic_write_bytes
+
+    if platform is None:
+        platform = formulation_platform()
+    table = _measured_table(platform)
+    if path is None:
+        path = formulation_table_path(platform)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    doc = {"platform": platform,
+           "ops": {op: dict(entry) for op, entry in
+                   sorted(table.items())}}
+    atomic_write_bytes(path, (json.dumps(doc, indent=1, sort_keys=True)
+                              + "\n").encode())
+    return path
+
+
+def reset_measured_formulations():
+    """Drop every measured table AND the loaded-file memo (tests; a
+    re-resolution re-reads the table files)."""
+    _MEASURED_TABLES.clear()
+    _MEASURED_LOADED.clear()
+
+
+def measure_formulation(op, candidates, repeats=2, persist=False):
     """Install a MEASURED override: time each candidate closure on the
     live platform and pin the fastest.
 
@@ -312,7 +430,13 @@ def measure_formulation(op, candidates, repeats=2):
     times; the per-choice time is the best repeat. Returns
     ``(winner, {choice: best_seconds})`` and leaves the winner pinned
     via :func:`set_formulation` (clear with
-    ``set_formulation(op, None)``)."""
+    ``set_formulation(op, None)``). With ``persist=True`` the winner
+    also lands in the platform's measured table and its committable
+    file (see :func:`record_measured_formulation`) so later processes
+    resolve it with no pinning; without it only the override is set —
+    clearing the override restores the registered resolution. Every
+    candidate timing is recorded into the program cost ledger under
+    site ``formulation.<op>``."""
     import time
 
     rec = _FORMULATIONS.get(op)
@@ -332,10 +456,17 @@ def measure_formulation(op, candidates, repeats=2):
         timings[choice] = best
     winner = min(timings, key=timings.get)
     set_formulation(op, winner)
+    if persist:
+        record_measured_formulation(op, winner, seconds=timings,
+                                    persist=True)
+    from .obs import ledger
     from .utils import slog
 
+    for choice, best in timings.items():
+        ledger.record(f"formulation.{op}", best, "steady",
+                      formulation=choice)
     slog.log_event("backend.formulation_measured", op=op,
-                   winner=winner,
+                   winner=winner, persist=bool(persist),
                    timings={k: round(v, 6) for k, v in timings.items()})
     return winner, timings
 
@@ -343,6 +474,8 @@ def measure_formulation(op, candidates, repeats=2):
 def formulation_snapshot():
     """JSON-able view of every registered op: its choices, table, and
     the choice that would resolve right now (for run reports/bench)."""
+    platform = formulation_platform()
+    measured = _measured_table(platform)
     out = {}
     for op, rec in sorted(_FORMULATIONS.items()):
         out[op] = {
@@ -351,6 +484,7 @@ def formulation_snapshot():
             "platforms": dict(rec["platforms"]),
             "override": _FORMULATION_OVERRIDES.get(op)
             or _env_formulation(op),
+            "measured": (measured.get(op) or {}).get("choice"),
             "active": formulation(op),
         }
     return out

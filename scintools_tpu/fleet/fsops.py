@@ -165,7 +165,7 @@ class FsOps:
                 _metrics.counter(
                     "fleet_fsop_retries_total",
                     help="transient fs-op failures retried at the "
-                         "fleet fsops seam").labels(op=op).inc()
+                         "fleet fsops seam").labels(op=op).inc()  # lint-ok: metric-hygiene: bounded=op
                 if attempt > self.policy.retries:
                     self._degrade(op, path, attempt, e)
                 if time.monotonic() >= deadline:

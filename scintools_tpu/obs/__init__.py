@@ -22,6 +22,12 @@ bench-only timing splits):
   events for long runs, plus the cross-process file-heartbeat channel
   with its mtime-gated incremental directory scan
   (:class:`~scintools_tpu.obs.heartbeat.HeartbeatScanner`);
+- :mod:`~scintools_tpu.obs.ledger` — the program cost ledger
+  (ISSUE 20): persistent per-(site, platform, shape, formulation)
+  compile/steady wall-time accounting, CRC-JSONL persistence per
+  workdir, the ``/ledger`` endpoint's data source, and the measured
+  cost model the formulation tables and the serve batch controller's
+  gain scheduling read back;
 - :mod:`~scintools_tpu.obs.report` — the end-of-run ``run_report``
   artifact (JSON + markdown), schema-validated;
 - :mod:`~scintools_tpu.obs.plane` — the pod-level telemetry plane
@@ -33,10 +39,11 @@ See docs/observability.md for the event catalog, metric names, the
 trace-viewer walkthrough, and the RunReport schema.
 """
 
-from . import (heartbeat, metrics, plane, programs,  # noqa: F401
-               report, retrace, trace)
+from . import (heartbeat, ledger, metrics, plane,  # noqa: F401
+               programs, report, retrace, trace)
 from .heartbeat import (Heartbeat, HeartbeatScanner,  # noqa: F401
                         as_heartbeat, scan_heartbeat_dir)
+from .ledger import (LEDGER, ProgramLedger)  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, aggregate_snapshots, counter,
                       gauge, histogram, set_enabled)

@@ -214,7 +214,7 @@ def plane_routes():
     """The plane's handler table — same route contract as
     :func:`serve.http.daemon_routes`, so the two surfaces share one
     dispatch/index/404/metric path and cannot drift."""
-    from ..serve.http import snapshot_route
+    from ..serve.http import ledger_route, snapshot_route
 
     def metrics_route(view):
         return (200, view.merged_metrics_text(),
@@ -225,6 +225,7 @@ def plane_routes():
         "/state": snapshot_route("state_snapshot"),
         "/report": snapshot_route("report_snapshot"),
         "/workers": snapshot_route("workers_snapshot"),
+        "/ledger": ledger_route,
     }
 
 

@@ -31,6 +31,10 @@ quarantined        list     per-epoch {epoch, error_class,
 timeline           dict?    StageTimeline.summary() or None
 jit_builds         dict     per-site {builds, distinct_keys}
 metrics            dict?    MetricsRegistry.snapshot() or None
+slo                dict     {global, tenants, sites}: global +
+                            per-tenant latency p50/p95 and the
+                            cost ledger's per-site steady
+                            medians (ISSUE 20)
 =================  =======  ==================================
 
 Optional extras (``n_batches`` from the batched runner, caller
@@ -52,6 +56,7 @@ import os
 import time
 
 from ..utils import slog
+from . import ledger as _ledger
 from . import metrics as _metrics
 from . import retrace as _retrace
 
@@ -73,16 +78,36 @@ _REQUIRED = {
     "timeline": (dict, type(None)),
     "jit_builds": dict,
     "metrics": (dict, type(None)),
+    "slo": dict,
 }
 
 
+def _slo_block(slo=None):
+    """Normalise a caller-supplied SLO view into the schema's
+    ``slo`` block; the ledger's per-site steady medians fill in when
+    the caller didn't supply ``sites`` (batch runners have no
+    per-tenant latency, but every runner has a cost ledger)."""
+    slo = dict(slo or {})
+    sites = slo.get("sites")
+    if sites is None:
+        sites = _ledger.LEDGER.steady_site_medians()
+    return {
+        "global": dict(slo.get("global")
+                       or {"p50_s": None, "p95_s": None, "n": 0}),
+        "tenants": dict(slo.get("tenants") or {}),
+        "sites": dict(sites),
+    }
+
+
 def build_run_report(summary, outcomes=(), wall_s=0.0, timeline=None,
-                     runner="run_survey", extra=None):
+                     runner="run_survey", extra=None, slo=None):
     """Assemble the report dict from the runner's tally ``summary``,
     its ordered ``outcomes`` (:class:`EpochOutcome`-like, for the
     quarantine detail), the run's wall seconds, and an optional
     timeline summary dict. Metrics and jit-build accounting are read
-    from the process-wide registries."""
+    from the process-wide registries; ``slo`` — the serving daemon's
+    latency SLO view (:meth:`SurveyService.slo_snapshot`), defaulted
+    to a ledger-only block for batch runners."""
     quarantined = []
     for o in outcomes:
         status = getattr(o, "status", None)
@@ -115,6 +140,7 @@ def build_run_report(summary, outcomes=(), wall_s=0.0, timeline=None,
         "jit_builds": _retrace.snapshot(),
         "metrics": (_metrics.REGISTRY.snapshot()
                     if _metrics.REGISTRY.enabled else None),
+        "slo": _slo_block(slo),
     }
     if "n_batches" in summary:
         rep["n_batches"] = int(summary["n_batches"])
@@ -149,7 +175,7 @@ class RunReportBuilder:
         return time.perf_counter() - self._t0
 
     def snapshot(self, summary, outcomes=(), timeline=None,
-                 extra=None, in_progress=True):
+                 extra=None, in_progress=True, slo=None):
         """A schema-valid report of the run SO FAR (validated before
         it is returned — a malformed snapshot must fail here, not in
         the scraper)."""
@@ -157,17 +183,19 @@ class RunReportBuilder:
                   "in_progress": bool(in_progress)}
         return validate_run_report(build_run_report(
             summary, outcomes, wall_s=self.wall_s(),
-            timeline=timeline, runner=self.runner, extra=merged))
+            timeline=timeline, runner=self.runner, extra=merged,
+            slo=slo))
 
     def finalize(self, workdir, summary, outcomes=(), timeline=None,
-                 extra=None, name="run_report"):
+                 extra=None, name="run_report", slo=None):
         """Write the closing snapshot (``in_progress: false``) as the
         usual ``run_report.json``/``.md`` pair; returns the JSON
         path."""
         return write_run_report(
             workdir, self.snapshot(summary, outcomes,
                                    timeline=timeline, extra=extra,
-                                   in_progress=False), name=name)
+                                   in_progress=False, slo=slo),
+            name=name)
 
 
 def validate_run_report(report):
@@ -197,6 +225,21 @@ def validate_run_report(report):
         if not isinstance(q, dict) or "epoch" not in q \
                 or "error_class" not in q:
             problems.append(f"quarantined[{i}] malformed: {q!r}")
+    slo = report.get("slo")
+    if isinstance(slo, dict):
+        for part, typ in (("global", dict), ("tenants", dict),
+                          ("sites", dict)):
+            if not isinstance(slo.get(part), typ):
+                problems.append(f"slo[{part!r}] missing or not a "
+                                f"{typ.__name__}")
+        for field in ("p50_s", "p95_s", "n"):
+            if isinstance(slo.get("global"), dict) \
+                    and field not in slo["global"]:
+                problems.append(f"slo['global'] missing {field!r}")
+        if isinstance(slo.get("tenants"), dict):
+            for t, pct in slo["tenants"].items():
+                if not isinstance(pct, dict) or "p95_s" not in pct:
+                    problems.append(f"slo['tenants'][{t!r}] malformed")
     try:
         json.dumps(report)
     except (TypeError, ValueError) as e:
@@ -235,6 +278,21 @@ def render_markdown(report):
                   "| site | builds | distinct keys |", "|---|---|---|"]
         lines += [f"| {s} | {d['builds']} | {d['distinct_keys']} |"
                   for s, d in r["jit_builds"].items()]
+    slo = r.get("slo") or {}
+    g = slo.get("global") or {}
+    if g.get("n"):
+        lines += ["", "## Latency SLO", "",
+                  "| tenant | p50_s | p95_s | n |", "|---|---|---|---|",
+                  f"| (all) | {g.get('p50_s')} | {g.get('p95_s')} | "
+                  f"{g.get('n')} |"]
+        lines += [f"| {t} | {p.get('p50_s')} | {p.get('p95_s')} | "
+                  f"{p.get('n')} |"
+                  for t, p in (slo.get("tenants") or {}).items()]
+    if slo.get("sites"):
+        lines += ["", "## Program cost ledger (steady medians)", "",
+                  "| site | median_s |", "|---|---|"]
+        lines += [f"| {s} | {m} |"
+                  for s, m in slo["sites"].items()]
     if r["quarantined"]:
         lines += ["", "## Quarantined epochs", "",
                   "| epoch | error class | error |", "|---|---|---|"]

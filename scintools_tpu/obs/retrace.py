@@ -42,10 +42,14 @@ class RetraceRegression(AssertionError):
     programs (see :func:`retrace_guard`)."""
 
 
-def record_build(site, key=None):
+def record_build(site, key=None, seconds=None):
     """Count one program build at ``site`` (call ONLY on a cache
     miss). ``key`` — the cache key, hashed for the distinct-geometry
-    count and then dropped."""
+    count and then dropped. ``seconds`` — the build's wall time when
+    the caller measured it (forwarded to the program cost ledger as
+    a ``compile`` sample; sites whose ``jax.jit`` compiles lazily
+    record it from the first invocation instead — see
+    ``thth.core.keyed_jit_cache``)."""
     site = str(site)
     with _LOCK:
         rec = _SITES.setdefault(site, {"builds": 0, "keys": set()})
@@ -60,7 +64,11 @@ def record_build(site, key=None):
     metrics.counter(
         "jit_builds_total",
         help="compiled-program builds per jit-cache site",
-    ).labels(site=site).inc()
+    ).labels(site=site).inc()  # lint-ok: metric-hygiene: bounded=site
+    if seconds is not None:
+        from . import ledger
+
+        ledger.record(site, seconds, "compile")
 
 
 def compile_counts():

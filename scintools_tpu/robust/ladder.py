@@ -107,7 +107,7 @@ def _record(report, epoch, stage, tier, exc, retry):
     _metrics.counter(
         "survey_fallback_transitions_total",
         help="failed ladder attempts (per tier that failed)",
-    ).labels(tier=str(tier)).inc()
+    ).labels(tier=str(tier)).inc()  # lint-ok: metric-hygiene: bounded=tier
     slog.log_failure("robust.fallback", epoch=epoch, stage=stage,
                      error=exc, tier=tier, retry=retry)
 

@@ -49,6 +49,7 @@ import time
 import numpy as np
 
 from ..obs import heartbeat as _hb
+from ..obs import ledger as _ledger
 from ..obs import metrics as _metrics
 from ..obs import report as _report
 from ..parallel.pipeline import AsyncJournalWriter, PrefetchLoader
@@ -118,7 +119,8 @@ class SurveyService:
                  report=True, on_published=None, process_batch=None,
                  max_batch=16, controller=None, tenant_policy=None,
                  geometry_fn=None, bucket_lanes=True,
-                 on_published_group=None):
+                 on_published_group=None, gain_schedule=True,
+                 tenant_label_cap=8):
         self.source = source
         self.process = process
         self.workdir = os.fspath(workdir)
@@ -164,6 +166,25 @@ class SurveyService:
             self.max_batch = self._controller.max_batch
         self._tenant_pending = {}    # tenant -> admitted-not-published
         self._staged_t = {}          # key -> staging-entry instant
+
+        # program cost ledger (ISSUE 20): batch service times feed
+        # the controller's gain scheduling, and the accumulated
+        # ledger persists per workdir (loaded here, saved at loop
+        # exit) so a restarted daemon resumes its cost model
+        self.gain_schedule = bool(gain_schedule)
+        self._buckets_seen = set()
+        self._ledger_path = _ledger.workdir_path(self.workdir)
+        _ledger.load(self._ledger_path)
+
+        # per-tenant SLO accounting (ISSUE 20): the first
+        # ``tenant_label_cap`` distinct tenants (by ingest order) get
+        # dedicated metric labels, later ones fold into "other" —
+        # tenant names are user-controlled strings, so every
+        # tenant-labeled metric goes through _tenant_label to keep
+        # label cardinality bounded (JL005)
+        self.tenant_label_cap = max(1, int(tenant_label_cap))
+        self._tenant_labels = {}
+        self._lat_by_tenant = {}     # label -> deque of latencies
 
         os.makedirs(self.workdir, exist_ok=True)
         self.store = ResultsStore(self.workdir, name=journal_name)
@@ -316,12 +337,17 @@ class SurveyService:
                         break
             self._writer.close()       # durability barrier (PR-2)
             self._rec.beat(force=True)
+            # persist the accumulated cost model next to the results
+            # journal: a restarted daemon loads it back and resumes
+            # gain scheduling with a warm cost model
+            _ledger.save(self._ledger_path)
             if self.report:
                 self._builder.finalize(
                     self.workdir, dict(self._rec.tally),
                     list(self._rec.outcomes),
                     timeline=self.timeline.summary(),
-                    extra=self._live_stats())
+                    extra=self._live_stats(),
+                    slo=self.slo_snapshot())
         except Exception as e:  # noqa: BLE001 — the loop must die
             # loudly: surfaced by /healthz (loop no longer ticking),
             # re-raised from stop()
@@ -353,6 +379,21 @@ class SurveyService:
 
     def _tick(self):
         self._last_tick = time.time()
+
+    def _tenant_label(self, tenant):
+        """The bounded metric label for a tenant namespace: the first
+        ``tenant_label_cap`` distinct tenants keep their own label,
+        every later one is ``"other"`` — tenant names come off the
+        spool (user-controlled), and an unbounded label set is a
+        cardinality leak (JL005). The mapping is sticky for the
+        process lifetime. Callers hold ``self._lock``."""
+        lbl = self._tenant_labels.get(tenant)
+        if lbl is None:
+            lbl = str(tenant) \
+                if len(self._tenant_labels) < self.tenant_label_cap \
+                else "other"
+            self._tenant_labels[tenant] = lbl
+        return lbl
 
     def _pull_arrivals(self):
         while self._fresh_q.qsize() < max(2, self.prefetch):
@@ -405,7 +446,8 @@ class SurveyService:
                 _metrics.counter(
                     "serve_tenant_rejected_total",
                     help="arrivals refused by per-tenant admission "
-                         "control").labels(tenant=tenant).inc()
+                         "control").labels(
+                    tenant=self._tenant_label(tenant)).inc()  # lint-ok: metric-hygiene: bounded=tenant
                 slog.log_event("serve.tenant_rejected", epoch=key,
                                tenant=tenant,
                                pending=self._tenant_pending.get(
@@ -419,7 +461,7 @@ class SurveyService:
             _metrics.counter(
                 "serve_tenant_ingested_total",
                 help="fresh epochs admitted, by tenant namespace"
-            ).labels(tenant=tenant).inc()
+            ).labels(tenant=self._tenant_label(tenant)).inc()  # lint-ok: metric-hygiene: bounded=tenant
             self._tenant_pending[tenant] = \
                 self._tenant_pending.get(tenant, 0) + 1
             self._rec.tally["n_epochs"] += 1
@@ -446,7 +488,8 @@ class SurveyService:
                 (key, None,
                  _runner._loader_outcome(key, loaded.error), None))
             return
-        with self.timeline.span(key, "dispatch"):
+        with _ledger.timed("serve.batch", shape=1), \
+                self.timeline.span(key, "dispatch"):
             entry = _runner._dispatch_first(
                 key, loaded.payload, self.process, self.tiers,
                 self.retries, self.validate)
@@ -510,7 +553,8 @@ class SurveyService:
             with self._lock:
                 st = self._states.get(key, {})
                 st["status"] = "in_flight"
-            with self.timeline.span(key, "dispatch"):
+            with _ledger.timed("serve.batch", shape=1), \
+                    self.timeline.span(key, "dispatch"):
                 entry = _runner._dispatch_first(
                     key, payload, self.process, self.tiers,
                     self.retries, self.validate)
@@ -581,6 +625,11 @@ class SurveyService:
             lambda eid, out: outs.append((eid, out)),
             epoch_label=f"group[{keys[0]}+{len(entries)}]")
         t1 = time.perf_counter()
+        # the measured per-bucket batch service time — the gain
+        # scheduler's input and the /ledger endpoint's content
+        _ledger.record("serve.batch", t1 - t0, "steady", shape=bucket)
+        self._buckets_seen.add(int(bucket))
+        self._reschedule_controller()
         for key in keys:
             # the batched program is the device stage: dispatch +
             # compute + fetch for every lane in one span
@@ -593,6 +642,43 @@ class SurveyService:
             self._publish(out)
             self._run_hooks(eid, payloads.get(str(eid)), out)
         self._run_group_hooks(entries, dict(outs))
+
+    def _reschedule_controller(self):
+        """Gain-schedule the batch controller from the ledger's
+        measured per-bucket service time (ISSUE 20, ROADMAP 2d): the
+        steady median of a 1-lane dispatch vs the widest observed
+        bucket decides how amortised batching actually is, and the
+        controller interpolates gain/decay accordingly (compute-bound
+        lanes → under-track the backlog, less padding waste, faster
+        drain). With no 1-lane samples (sustained load batches
+        everything) T(1) is extrapolated from the two observed bucket
+        extremes under a linear cost model. Runs once per dispatched
+        group — a few ring-buffer median queries, microseconds
+        against a batch program."""
+        if self._controller is None or not self.gain_schedule \
+                or not self._buckets_seen:
+            return
+        b = max(self._buckets_seen)
+        if b <= 1:
+            return
+        tb = _ledger.steady_median("serve.batch", shape=b)
+        t1 = _ledger.steady_median("serve.batch", shape=1)
+        if t1 is None and len(self._buckets_seen) >= 2 and tb:
+            # a daemon under sustained load never dispatches a single
+            # lane, so T(1) may be unmeasured; estimate it from the
+            # smallest and widest observed buckets via the linear
+            # cost model t(b) = c_fixed + c_lane * b
+            b0 = min(self._buckets_seen)
+            t0 = _ledger.steady_median("serve.batch", shape=b0)
+            if t0 and b > b0:
+                c_lane = (tb - t0) / (b - b0)
+                t1 = max(t0 - c_lane * (b0 - 1), 1e-9)
+        factor = self._controller.reschedule(t1, tb, b)
+        if factor is not None:
+            _metrics.gauge(
+                "serve_controller_gain",
+                help="gain-scheduled batch controller gain",
+            ).set(self._controller.gain)
 
     def _consume_one(self):
         # lint-ok: lock-discipline: loop-thread-only window (see
@@ -691,6 +777,7 @@ class SurveyService:
                 st["error_class"] = out.error_class
             t_pub = time.perf_counter()
             t_in = st.get("t_ingest")
+            tenant = st.get("tenant")
             if t_in is not None:
                 lat = t_pub - t_in
                 st["latency_s"] = round(lat, 6)
@@ -699,9 +786,19 @@ class SurveyService:
                     "serve_e2e_latency_seconds",
                     help="ingest-to-published end-to-end latency",
                     buckets=LATENCY_BUCKETS).observe(lat)
+                if tenant is not None:
+                    # per-tenant SLO view (ISSUE 20): same family,
+                    # bounded tenant label (top-K + "other")
+                    lbl = self._tenant_label(tenant)
+                    _metrics.histogram(
+                        "serve_e2e_latency_seconds",
+                        help="ingest-to-published end-to-end latency",
+                        buckets=LATENCY_BUCKETS).labels(
+                        tenant=lbl).observe(lat)  # lint-ok: metric-hygiene: bounded=tenant
+                    self._lat_by_tenant.setdefault(
+                        lbl, collections.deque(maxlen=1024)).append(lat)
             self.store.note_published(key, st.get("sha"))
             self._inflight_sha.pop(st.get("sha"), None)
-            tenant = st.get("tenant")
             if tenant is not None:
                 pend = self._tenant_pending.get(tenant, 0)
                 if pend > 0:
@@ -709,12 +806,13 @@ class SurveyService:
                 _metrics.counter(
                     "serve_tenant_published_total",
                     help="published epochs, by tenant namespace"
-                ).labels(tenant=tenant).inc()
+                ).labels(tenant=self._tenant_label(tenant)).inc()  # lint-ok: metric-hygiene: bounded=tenant
                 if out.status == "quarantined":
                     _metrics.counter(
                         "serve_tenant_quarantined_total",
                         help="quarantined epochs, by tenant "
-                             "namespace").labels(tenant=tenant).inc()
+                             "namespace").labels(
+                        tenant=self._tenant_label(tenant)).inc()  # lint-ok: metric-hygiene: bounded=tenant
         self.timeline.record(key, "publish", t0, time.perf_counter())
         if out.status == "ok":
             # lint-ok: lock-discipline: monotonic False→True latch,
@@ -758,12 +856,39 @@ class SurveyService:
                 "p95_s": round(float(np.percentile(lat, 95)), 6),
                 "n": len(lat)}
 
+    def tenant_latency_percentiles(self):
+        """Per-tenant-label ``{"p50_s":, "p95_s":, "n":}`` over the
+        recent latencies — keys are the BOUNDED labels
+        (:meth:`_tenant_label`: top-K tenants + ``"other"``), the
+        per-tenant SLO view heartbeats and the RunReport carry."""
+        # lock-free like latency_percentiles: C-level dict/deque
+        # copies under the GIL; heartbeats call this from inside
+        # _publish (which holds self._lock), so taking the lock here
+        # would self-deadlock
+        by = {lbl: list(q) for lbl, q in
+              list(self._lat_by_tenant.items()) if q}
+        return {lbl: {"p50_s": round(float(np.percentile(lat, 50)), 6),
+                      "p95_s": round(float(np.percentile(lat, 95)), 6),
+                      "n": len(lat)}
+                for lbl, lat in sorted(by.items())}
+
+    def slo_snapshot(self):
+        """The RunReport ``slo`` block (ISSUE 20): global + per-tenant
+        latency percentiles plus the ledger's per-site steady medians
+        (``{"global":, "tenants":, "sites":}``)."""
+        return {"global": self.latency_percentiles(),
+                "tenants": self.tenant_latency_percentiles(),
+                "sites": _ledger.LEDGER.steady_site_medians()}
+
     def _live_stats(self):
         stats = {"backlog": self.backlog()}
         pct = self.latency_percentiles()
         if pct["n"]:
             stats["latency_p50_s"] = pct["p50_s"]
             stats["latency_p95_s"] = pct["p95_s"]
+        tenants = self.tenant_latency_percentiles()
+        if tenants:
+            stats["tenants"] = tenants
         return stats
 
     def healthy(self):
@@ -811,7 +936,8 @@ class SurveyService:
             tally, outcomes, timeline=self.timeline.summary(),
             extra={**self._live_stats(),
                    "latency": self.latency_percentiles()},
-            in_progress=not self._done.is_set())
+            in_progress=not self._done.is_set(),
+            slo=self.slo_snapshot())
 
     def state_snapshot(self):
         """Per-epoch status map (the ``/state`` answer):

@@ -35,6 +35,9 @@ path        answer
 /state      per-epoch status map: queued / in_flight / ok /
             quarantined / resumed / duplicate, with latency and
             backlog
+/ledger     the program cost ledger snapshot (obs/ledger.py):
+            per-(site, platform, shape, formulation) compile
+            totals and steady-time stats
 ==========  =====================================================
 
 A route is ``path -> fn(service) -> (status, body, content_type)``;
@@ -84,6 +87,19 @@ def snapshot_route(method_name):
     return route
 
 
+def ledger_route(service):
+    """``/ledger``: the program cost ledger snapshot (ISSUE 20). A
+    view object may supply its own ``ledger_snapshot()``; otherwise
+    the process-wide ledger answers — the daemon's ledger IS the
+    process ledger."""
+    fn = getattr(service, "ledger_snapshot", None)
+    if fn is not None:
+        return 200, fn(), None
+    from ..obs import ledger as _ledger
+
+    return 200, _ledger.snapshot(), None
+
+
 def daemon_routes():
     """The streaming daemon's handler table (the docs/serving.md
     endpoint table is this dict, rendered)."""
@@ -93,6 +109,7 @@ def daemon_routes():
         "/readyz": probe_route("ready"),
         "/report": snapshot_route("report_snapshot"),
         "/state": snapshot_route("state_snapshot"),
+        "/ledger": ledger_route,
     }
 
 
@@ -143,6 +160,15 @@ class TelemetryServer:
         return f"http://{self.host}:{self.port}"
 
 
+def _bounded_path(path, routes):
+    """The bounded ``path`` metric label for a request: known route
+    paths (and ``/``) keep their own label, anything else folds into
+    ``"other"`` — request paths are caller-controlled strings, and
+    before this bound every scanner probing random URLs minted a new
+    label child (JL005 unbounded-cardinality)."""
+    return path if path == "/" or path in routes else "other"
+
+
 def _make_handler(service, routes, metric_prefix):
     """A request-handler class bound to one service instance and its
     route table."""
@@ -172,7 +198,7 @@ def _make_handler(service, routes, metric_prefix):
             _metrics.counter(
                 f"{metric_prefix}_requests_total",
                 help="telemetry requests served",
-            ).labels(path=path).inc()
+            ).labels(path=_bounded_path(path, routes)).inc()
             try:
                 route = routes.get(path)
                 if path == "/":
@@ -203,7 +229,7 @@ def _make_handler(service, routes, metric_prefix):
                 _metrics.histogram(
                     f"{metric_prefix}_request_seconds",
                     help="telemetry request handling wall time",
-                ).labels(path=path).observe(
+                ).labels(path=_bounded_path(path, routes)).observe(
                     time.perf_counter() - t0)
 
     return Handler

@@ -44,6 +44,32 @@ from __future__ import annotations
 from collections import OrderedDict, deque
 
 
+def amortisation_factor(t1, tb, b):
+    """How much batching amortises, from measured service times:
+    ``t1`` — median seconds of a 1-lane batch, ``tb`` — median
+    seconds of a ``b``-lane batch (the program cost ledger's
+    ``serve.batch`` site supplies both).
+
+    Returns a factor in [0, 1]: 1 when the batch costs the same as a
+    single dispatch (fixed dispatch cost dominates — lanes are free,
+    batch as wide as possible), 0 when the batch costs ``b`` single
+    dispatches (compute-bound — lanes are marginal cost, and padding
+    up to power-of-two buckets burns real seconds). Derived from the
+    marginal-lane-cost ratio ``rho = (tb / b) / t1`` normalised so
+    perfect amortisation (``rho = 1/b``) maps to 1 and none
+    (``rho = 1``) to 0. None when the inputs can't support the
+    estimate (missing samples, b <= 1)."""
+    try:
+        t1, tb, b = float(t1), float(tb), int(b)
+    except (TypeError, ValueError):
+        return None
+    if t1 <= 0.0 or tb <= 0.0 or b <= 1:
+        return None
+    rho = (tb / b) / t1
+    factor = (1.0 - rho) / (1.0 - 1.0 / b)
+    return min(1.0, max(0.0, factor))
+
+
 def bucket_size(n, cap):
     """Smallest power-of-two >= ``n``, clipped to ``cap`` (``cap``
     itself is always a valid bucket, power of two or not)."""
@@ -82,7 +108,8 @@ class AdaptiveBatchController:
     target, so the step response is unit-testable without a daemon.
     """
 
-    def __init__(self, max_batch=16, gain=1.0, decay=0.5):
+    def __init__(self, max_batch=16, gain=1.0, decay=0.5,
+                 min_gain=0.25, min_decay=0.25):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1: {max_batch}")
         if not 0.0 <= decay < 1.0:
@@ -90,11 +117,39 @@ class AdaptiveBatchController:
         self.max_batch = int(max_batch)
         self.gain = float(gain)
         self.decay = float(decay)
+        # the configured law is the ceiling the scheduler works under
+        self._base_gain = float(gain)
+        self._base_decay = float(decay)
+        self.min_gain = float(min_gain)
+        self.min_decay = float(min_decay)
         self._b = 1
 
     @property
     def current(self):
         return self._b
+
+    def reschedule(self, t1, tb, b):
+        """Gain-schedule the law from measured batch service time
+        (ISSUE 20, ROADMAP item 2d): interpolate ``gain``/``decay``
+        between the configured values (fully-amortised batching —
+        the constant-lane-cost assumption holds) and
+        ``min_gain``/``min_decay`` (compute-bound — each lane costs
+        real seconds, so B should under-track the backlog to cut
+        power-of-two padding waste and drain faster at lulls).
+
+        ``t1``/``tb``/``b`` as in :func:`amortisation_factor`;
+        typically the ledger's ``serve.batch`` steady medians for
+        bucket 1 and the widest observed bucket ``b``. Returns the
+        factor applied, or None (law untouched) when the measurement
+        can't support one."""
+        factor = amortisation_factor(t1, tb, b)
+        if factor is None:
+            return None
+        lo_g = min(self.min_gain, self._base_gain)
+        lo_d = min(self.min_decay, self._base_decay)
+        self.gain = lo_g + (self._base_gain - lo_g) * factor
+        self.decay = lo_d + (self._base_decay - lo_d) * factor
+        return factor
 
     def observe(self, backlog):
         target = int(-(-self.gain * max(0, backlog) // 1))  # ceil

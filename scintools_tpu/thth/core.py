@@ -348,7 +348,10 @@ def keyed_jit_cache(cache, key, builder, maxsize=32,
     ``site`` names this cache in the retrace/compile accounting
     (obs/retrace.py): every MISS is one recorded program build, which
     the tier-1 ``retrace_guard`` gate and the RunReport's
-    ``jit_builds`` table read back."""
+    ``jit_builds`` table read back — and the program cost ledger
+    (obs/ledger.py) gets the build's compile seconds, measured on the
+    first invocation (``jax.jit`` compiles lazily, so the MISS itself
+    costs microseconds; the first call carries trace + XLA compile)."""
     fn = cache.get(key)
     if fn is None:
         from ..obs import retrace as _retrace
@@ -357,11 +360,38 @@ def keyed_jit_cache(cache, key, builder, maxsize=32,
         kwargs = {}
         if donate_argnums is not None:
             kwargs["donate_argnums"] = donate_argnums
-        fn = get_jax().jit(builder(), **kwargs)
+        fn = _compile_timed(get_jax().jit(builder(), **kwargs),
+                            cache, key, site or "thth.keyed_jit")
         if len(cache) >= maxsize:
             cache.pop(next(iter(cache)))
         cache[key] = fn
     return fn
+
+
+def _compile_timed(raw, cache, key, site):
+    """First-call timing shim over a freshly-jitted kernel: the first
+    invocation (which carries trace + XLA compile) is timed into the
+    program cost ledger as a ``compile`` sample, then the raw jitted
+    fn is swapped back into the cache — steady-state cache hits
+    dispatch with zero wrapper overhead."""
+    import time as _time
+
+    done = [False]
+
+    def wrapper(*args, **kw):
+        if done[0]:
+            return raw(*args, **kw)
+        t0 = _time.perf_counter()
+        out = raw(*args, **kw)
+        done[0] = True
+        from ..obs import ledger as _ledger
+
+        _ledger.record(site, _time.perf_counter() - t0, "compile")
+        if cache.get(key) is wrapper:
+            cache[key] = raw
+        return out
+
+    return wrapper
 
 
 _EVAL_JIT_CACHE = {}

@@ -58,10 +58,14 @@ def _isolate_observability():
     jit-build accounting (obs.retrace) is deliberately NOT reset: the
     program caches it mirrors are process-wide, and zeroing the
     counts while the caches stay warm would let a retrace_guard pass
-    vacuously."""
+    vacuously. The program cost ledger (obs.ledger) IS reset — its
+    samples are pure timing data, so a fresh ledger per test keeps
+    steady medians from bleeding across tests without weakening any
+    guard."""
     slog.reset()
     obs.metrics.REGISTRY.reset()
     obs.metrics.set_enabled(True)
+    obs.ledger.reset()
     yield
     slog.reset()
 

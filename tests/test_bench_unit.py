@@ -230,6 +230,25 @@ class TestBenchPlan:
             assert set(est) == {"acc", "cpu"}
 
 
+class TestBenchCLI:
+    def test_list_prints_plan_with_estimates(self, capsys):
+        import bench
+
+        bench.main(["--list"])
+        out = capsys.readouterr().out
+        names = [ln.split()[0] for ln in out.strip().splitlines()]
+        assert set(names) == set(bench._EST_S)
+        assert "accelerator" in out and "cpu" in out
+
+    def test_unknown_config_is_a_usage_error(self, capsys):
+        import bench
+
+        with pytest.raises(SystemExit):
+            bench.main(["--config", "not_a_config"])
+        err = capsys.readouterr().err
+        assert "unknown config(s)" in err and "--list" in err
+
+
 class TestProbe:
     def test_no_probe_env_short_circuits(self):
         env = dict(os.environ, SCINTOOLS_BENCH_NO_PROBE="1")

@@ -309,6 +309,87 @@ class TestMetricHygiene:
 
 
 # =====================================================================
+# JL005 label cardinality (ISSUE 20)
+# =====================================================================
+
+class TestMetricLabelCardinality:
+    """The ``.labels(...)`` extension: non-literal label values must
+    come from a bounding helper or carry a ``bounded=<label>`` marker
+    token — every distinct runtime string otherwise mints a new
+    metric child."""
+
+    def catalog(self, tmp_path, *names):
+        doc = tmp_path / "catalog.md"
+        doc.write_text("\n".join(f"`{n}`" for n in names))
+        return Config(obs_docs=[str(doc)])
+
+    def test_nonliteral_label_value_flagged(self, tmp_path):
+        cfg = self.catalog(tmp_path, "hits_total")
+        src = "_metrics.counter('hits_total').labels(tenant=t).inc()\n"
+        out = scan("metric-hygiene", src, config=cfg)
+        assert len(out) == 1
+        assert "label 'tenant'" in out[0].message
+        assert "unbounded cardinality" in out[0].message
+
+    def test_literal_label_value_passes(self, tmp_path):
+        cfg = self.catalog(tmp_path, "hits_total")
+        src = ("_metrics.counter('hits_total')"
+               ".labels(tenant='alice').inc()\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+    def test_bounding_helper_passes(self, tmp_path):
+        cfg = self.catalog(tmp_path, "req_total", "lat_seconds")
+        src = ("_metrics.counter('req_total')"
+               ".labels(path=_bounded_path(p, routes)).inc()\n"
+               "_metrics.histogram('lat_seconds')"
+               ".labels(tenant=self._tenant_label(t)).observe(dt)\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+    def test_bounded_marker_passes(self, tmp_path):
+        cfg = self.catalog(tmp_path, "hits_total")
+        src = ("_metrics.counter('hits_total').labels(site=site)"
+               ".inc()  # lint-ok: metric-hygiene: bounded=site\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+    def test_marker_names_only_its_label(self, tmp_path):
+        cfg = self.catalog(tmp_path, "hits_total")
+        src = ("_metrics.counter('hits_total')"
+               ".labels(site=site, tenant=t)"
+               ".inc()  # lint-ok: metric-hygiene: bounded=site\n")
+        out = scan("metric-hygiene", src, config=cfg)
+        assert len(out) == 1 and "label 'tenant'" in out[0].message
+
+    def test_multiline_chain_marker_recognised(self, tmp_path):
+        # a chained .labels() node STARTS at the receiver's first
+        # line; the trailing marker lives at end_lineno and must
+        # still be found
+        cfg = self.catalog(tmp_path, "hits_total")
+        src = ("_metrics.counter(\n"
+               "    'hits_total',\n"
+               "    help='h',\n"
+               ").labels(site=site).inc()"
+               "  # lint-ok: metric-hygiene: bounded=site\n")
+        assert scan("metric-hygiene", src, config=cfg) == []
+
+    def test_bounded_only_payload_not_a_grandfather(self, tmp_path):
+        # bounded= tokens are label triage, NOT a name-check escape:
+        # the off-catalog name must still be flagged
+        cfg = self.catalog(tmp_path, "known_total")
+        src = ("_metrics.counter('unknown_total').labels(site=site)"
+               ".inc()  # lint-ok: metric-hygiene: bounded=site\n")
+        out = scan("metric-hygiene", src, config=cfg)
+        assert len(out) == 1
+        assert "not in the documented catalog" in out[0].message
+
+    def test_star_star_labels_flagged(self, tmp_path):
+        cfg = self.catalog(tmp_path, "hits_total")
+        src = "_metrics.counter('hits_total').labels(**kw).inc()\n"
+        out = scan("metric-hygiene", src, config=cfg)
+        assert len(out) == 1
+        assert "hides the label names" in out[0].message
+
+
+# =====================================================================
 # JL006 fsops-seam (ISSUE 17)
 # =====================================================================
 

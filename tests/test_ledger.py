@@ -1,0 +1,327 @@
+"""Program cost ledger + measured formulation tables (ISSUE 20).
+
+Gates, in order:
+
+- ledger core: keyed recording, shape/formulation filtering of
+  steady medians, the ``set_enabled`` no-op gate, the ``timed``
+  context manager (records even when the block raises);
+- persistence: the atomic CRC-JSONL dialect round-trips, a torn
+  tail (SIGKILL mid-line) loses only that line, a corrupt crc is
+  skipped, a missing file is an empty ledger;
+- formulation precedence, pinned end-to-end: explicit
+  ``set_formulation`` override > ``SCINTOOLS_FORMULATION_<OP>`` env
+  pin > measured per-platform table > registered platform table >
+  registered default — and an invalid measured choice (stale
+  committed table) silently degrades to the registered resolution;
+- the committable table file: ``save_formulation_table`` writes
+  winners + raw seconds, a FRESH registry auto-loads it, and a
+  separate PROCESS resolves the measured winner with no env pins
+  (the workflow performance.md documents);
+- gain scheduling (serve/lanes.py): ``amortisation_factor`` at the
+  launch-bound and compute-bound extremes, ``reschedule``
+  interpolating gain/decay, and the daemon's T(1) extrapolation
+  fallback for sustained-load ledgers with no single-dispatch
+  samples.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from scintools_tpu import backend
+from scintools_tpu.obs import ledger as obs_ledger
+from scintools_tpu.obs import metrics as obs_metrics
+from scintools_tpu.obs.ledger import ProgramLedger
+from scintools_tpu.serve import QueueSource, SurveyService
+from scintools_tpu.serve.lanes import (AdaptiveBatchController,
+                                       amortisation_factor)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# =====================================================================
+# ledger core
+# =====================================================================
+
+class TestLedgerCore:
+    def test_keyed_recording_and_median_filters(self):
+        led = ProgramLedger()
+        for s in (0.1, 0.2, 0.3):
+            led.record("site.a", s, shape=4, formulation="dense")
+        led.record("site.a", 9.0, shape=8, formulation="dense")
+        led.record("site.b", 5.0)
+        assert led.steady_median("site.a", shape=4) == pytest.approx(0.2)
+        assert led.steady_median("site.a", shape=8) == pytest.approx(9.0)
+        # no shape filter → samples pool across shapes
+        assert led.steady_median("site.a") == pytest.approx(0.25)
+        assert led.steady_median("site.c") is None
+
+    def test_disabled_gate_is_a_noop(self):
+        led = ProgramLedger()
+        obs_metrics.set_enabled(False)
+        try:
+            led.record("site.a", 1.0)
+        finally:
+            obs_metrics.set_enabled(True)
+        assert led.steady_median("site.a") is None
+        led.record("site.a", 1.0)
+        assert led.steady_median("site.a") == pytest.approx(1.0)
+
+    def test_timed_records_even_on_raise(self):
+        led = ProgramLedger()
+        with pytest.raises(RuntimeError):
+            with led.timed("site.x"):
+                raise RuntimeError("program died")
+        assert led.steady_median("site.x") is not None
+
+    def test_ring_bounds_memory(self):
+        led = ProgramLedger(ring=4)
+        for s in range(100):
+            led.record("s", float(s))
+        snap = led.snapshot()
+        assert snap["entries"][0]["steady_n"] == 4
+
+    def test_compile_kind_totals(self):
+        led = ProgramLedger()
+        led.record("site.c", 1.5, kind="compile")
+        led.record("site.c", 0.5, kind="compile")
+        row = led.snapshot()["entries"][0]
+        assert row["compile_s"] == pytest.approx(2.0)
+        assert row["compile_n"] == 2
+        assert led.steady_median("site.c") is None
+
+    def test_module_singleton_mirrors_metrics(self):
+        obs_ledger.record("site.m", 0.01, formulation="czt")
+        snap = obs_metrics.snapshot()
+        fams = snap["histograms"]
+        assert any(k.startswith("program_steady_seconds")
+                   for k in fams)
+
+
+# =====================================================================
+# persistence: atomic CRC-JSONL
+# =====================================================================
+
+class TestLedgerPersistence:
+    def _filled(self):
+        led = ProgramLedger()
+        led.record("a", 0.1, shape=4, formulation="dense")
+        led.record("a", 0.3, shape=4, formulation="dense")
+        led.record("b", 2.5, kind="compile")
+        return led
+
+    def test_round_trip(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        self._filled().save(path)
+        fresh = ProgramLedger()
+        assert fresh.load(path) == 2
+        assert fresh.steady_median("a", shape=4) == pytest.approx(0.2)
+        row = [r for r in fresh.snapshot()["entries"]
+               if r["site"] == "b"][0]
+        assert row["compile_s"] == pytest.approx(2.5)
+
+    def test_every_line_carries_a_valid_crc(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        self._filled().save(path)
+        for line in path.read_text().splitlines():
+            rec = json.loads(line)
+            crc = rec.pop("crc")
+            assert crc == obs_ledger._line_crc(json.dumps(rec))
+
+    def test_torn_tail_loses_only_the_last_line(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        self._filled().save(path)
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-9])        # SIGKILL mid-final-line
+        fresh = ProgramLedger()
+        assert fresh.load(path) == 1
+        assert fresh.steady_median("a", shape=4) == pytest.approx(0.2)
+
+    def test_corrupt_crc_line_skipped(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        self._filled().save(path)
+        lines = path.read_text().splitlines()
+        lines[0] = lines[0].replace('"crc": "', '"crc": "f00d')
+        path.write_text("\n".join(lines) + "\n")
+        fresh = ProgramLedger()
+        assert fresh.load(path) == 1
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert ProgramLedger().load(tmp_path / "nope.jsonl") == 0
+
+    def test_load_merges_into_live_entries(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        self._filled().save(path)
+        led = ProgramLedger()
+        led.record("a", 0.2, shape=4, formulation="dense")
+        led.load(path)
+        row = [r for r in led.snapshot()["entries"]
+               if r["site"] == "a"][0]
+        assert row["steady_n"] == 3       # 1 live + 2 merged
+
+
+# =====================================================================
+# formulation precedence + committable tables
+# =====================================================================
+
+OP = "testledger.op"
+
+
+@pytest.fixture
+def table_sandbox(tmp_path, monkeypatch):
+    """A registered synthetic op + a private table dir, with every
+    layer of resolution state restored afterwards."""
+    backend.register_formulation(
+        OP, default="slow", choices=("slow", "fast", "tuned"),
+        platforms={"cpu": "fast"})
+    monkeypatch.setenv("SCINTOOLS_FORMULATION_TABLES", str(tmp_path))
+    backend.reset_measured_formulations()
+    yield tmp_path
+    backend.set_formulation(OP, None)
+    backend.reset_measured_formulations()
+    backend._FORMULATIONS.pop(OP, None)
+
+
+class TestFormulationPrecedence:
+    def test_full_order_pinned(self, table_sandbox, monkeypatch):
+        # registered platform table beats the default...
+        assert backend.formulation(OP, platform="cpu") == "fast"
+        assert backend.formulation(OP, platform="tpu") == "slow"
+        # ...the measured table beats the registered one...
+        backend.record_measured_formulation(OP, "tuned",
+                                            platform="cpu")
+        assert backend.formulation(OP, platform="cpu") == "tuned"
+        # ...the env pin beats measured...
+        monkeypatch.setenv("SCINTOOLS_FORMULATION_TESTLEDGER_OP",
+                           "slow")
+        assert backend.formulation(OP, platform="cpu") == "slow"
+        # ...and the explicit override beats everything
+        backend.set_formulation(OP, "fast")
+        assert backend.formulation(OP, platform="cpu") == "fast"
+
+    def test_invalid_measured_choice_skipped(self, table_sandbox):
+        path = backend.formulation_table_path("cpu")
+        with open(path, "w") as fh:
+            json.dump({"platform": "cpu", "ops": {
+                OP: {"choice": "renamed_away"}}}, fh)
+        backend.reset_measured_formulations()
+        # stale committed table degrades to the registered resolution
+        assert backend.formulation(OP, platform="cpu") == "fast"
+
+    def test_save_then_fresh_reload_resolves_winner(
+            self, table_sandbox):
+        backend.record_measured_formulation(
+            OP, "tuned", seconds={"tuned": 0.1, "fast": 0.4},
+            platform="cpu", persist=True)
+        path = backend.formulation_table_path("cpu")
+        assert os.path.exists(path)
+        data = json.loads(open(path).read())
+        assert data["ops"][OP]["choice"] == "tuned"
+        assert data["ops"][OP]["seconds"]["fast"] == pytest.approx(0.4)
+        # a fresh registry (new process stand-in) auto-loads the file
+        backend.reset_measured_formulations()
+        assert backend.formulation(OP, platform="cpu") == "tuned"
+
+    def test_snapshot_carries_measured_layer(self, table_sandbox):
+        backend.record_measured_formulation(OP, "tuned",
+                                            platform="cpu")
+        snap = backend.formulation_snapshot()
+        assert snap[OP]["measured"] == "tuned"
+
+    def test_cross_process_auto_load(self, table_sandbox):
+        """The committed-table workflow across a REAL process
+        boundary: this process measures and persists, a child
+        process with no env pins resolves the measured winner."""
+        backend.record_measured_formulation(OP, "tuned",
+                                            platform="cpu",
+                                            persist=True)
+        child = (
+            "from scintools_tpu import backend\n"
+            f"backend.register_formulation({OP!r}, default='slow',"
+            " choices=('slow', 'fast', 'tuned'),"
+            " platforms={'cpu': 'fast'})\n"
+            f"print(backend.formulation({OP!r}, platform='cpu'))\n")
+        env = dict(os.environ,
+                   SCINTOOLS_FORMULATION_TABLES=str(table_sandbox),
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", child], env=env,
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip().splitlines()[-1] == "tuned"
+
+
+# =====================================================================
+# gain scheduling
+# =====================================================================
+
+class TestGainScheduling:
+    def test_amortisation_factor_extremes(self):
+        # launch-bound: a batch of 8 costs the same as one dispatch
+        assert amortisation_factor(0.1, 0.1, 8) == pytest.approx(1.0)
+        # compute-bound: each lane pays the full single cost
+        assert amortisation_factor(0.1, 0.8, 8) == pytest.approx(0.0)
+        # halfway amortised lands strictly between
+        assert 0.0 < amortisation_factor(0.1, 0.45, 8) < 1.0
+
+    def test_amortisation_factor_clips(self):
+        # better-than-free batching (noise) and worse-than-linear
+        # both clip into [0, 1]
+        assert amortisation_factor(0.1, 0.05, 8) == 1.0
+        assert amortisation_factor(0.1, 2.0, 8) == 0.0
+        assert amortisation_factor(None, 0.1, 8) is None
+        assert amortisation_factor(0.1, None, 8) is None
+
+    def test_reschedule_interpolates_gain_and_decay(self):
+        c = AdaptiveBatchController(max_batch=16, gain=1.0, decay=0.5)
+        # compute-bound evidence → floor the law
+        assert c.reschedule(0.1, 0.8, 8) == pytest.approx(0.0)
+        assert c.gain == pytest.approx(c.min_gain)
+        assert c.decay == pytest.approx(c.min_decay)
+        # launch-bound evidence → back to the base law
+        assert c.reschedule(0.1, 0.1, 8) == pytest.approx(1.0)
+        assert c.gain == pytest.approx(1.0)
+        assert c.decay == pytest.approx(0.5)
+        # no evidence → no change
+        assert c.reschedule(None, 0.1, 8) is None
+        assert c.gain == pytest.approx(1.0)
+
+    def test_daemon_t1_extrapolation_fallback(self, tmp_path):
+        """A sustained-load ledger has NO single-dispatch samples;
+        the daemon extrapolates T(1) from two bucket extremes via
+        the linear cost model and still floors the gain on
+        compute-bound evidence."""
+        def process_batch(payloads, tier=None):
+            return list(payloads)
+
+        svc = SurveyService(QueueSource(), lambda p, tier=None: p,
+                            tmp_path / "run",
+                            process_batch=process_batch,
+                            geometry_fn=lambda p: (1,), max_batch=8)
+        # compute-bound: t(b) = 0.001 + 0.1*b  (c_lane ≈ t1)
+        for _ in range(3):
+            obs_ledger.record("serve.batch", 0.401, shape=4)
+            obs_ledger.record("serve.batch", 0.801, shape=8)
+        svc._buckets_seen.update({4, 8})
+        svc._reschedule_controller()
+        assert svc._controller.gain == pytest.approx(
+            svc._controller.min_gain, abs=0.05)
+
+    def test_daemon_gain_schedule_opt_out(self, tmp_path):
+        def process_batch(payloads, tier=None):
+            return list(payloads)
+
+        svc = SurveyService(QueueSource(), lambda p, tier=None: p,
+                            tmp_path / "run",
+                            process_batch=process_batch,
+                            geometry_fn=lambda p: (1,), max_batch=8,
+                            gain_schedule=False)
+        for _ in range(3):
+            obs_ledger.record("serve.batch", 0.401, shape=4)
+            obs_ledger.record("serve.batch", 0.801, shape=8)
+        svc._buckets_seen.update({4, 8})
+        svc._reschedule_controller()
+        assert svc._controller.gain == pytest.approx(1.0)

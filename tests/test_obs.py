@@ -324,6 +324,35 @@ class TestRunReport:
         with pytest.raises(ValueError, match="missing"):
             report.validate_run_report({"schema_version": 1})
 
+    def test_slo_block_fed_by_cost_ledger(self, tmp_path):
+        from scintools_tpu.obs import ledger as obs_ledger
+
+        obs_ledger.record("site.pinned", 0.125)
+        out = self._run(tmp_path)
+        assert out is not None
+        rep = json.loads((tmp_path / "run_report.json").read_text())
+        slo = rep["slo"]
+        # batch runners have no per-tenant latency, but every runner
+        # has a cost ledger — the sites view fills in
+        assert set(slo) == {"global", "tenants", "sites"}
+        assert slo["sites"]["site.pinned"] == pytest.approx(0.125)
+        assert set(slo["global"]) >= {"p50_s", "p95_s", "n"}
+
+    def test_validator_rejects_malformed_slo(self):
+        good = report.build_run_report(
+            {"n_epochs": 1, "n_ok": 1, "n_quarantined": 0,
+             "n_resumed": 0, "retries": 0, "tier_counts": {}},
+            wall_s=1.0)
+        with pytest.raises(ValueError, match="slo"):
+            report.validate_run_report(dict(good, slo=[]))
+        bad = dict(good, slo={"global": {}, "tenants": {}, "sites": {}})
+        with pytest.raises(ValueError, match="p50_s"):
+            report.validate_run_report(bad)
+        bad = dict(good, slo=dict(good["slo"],
+                                  tenants={"t": "oops"}))
+        with pytest.raises(ValueError, match="tenants"):
+            report.validate_run_report(bad)
+
     def test_batched_runner_writes_report(self, tmp_path):
         from scintools_tpu.robust.runner import run_survey_batched
 

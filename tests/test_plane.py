@@ -339,7 +339,8 @@ class TestPlaneThreadMode:
             code, _, index = _get(url, "/")
             assert code == 200
             assert set(index["paths"]) == {
-                "/", "/metrics", "/report", "/state", "/workers"}
+                "/", "/metrics", "/report", "/state", "/workers",
+                "/ledger"}
             code, _, nf = _get(url, "/nope")
             assert code == 404 and "/workers" in nf["paths"]
 
@@ -371,6 +372,10 @@ class TestPlaneThreadMode:
             code, _, workers = _get(url, "/workers")
             assert code == 200
             assert set(workers["workers"]) >= {"w0", "w1"}
+            # the coordinator's program cost ledger is on the plane
+            # surface too (ISSUE 20)
+            code, _, led = _get(url, "/ledger")
+            assert code == 200 and "entries" in led
         finally:
             out = pod.wait(timeout=120.0)
         assert out["summary"]["n_ok"] == 24

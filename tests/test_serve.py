@@ -556,7 +556,7 @@ class TestIntegrationAcceptance:
             assert code == 200
             assert set(index["paths"]) == {
                 "/", "/metrics", "/healthz", "/readyz", "/report",
-                "/state"}
+                "/state", "/ledger"}
             assert index["paths"] == notfound["paths"]
 
             total = self.N_OK + 2
@@ -583,6 +583,10 @@ class TestIntegrationAcceptance:
             assert rep["in_progress"] is True
             code, _, state = _get(port, "/state")
             assert code == 200 and state["epochs"]
+            # the program cost ledger serves mid-run too (ISSUE 20)
+            code, _, led = _get(port, "/ledger")
+            assert code == 200 and "entries" in led \
+                and "platform" in led
             code, _, health = _get(port, "/healthz")
             assert code == 200 and health["ok"] is True
             code, _, ready = _get(port, "/readyz")

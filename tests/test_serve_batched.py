@@ -304,6 +304,98 @@ class TestBatchedDaemon:
             'serve_tenant_published_total{tenant="healthy"}'] >= 3
 
 
+class TestTenantSLO:
+    """Per-tenant SLO accounting (ISSUE 20): bounded tenant labels on
+    the latency histogram, per-tenant percentiles in the live stats,
+    and the RunReport ``slo`` block."""
+
+    def _service(self, tmp_path, **kw):
+        def process_batch(payloads, tier=None):
+            return [_numeric_process(p) for p in payloads]
+
+        src = QueueSource(hash_payloads=True)
+        kw.setdefault("http", False)
+        kw.setdefault("heartbeat", False)
+        kw.setdefault("report", False)
+        kw.setdefault("max_batch", 8)
+        svc = SurveyService(src, _numeric_process, tmp_path / "run",
+                            process_batch=process_batch, **kw)
+        return src, svc
+
+    def test_tenant_label_bounded_and_sticky(self, tmp_path):
+        _, svc = self._service(tmp_path, tenant_label_cap=2)
+        assert svc._tenant_label("a") == "a"
+        assert svc._tenant_label("b") == "b"
+        # past the cap every NEW tenant folds into "other"...
+        assert svc._tenant_label("c") == "other"
+        assert svc._tenant_label("d") == "other"
+        # ...and the mapping is sticky for the early ones
+        assert svc._tenant_label("a") == "a"
+
+    def test_latency_labels_and_slo_snapshot(self, tmp_path):
+        src, svc = self._service(tmp_path, tenant_label_cap=2)
+        with svc:
+            for i in range(4):
+                src.put(f"a{i}", np.full((2, 2), float(i)),
+                        tenant="alice")
+                src.put(f"b{i}", np.full((2, 2), 10.0 + i),
+                        tenant="bob")
+                src.put(f"c{i}", np.full((2, 2), 20.0 + i),
+                        tenant="carol")
+            assert _wait(lambda: len(svc.results()) == 12)
+            slo = svc.slo_snapshot()
+            stats = svc._live_stats()
+        # bounded label set: two named tenants + the overflow bucket
+        assert set(slo["tenants"]) == {"alice", "bob", "other"}
+        for pct in slo["tenants"].values():
+            assert pct["n"] >= 1 and pct["p95_s"] >= pct["p50_s"] >= 0
+        assert slo["global"]["n"] == 12
+        # the dispatch site's measured cost rides in the sites view
+        assert "serve.batch" in slo["sites"]
+        assert stats["tenants"] == slo["tenants"]
+        # the histogram family carries the SAME bounded labels
+        hists = obs_metrics.snapshot()["histograms"]
+        labelled = {k for k in hists
+                    if k.startswith("serve_e2e_latency_seconds{")}
+        assert labelled == {
+            'serve_e2e_latency_seconds{tenant="alice"}',
+            'serve_e2e_latency_seconds{tenant="bob"}',
+            'serve_e2e_latency_seconds{tenant="other"}'}
+
+    def test_run_report_slo_block(self, tmp_path):
+        from scintools_tpu.obs.report import validate_run_report
+
+        src, svc = self._service(tmp_path, report=True)
+        with svc:
+            for i in range(4):
+                src.put(f"e{i}", np.full((2, 2), float(i)),
+                        tenant="alice")
+            assert _wait(lambda: len(svc.results()) == 4)
+        rep = json.loads(
+            (tmp_path / "run" / "run_report.json").read_text())
+        validate_run_report(rep)
+        assert rep["slo"]["tenants"]["alice"]["n"] == 4
+        assert rep["slo"]["global"]["n"] == 4
+
+    def test_ledger_persists_across_daemon_restart(self, tmp_path):
+        from scintools_tpu.obs import ledger as obs_ledger
+
+        src, svc = self._service(tmp_path)
+        with svc:
+            for i in range(4):
+                src.put(f"e{i}", np.full((2, 2), float(i)))
+            assert _wait(lambda: len(svc.results()) == 4)
+        path = obs_ledger.workdir_path(tmp_path / "run")
+        assert os.path.exists(path)
+        # a fresh process (stand-in: reset singleton) resumes the
+        # cost model from the workdir file
+        obs_ledger.reset()
+        assert obs_ledger.steady_median("serve.batch") is None
+        src2, svc2 = self._service(tmp_path)
+        with svc2:
+            assert obs_ledger.steady_median("serve.batch") is not None
+
+
 class TestBitwiseLaneQuarantine:
     def test_neighbour_lanes_bitwise_untouched(self):
         """The real batched fit program (fit.scint_params_serve): a

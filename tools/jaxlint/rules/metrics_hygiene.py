@@ -28,6 +28,24 @@ literal. A marker on a LITERAL registration grandfathers it
 (triage escape hatch; the reason should say why the name cannot
 follow the convention).
 
+**Label cardinality** (ISSUE 20): a ``.labels(...)`` call on a
+registration whose label VALUE is a non-literal expression mints a
+new metric child per distinct runtime string — a scanner probing
+random URLs or a tenant-id flood becomes an unbounded label space
+and an unbounded registry. Such a value must either come from a
+**bounding helper** — a call whose function name contains
+``bounded`` or ends ``_label`` (``_bounded_path(...)``,
+``self._tenant_label(...)``) — or the line must carry a
+``bounded=<label>`` token in its metric-hygiene marker::
+
+    .labels(site=site).inc()  # lint-ok: metric-hygiene: bounded=site
+
+``bounded=`` tokens are recognised anywhere in the registration
+chain's line range (a chained ``.labels()`` call starts, in AST
+terms, at the receiver's first line). They are NOT metric names and
+NOT grandfather reasons: a marker whose payload is only ``bounded=``
+tokens does not exempt the name checks.
+
 Receivers named for array/plotting libraries (``np.histogram``,
 ``jnp.histogram``, ``plt.hist``…) are ignored — those are math, not
 metrics.
@@ -72,6 +90,38 @@ def _name_arg(node):
     return None
 
 
+def _split_payload(payload):
+    """Split a marker payload into ``(bounded_labels, rest_tokens)``:
+    ``bounded=<label>`` tokens declare label-cardinality triage, the
+    rest are metric names / grandfather reasons."""
+    bounded, rest = set(), []
+    for tok in (payload or "").split():
+        tok = tok.rstrip(",;")
+        if tok.startswith("bounded="):
+            bounded.add(tok[len("bounded="):])
+        elif tok:
+            rest.append(tok)
+    return bounded, rest
+
+
+def _bounded_helper_call(value):
+    """True when a label value comes from a bounding helper — a call
+    whose function name contains ``bounded`` or ends ``_label``
+    (``_bounded_path(path, routes)``, ``self._tenant_label(t)``) —
+    the code-shape guarantee that the runtime string was folded into
+    a finite label set."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    if isinstance(f, ast.Attribute):
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    else:
+        return False
+    return "bounded" in name or name.endswith("_label")
+
+
 def _name_problems(name, kind, catalog):
     """The convention violations of one (name, kind) registration."""
     out = []
@@ -114,6 +164,12 @@ class MetricHygieneRule(Rule):
         for node in ctx.nodes:
             if not isinstance(node, ast.Call):
                 continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "labels" \
+                    and isinstance(node.func.value, ast.Call) \
+                    and _factory_kind(node.func.value) is not None \
+                    and _name_arg(node.func.value) is not None:
+                yield from self._check_labels(ctx, node)
             kind = _factory_kind(node)
             if kind is None:
                 continue
@@ -121,14 +177,18 @@ class MetricHygieneRule(Rule):
             if arg is None:
                 continue              # not a registration form
             payload = ctx.marked(node.lineno, self.name)
+            bounded_only = payload is not None \
+                and not _split_payload(payload)[1]
             if isinstance(arg, ast.Constant) \
                     and isinstance(arg.value, str):
-                if payload is not None:
-                    continue          # grandfathered literal
+                if payload is not None and not bounded_only:
+                    continue          # grandfathered literal — a
+                    # payload of only bounded= tokens is label
+                    # triage, not a name-check exemption
                 names = [arg.value]
             else:
-                names = [t.rstrip(",;") for t in (payload or "")
-                         .split() if _SNAKE.match(t.rstrip(",;"))]
+                names = [t for t in _split_payload(payload)[1]
+                         if _SNAKE.match(t)]
                 if not names:
                     yield self.finding(
                         ctx, node.lineno,
@@ -142,3 +202,39 @@ class MetricHygieneRule(Rule):
                     yield self.finding(ctx, node.lineno, problem,
                                        data={"metric": name,
                                              "kind": kind})
+
+    def _check_labels(self, ctx, node):
+        """The label-cardinality check of one ``<factory>(...)
+        .labels(...)`` chain (see the module docstring): every
+        non-literal label value needs a bounding-helper call or a
+        ``bounded=<label>`` marker token somewhere on the chain's
+        lines (a chained call's ``lineno`` is the RECEIVER's first
+        line, so the trailing marker lives at ``end_lineno``)."""
+        bounded = set()
+        for ln in range(node.lineno,
+                        (node.end_lineno or node.lineno) + 1):
+            bounded |= _split_payload(
+                ctx.marked(ln, self.name))[0]
+        for kw in node.keywords:
+            if kw.arg is None:
+                yield self.finding(
+                    ctx, node.lineno,
+                    ".labels(**...) hides the label names from "
+                    "cardinality review — pass labels as explicit "
+                    "keywords")
+                continue
+            value = kw.value
+            if isinstance(value, ast.Constant):
+                continue              # a literal value is bounded
+            if _bounded_helper_call(value) or kw.arg in bounded:
+                continue
+            yield self.finding(
+                ctx, node.lineno,
+                f"label {kw.arg!r} takes a non-literal value — "
+                "every distinct runtime string mints a new metric "
+                "child (unbounded cardinality); fold it through a "
+                "bounding helper (function name containing "
+                "'bounded' or ending '_label') or, after verifying "
+                "the value set is finite, mark the line "
+                f"'# lint-ok: metric-hygiene: bounded={kw.arg}'",
+                data={"label": kw.arg})
